@@ -1,0 +1,12 @@
+from dla_tpu.models.config import ModelConfig, get_model_config, known_models, register_model
+from dla_tpu.models.transformer import Transformer
+from dla_tpu.models.reward import RewardModel
+
+__all__ = [
+    "ModelConfig",
+    "get_model_config",
+    "known_models",
+    "register_model",
+    "Transformer",
+    "RewardModel",
+]

@@ -1,0 +1,103 @@
+"""Model hyperparameter schema + registry of presets.
+
+Replaces the reference's reliance on HF ``AutoConfig``/``AutoModel``
+(src/models/base_model.py:17-42): model architecture is explicit data here,
+so the same transformer code serves Llama-2 7B/13B/70B, Mistral-7B, phi-2
+-class students, and tiny test models.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: Optional[int] = None      # defaults to hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    max_seq_length: int = 2048
+    # numerics
+    dtype: str = "bfloat16"             # activation dtype
+    param_dtype: str = "float32"        # master param dtype
+    # remat: "none" | "full" | "dots"  (jax.checkpoint policy per block)
+    remat: str = "full"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Registry: name -> ModelConfig. Names accepted anywhere the reference
+# accepts an HF repo id (model_name_or_path config keys).
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, ModelConfig] = {}
+
+
+def register_model(name: str, cfg: ModelConfig) -> None:
+    _REGISTRY[name.lower()] = cfg
+
+
+def get_model_config(name: str, **overrides: Any) -> ModelConfig:
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise KeyError(
+            f"Unknown model preset '{name}'. Known: {sorted(_REGISTRY)}")
+    cfg = _REGISTRY[key]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def known_models() -> Dict[str, ModelConfig]:
+    return dict(_REGISTRY)
+
+
+register_model("llama2-7b", ModelConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+    num_layers=32, num_heads=32, num_kv_heads=32, max_seq_length=4096))
+register_model("llama2-13b", ModelConfig(
+    vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+    num_layers=40, num_heads=40, num_kv_heads=40, max_seq_length=4096))
+register_model("llama2-70b", ModelConfig(
+    vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+    num_layers=80, num_heads=64, num_kv_heads=8, max_seq_length=4096))
+register_model("mistral-7b", ModelConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, max_seq_length=8192))
+# phi-2-class small student (2.7B, dense MHA, tied embeddings like phi-2)
+register_model("phi-2", ModelConfig(
+    vocab_size=51200, hidden_size=2560, intermediate_size=10240,
+    num_layers=32, num_heads=32, num_kv_heads=32, tie_embeddings=True,
+    max_seq_length=2048))
+# tiny models for tests / smoke runs
+register_model("tiny", ModelConfig(
+    vocab_size=512, hidden_size=64, intermediate_size=192,
+    num_layers=2, num_heads=4, num_kv_heads=2, max_seq_length=256,
+    param_dtype="float32", dtype="float32", remat="none"))
+register_model("tiny-gqa", ModelConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=384,
+    num_layers=4, num_heads=8, num_kv_heads=4, max_seq_length=512,
+    param_dtype="float32", dtype="float32", remat="none"))
+
+# HF repo-id aliases so reference configs keep working verbatim
+register_model("meta-llama/Llama-2-7b-hf", _REGISTRY["llama2-7b"])
+register_model("meta-llama/Llama-2-13b-hf", _REGISTRY["llama2-13b"])
+register_model("meta-llama/Llama-2-70b-hf", _REGISTRY["llama2-70b"])
+register_model("mistralai/Mistral-7B-v0.1", _REGISTRY["mistral-7b"])
+register_model("microsoft/phi-2", _REGISTRY["phi-2"])

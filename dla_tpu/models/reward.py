@@ -1,0 +1,75 @@
+"""Reward model: transformer backbone + pooled scalar head.
+
+Replaces the reference's ``AutoModel`` + dropout + Linear(hidden, 1) head
+(src/models/reward_model.py:38-64). Pooling modes match the reference:
+``last_token`` indexes the hidden state at attention_mask.sum()-1
+(reward_model.py:56-59); ``mean`` is a masked mean (reward_model.py:61-64).
+
+Dropout on the pooled feature (reward_model.py:44) is implemented but is a
+no-op unless a dropout rng is threaded in (deterministic eval by default —
+the TPU-first stance is that stochastic layers take explicit rngs).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dla_tpu.models.config import ModelConfig
+from dla_tpu.models.transformer import Transformer
+
+Params = Dict[str, Any]
+
+
+class RewardModel:
+    def __init__(self, cfg: ModelConfig, pooling: str = "last_token",
+                 dropout: float = 0.0):
+        if pooling not in ("last_token", "mean"):
+            raise ValueError(f"Unknown pooling '{pooling}'")
+        self.backbone = Transformer(cfg)
+        self.cfg = cfg
+        self.pooling = pooling
+        self.dropout = dropout
+
+    def init(self, rng: jax.Array) -> Params:
+        brng, hrng = jax.random.split(rng)
+        params = self.backbone.init(brng)
+        params.pop("lm_head", None)  # backbone only — no unembedding
+        params["reward_head"] = {
+            "w": (jax.random.normal(hrng, (self.cfg.hidden_size, 1), jnp.float32)
+                  * (self.cfg.hidden_size ** -0.5)
+                  ).astype(jnp.dtype(self.cfg.param_dtype)),
+            "b": jnp.zeros((1,), jnp.dtype(self.cfg.param_dtype)),
+        }
+        return params
+
+    def partition_specs(self) -> Params:
+        specs = self.backbone.partition_specs()
+        specs.pop("lm_head", None)
+        specs["reward_head"] = {"w": P("fsdp", None), "b": P(None)}
+        return specs
+
+    def apply(self, params: Params, input_ids: jnp.ndarray,
+              attention_mask: jnp.ndarray,
+              dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        """[B, T] -> [B] scalar rewards (fp32)."""
+        h = self.backbone.hidden_states(params, input_ids, attention_mask)
+        mask = attention_mask.astype(jnp.float32)
+        if self.pooling == "last_token":
+            idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+            pooled = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+        else:
+            pooled = (h * mask[..., None]).sum(axis=1) / (
+                mask.sum(axis=1, keepdims=True) + 1e-8)
+        pooled = pooled.astype(jnp.float32)
+        if dropout_rng is not None and self.dropout > 0.0:
+            keep = jax.random.bernoulli(
+                dropout_rng, 1.0 - self.dropout, pooled.shape)
+            pooled = jnp.where(keep, pooled / (1.0 - self.dropout), 0.0)
+        head = params["reward_head"]
+        return (pooled @ head["w"].astype(jnp.float32)
+                + head["b"].astype(jnp.float32))[:, 0]
+
+    __call__ = apply

@@ -1,0 +1,26 @@
+from dla_tpu.ops.norms import rms_norm
+from dla_tpu.ops.rotary import apply_rotary, rotary_angles
+from dla_tpu.ops.attention import causal_attention
+from dla_tpu.ops.losses import (
+    cross_entropy_loss,
+    dpo_loss,
+    masked_mean,
+    pairwise_reward_loss,
+    sequence_logprob_mean,
+    token_logprobs,
+    kl_distill_loss,
+)
+
+__all__ = [
+    "rms_norm",
+    "apply_rotary",
+    "rotary_angles",
+    "causal_attention",
+    "cross_entropy_loss",
+    "dpo_loss",
+    "masked_mean",
+    "pairwise_reward_loss",
+    "sequence_logprob_mean",
+    "token_logprobs",
+    "kl_distill_loss",
+]

@@ -1,0 +1,65 @@
+"""Attention ops.
+
+``causal_attention`` is the XLA-fused reference implementation: einsum QK^T
+-> masked softmax (fp32) -> einsum with V. XLA fuses the mask+softmax into
+the matmuls well on TPU; the Pallas flash kernel
+(dla_tpu.ops.flash_attention) replaces it for long sequences where the
+[B, H, T, T] score materialization no longer fits HBM, and ring attention
+(dla_tpu.ops.ring_attention) extends it over the ``sequence`` mesh axis.
+
+Replaces: HF attention internals + the optional flash-attention path the
+reference only gestures at (reference src/models/base_model.py:39-40 — the
+flag merely sets use_cache=False).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: -inf breaks softmax rows that are fully masked
+
+
+def causal_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, S, K, D]   K = num kv heads (GQA when K < H)
+    v: jnp.ndarray,  # [B, S, K, D]
+    *,
+    kv_segment_mask: Optional[jnp.ndarray] = None,  # [B, T, S] extra mask (1=attend)
+    q_positions: Optional[jnp.ndarray] = None,  # [B, T] absolute positions
+    kv_positions: Optional[jnp.ndarray] = None,  # [B, S]
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Grouped-query causal attention. Returns [B, T, H, D].
+
+    Causality is evaluated on absolute positions so the same op serves
+    full-sequence training (q_positions == kv_positions == arange) and
+    single-token decode against a KV cache (q_positions = current step).
+    """
+    b, t, h, d = q.shape
+    _, s, kheads, _ = k.shape
+    groups = h // kheads
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    qg = q.reshape(b, t, kheads, groups, d)
+    # scores [B, K, G, T, S]
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
+
+    mask = None
+    if causal:
+        if q_positions is None:
+            q_positions = jnp.arange(t)[None, :]
+        if kv_positions is None:
+            kv_positions = jnp.arange(s)[None, :]
+        mask = q_positions[:, :, None] >= kv_positions[:, None, :]  # [B, T, S]
+    if kv_segment_mask is not None:
+        seg = kv_segment_mask.astype(bool)
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+
+    weights = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-30)
+    out = jnp.einsum("bkgts,bskd->btkgd", weights.astype(v.dtype), v)
+    return out.reshape(b, t, h, d)

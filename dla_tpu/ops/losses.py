@@ -1,0 +1,183 @@
+"""Alignment losses as pure functions — the numerical heart of every phase.
+
+Each loss reproduces the reference's math exactly (cited per-function) but
+is designed for XLA: label masks use the reference's -100 convention at the
+data layer, converted here to a float weight mask; log-prob gathers avoid
+materializing full [B, T, V] fp32 log-softmax tensors where possible
+(reference hot spot: src/training/train_dpo.py:36).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100  # reference label-mask convention (src/data/datasets.py:66-75)
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray,
+                axis=None, eps: float = 1e-8) -> jnp.ndarray:
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(x * mask, axis=axis) / (jnp.sum(mask, axis=axis) + eps)
+
+
+def token_logprobs(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Per-token log p(target) from logits, computed without a [B,T,V]
+    log-softmax materialization: logp = logit[target] - logsumexp(logits).
+
+    logits [B, T, V] (any float dtype), targets [B, T] int -> [B, T] fp32.
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, jnp.clip(targets, 0)[..., None], axis=-1)[..., 0]
+    return picked - lse
+
+
+def shift_for_next_token(
+    logits: jnp.ndarray, labels: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Next-token alignment: logits[:, :-1] predict labels[:, 1:].
+
+    Returns (shifted_logits, shifted_labels, valid_mask) where valid_mask
+    zeroes positions whose label is IGNORE_INDEX.
+    """
+    shifted_logits = logits[:, :-1, :]
+    shifted_labels = labels[:, 1:]
+    valid = (shifted_labels != IGNORE_INDEX)
+    return shifted_logits, shifted_labels, valid
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray,  # [B, T, V]
+    labels: jnp.ndarray,  # [B, T] with IGNORE_INDEX masking
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Token-mean next-token CE — the SFT objective.
+
+    Matches HF's built-in labels CE used by the reference SFT trainer
+    (src/training/train_sft.py:145-146): shift by one, ignore -100, mean
+    over valid tokens. Returns (loss, n_valid_tokens).
+    """
+    logits_s, labels_s, valid = shift_for_next_token(logits, labels)
+    logp = token_logprobs(logits_s, labels_s)
+    n = jnp.sum(valid)
+    loss = -jnp.sum(logp * valid) / jnp.maximum(n, 1)
+    return loss, n
+
+
+def sequence_logprob_mean(
+    logits: jnp.ndarray,        # [B, T, V]
+    input_ids: jnp.ndarray,     # [B, T]
+    mask: jnp.ndarray,          # [B, T] attention/validity mask (1 = real token)
+) -> jnp.ndarray:
+    """Length-normalized mean per-token logp of the sequence, [B] fp32.
+
+    Reference math: train_dpo.py:31-39 ``compute_logprobs`` and
+    train_rlhf.py:50-58 ``sequence_logprob`` (identical): shift logits by
+    one, gather target logp, mask, mean over valid positions.
+    """
+    logits_s = logits[:, :-1, :]
+    targets = input_ids[:, 1:]
+    m = mask[:, 1:].astype(jnp.float32)
+    logp = token_logprobs(logits_s, targets)
+    return jnp.sum(logp * m, axis=-1) / (jnp.sum(m, axis=-1) + 1e-8)
+
+
+def dpo_loss(
+    policy_chosen_logp: jnp.ndarray,
+    policy_rejected_logp: jnp.ndarray,
+    ref_chosen_logp: jnp.ndarray,
+    ref_rejected_logp: jnp.ndarray,
+    beta: float,
+    label_smoothing: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Direct Preference Optimization loss over per-sequence logps.
+
+    Reference math (train_dpo.py:42-44):
+      -logsigmoid(beta * ((pi_c - pi_r) - (ref_c - ref_r))).mean()
+    ``label_smoothing`` implements the conservative-DPO variant the
+    reference declares in config (dpo_config.yaml:9) but never wires
+    (SURVEY.md sec 2.5) — here it is functional; 0.0 reproduces reference.
+
+    Returns (loss, margin) where margin = beta * (logits difference), used
+    for the preference_rate metric (train_dpo.py:130-132).
+    """
+    pi_diff = policy_chosen_logp - policy_rejected_logp
+    ref_diff = ref_chosen_logp - ref_rejected_logp
+    margin = beta * (pi_diff - ref_diff)
+    pos = -jax.nn.log_sigmoid(margin)
+    if label_smoothing:
+        neg = -jax.nn.log_sigmoid(-margin)
+        loss = jnp.mean((1 - label_smoothing) * pos + label_smoothing * neg)
+    else:
+        loss = jnp.mean(pos)
+    return loss, margin
+
+
+def pairwise_reward_loss(chosen_rewards: jnp.ndarray,
+                         rejected_rewards: jnp.ndarray) -> jnp.ndarray:
+    """Bradley-Terry pairwise ranking loss.
+
+    Reference math (src/models/reward_model.py:67-68):
+      -logsigmoid(chosen - rejected).mean()
+    """
+    return -jnp.mean(jax.nn.log_sigmoid(chosen_rewards - rejected_rewards))
+
+
+def reinforce_loss(
+    policy_logp: jnp.ndarray,   # [B] sequence-mean logp (with grad)
+    advantages: jnp.ndarray,    # [B] detached advantages
+) -> jnp.ndarray:
+    """REINFORCE-with-baseline policy-gradient loss.
+
+    Reference math (train_rlhf.py:153): -(advantage.detach() * logp).mean().
+    """
+    return -jnp.mean(jax.lax.stop_gradient(advantages) * policy_logp)
+
+
+def ppo_clip_loss(
+    policy_logp: jnp.ndarray,      # [B] current-policy seq logp (with grad)
+    behavior_logp: jnp.ndarray,    # [B] logp under the rollout policy (detached)
+    advantages: jnp.ndarray,       # [B]
+    clip_ratio: float = 0.2,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """True PPO clipped surrogate (capability the reference names but does
+    not implement — config/rlhf_config.yaml declares mini_batch_size and
+    target_kl that are unused, SURVEY.md sec 2.5). Returns (loss, clip_frac).
+    """
+    adv = jax.lax.stop_gradient(advantages)
+    ratio = jnp.exp(policy_logp - jax.lax.stop_gradient(behavior_logp))
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1 - clip_ratio, 1 + clip_ratio) * adv
+    loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+    clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > clip_ratio).astype(jnp.float32))
+    return loss, clip_frac
+
+
+def kl_distill_loss(
+    student_logits: jnp.ndarray,            # [B, T, V]
+    teacher_logits: Sequence[jnp.ndarray],  # list of [B, T, V] (ensemble)
+    mask: jnp.ndarray,                      # [B, T] valid-token mask
+    temperature: float = 1.0,
+) -> jnp.ndarray:
+    """Forward KL(teacher_mean || student), token-masked mean.
+
+    Reference math (train_distill.py:130-144): teacher probs averaged over
+    the ensemble, KL summed over vocab, masked mean over tokens.
+    ``temperature`` implements the declared-but-unused config key
+    (distill_config.yaml:33) for real; 1.0 reproduces reference behavior.
+
+    Note the shift: distillation targets are the *next-token* distributions,
+    so we compare logits[:, :-1] under mask[:, 1:].
+    """
+    s = student_logits[:, :-1, :].astype(jnp.float32) / temperature
+    s_logp = jax.nn.log_softmax(s, axis=-1)
+    t_probs = None
+    for tl in teacher_logits:
+        tp = jax.nn.softmax(tl[:, :-1, :].astype(jnp.float32) / temperature, axis=-1)
+        t_probs = tp if t_probs is None else t_probs + tp
+    t_probs = t_probs / len(teacher_logits)
+    t_logp = jnp.log(t_probs + 1e-20)
+    per_token_kl = jnp.sum(t_probs * (t_logp - s_logp), axis=-1)  # [B, T-1]
+    return masked_mean(per_token_kl, mask[:, 1:]) * (temperature ** 2)

@@ -1,0 +1,14 @@
+"""Normalization ops. RMSNorm computed in fp32 regardless of input dtype
+(bf16 variance accumulation loses too much precision), cast back on exit —
+the standard TPU mixed-precision discipline."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)

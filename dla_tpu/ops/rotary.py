@@ -1,0 +1,34 @@
+"""Rotary position embeddings (RoPE), LLaMA convention.
+
+Angles are computed on the fly from integer positions — no precomputed
+[max_len, dim] table to keep in HBM, and decode-step positions can be
+dynamic values inside a jitted loop.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rotary_angles(positions: jnp.ndarray, head_dim: int,
+                  theta: float = 10000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """positions [..., T] int -> (cos, sin) each [..., T, head_dim//2], fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x [B, T, H, D] with (cos, sin) [B, T, D/2] (or broadcastable).
+
+    Uses the split-halves convention (rotate_half), matching LLaMA /
+    HF transformers so imported weights are numerically compatible.
+    """
+    d_half = x.shape[-1] // 2
+    x1, x2 = x[..., :d_half], x[..., d_half:]
+    cos = cos[..., None, :].astype(x.dtype)  # [B, T, 1, D/2]
+    sin = sin[..., None, :].astype(x.dtype)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1)

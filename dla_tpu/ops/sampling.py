@@ -1,0 +1,66 @@
+"""In-graph token sampling for the decode loop.
+
+The reference samples through HF ``generate(temperature, top_p)``
+(train_rlhf.py:123-124, generate_teacher_data.py:72-79,
+eval_alignment.py:71-77). Here sampling is a pure jittable function of
+(logits, rng) so the whole rollout stays on device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def apply_temperature(logits: jnp.ndarray, temperature: float) -> jnp.ndarray:
+    return logits / jnp.maximum(temperature, 1e-6)
+
+
+def top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Keep the k largest logits per row, NEG_INF elsewhere. Static k."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    vals, _ = jax.lax.top_k(logits, k)
+    cutoff = vals[..., -1:]
+    return jnp.where(logits >= cutoff, logits, NEG_INF)
+
+
+def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of the sorted distribution
+    with cumulative probability >= p. Tokens outside get NEG_INF.
+
+    Sort-based; [*, V] -> [*, V]. The token that crosses the threshold is
+    kept (matching the usual HF semantics).
+    """
+    if p >= 1.0:
+        return logits
+    sort_idx = jnp.argsort(logits, axis=-1)[..., ::-1]
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    sorted_probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # drop tokens whose *preceding* cumulative mass already reached p
+    drop_sorted = (cum - sorted_probs) >= p
+    keep_sorted = ~drop_sorted
+    inv = jnp.argsort(sort_idx, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def sample_token(
+    rng: jax.Array,
+    logits: jnp.ndarray,  # [B, V]
+    *,
+    temperature: float = 1.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
+    do_sample: bool = True,
+) -> jnp.ndarray:
+    """One sampling step -> [B] int32 token ids. All filters static."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = apply_temperature(logits, temperature)
+    logits = top_k_mask(logits, top_k)
+    logits = top_p_mask(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

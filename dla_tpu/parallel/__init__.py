@@ -1,0 +1,17 @@
+from dla_tpu.parallel.mesh import MeshConfig, build_mesh, mesh_from_config
+from dla_tpu.parallel.sharding import (
+    batch_spec,
+    named_sharding,
+    shard_pytree,
+    with_constraint,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "mesh_from_config",
+    "batch_spec",
+    "named_sharding",
+    "shard_pytree",
+    "with_constraint",
+]

@@ -1,0 +1,78 @@
+"""Multi-host rendezvous and process-level helpers.
+
+TPU-native replacement for ``accelerate launch``'s process bootstrap
+(reference config/accelerate_config.yaml: MULTI_GPU, num_processes 8,
+static rendezvous on port 29500). On TPU pods each host runs the same
+program; ``jax.distributed.initialize`` wires the coordination service and
+``jax.devices()`` then spans the whole slice. Collectives ride ICI within
+a slice and DCN across slices — chosen by XLA from the mesh layout, not by
+us.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+_INITIALIZED = False
+
+
+def initialize_distributed(hardware_cfg: Optional[Dict[str, Any]] = None) -> None:
+    """Initialize multi-host JAX if requested / detectable; idempotent.
+
+    Config keys (all optional, under ``hardware:``):
+      coordinator_address: "host:port" of process 0
+      num_processes:       world size (reference key reused; on TPU this is
+                           the host count, not the chip count)
+      process_id:          this host's rank
+
+    On single-host (or when nothing is configured and no cloud TPU env is
+    present) this is a no-op — jax works out of the box.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    cfg = hardware_cfg or {}
+    coord = cfg.get("coordinator_address") or os.environ.get("DLA_COORDINATOR")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(cfg.get("num_processes",
+                                      os.environ.get("DLA_NUM_PROCESSES", 1))),
+            process_id=int(cfg.get("process_id",
+                                   os.environ.get("DLA_PROCESS_ID", 0))),
+        )
+        _INITIALIZED = True
+    elif os.environ.get("TPU_WORKER_HOSTNAMES") and cfg.get("auto_initialize", False):
+        # Cloud TPU pod: zero-arg initialize discovers topology from metadata.
+        jax.distributed.initialize()
+        _INITIALIZED = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """Rank-0 predicate for logging/IO (reference utils.py:105-107 log_rank_zero)."""
+    return jax.process_index() == 0
+
+
+def log_main(*args: Any) -> None:
+    if is_main_process():
+        print(*args, flush=True)
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host barrier (reference: accelerator.wait_for_everyone,
+    train_rlhf.py:164). Implemented as a tiny global psum."""
+    if jax.process_count() == 1:
+        return
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)

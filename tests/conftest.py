@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initializes.
+
+This is how the suite exercises multi-chip SPMD (pjit partitioning,
+collectives, checkpoint shard round-trips) without TPU hardware —
+SURVEY.md sec 4's test strategy.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("JAX_PLATFORMS") != "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    assert len(jax.devices()) == 8, (
+        "expected 8 virtual CPU devices; run tests via "
+        "JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    return build_mesh(MeshConfig(data=2, fsdp=2, model=2, sequence=1))
+
+
+@pytest.fixture(scope="session")
+def tiny_cfg():
+    from dla_tpu.models.config import get_model_config
+    return get_model_config("tiny")

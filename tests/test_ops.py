@@ -1,0 +1,207 @@
+"""Unit tests for ops: losses vs hand-computed values, attention vs naive."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dla_tpu.ops.attention import causal_attention
+from dla_tpu.ops.losses import (
+    IGNORE_INDEX,
+    cross_entropy_loss,
+    dpo_loss,
+    kl_distill_loss,
+    pairwise_reward_loss,
+    ppo_clip_loss,
+    reinforce_loss,
+    sequence_logprob_mean,
+    token_logprobs,
+)
+from dla_tpu.ops.norms import rms_norm
+from dla_tpu.ops.rotary import apply_rotary, rotary_angles
+from dla_tpu.ops.sampling import sample_token, top_k_mask, top_p_mask
+
+
+def test_rms_norm_matches_numpy():
+    x = np.random.RandomState(0).randn(2, 5, 8).astype(np.float32)
+    w = np.random.RandomState(1).rand(8).astype(np.float32)
+    got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-5)
+    want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_rotary_norm_preserving_and_position_zero_identity():
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 4, 2, 8).astype(np.float32))
+    pos = jnp.arange(4)[None, :]
+    cos, sin = rotary_angles(pos, 8)
+    y = apply_rotary(x, cos, sin)
+    # norms preserved (rotation)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(x[:, 0]), np.asarray(y[:, 0]), rtol=1e-6)
+
+
+def test_causal_attention_matches_naive():
+    rs = np.random.RandomState(0)
+    b, t, h, d = 2, 6, 4, 8
+    q = jnp.asarray(rs.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, t, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, t, h, d).astype(np.float32))
+    got = np.asarray(causal_attention(q, k, v))
+
+    qn, kn, vn = (np.asarray(a) for a in (q, k, v))
+    want = np.zeros_like(qn)
+    for bi in range(b):
+        for hi in range(h):
+            s = (qn[bi, :, hi] @ kn[bi, :, hi].T) / np.sqrt(d)
+            mask = np.tril(np.ones((t, t), bool))
+            s = np.where(mask, s, -1e30)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            want[bi, :, hi] = p @ vn[bi, :, hi]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_matches_repeated_kv():
+    rs = np.random.RandomState(1)
+    b, t, h, kh, d = 1, 5, 4, 2, 8
+    q = jnp.asarray(rs.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, t, kh, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, t, kh, d).astype(np.float32))
+    got = causal_attention(q, k, v)
+    # repeat kv heads to full h and compare
+    k_full = jnp.repeat(k, h // kh, axis=2)
+    v_full = jnp.repeat(v, h // kh, axis=2)
+    want = causal_attention(q, k_full, v_full)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_token_logprobs_vs_log_softmax():
+    rs = np.random.RandomState(2)
+    logits = jnp.asarray(rs.randn(2, 4, 10).astype(np.float32))
+    targets = jnp.asarray(rs.randint(0, 10, (2, 4)))
+    got = token_logprobs(logits, targets)
+    want = np.take_along_axis(
+        np.asarray(jax.nn.log_softmax(logits, -1)),
+        np.asarray(targets)[..., None], -1)[..., 0]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+def test_cross_entropy_ignores_masked_labels():
+    rs = np.random.RandomState(3)
+    logits = jnp.asarray(rs.randn(1, 5, 7).astype(np.float32))
+    labels = jnp.asarray([[IGNORE_INDEX, IGNORE_INDEX, 3, 4, 5]])
+    loss, n = cross_entropy_loss(logits, labels)
+    assert int(n) == 3  # positions 2,3,4 of the shifted labels
+    # hand-compute: logits[:, :-1] predict labels[:, 1:]
+    lp = np.asarray(jax.nn.log_softmax(logits[:, :-1], -1))
+    want = -(lp[0, 1, 3] + lp[0, 2, 4] + lp[0, 3, 5]) / 3
+    np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+
+
+def test_sequence_logprob_mean_hand_case():
+    # 2 tokens after shift, equal logits -> logp = -log(V) each
+    v = 4
+    logits = jnp.zeros((1, 3, v))
+    ids = jnp.asarray([[1, 2, 3]])
+    mask = jnp.asarray([[1, 1, 1]])
+    got = float(sequence_logprob_mean(logits, ids, mask)[0])
+    np.testing.assert_allclose(got, -np.log(v), rtol=1e-6)
+
+
+def test_dpo_loss_reference_math():
+    pc, pr = jnp.asarray([-1.0]), jnp.asarray([-2.0])
+    rc, rr = jnp.asarray([-1.5]), jnp.asarray([-1.8])
+    beta = 0.1
+    loss, margin = dpo_loss(pc, pr, rc, rr, beta)
+    want_margin = beta * ((pc - pr) - (rc - rr))
+    want_loss = -np.log(1 / (1 + np.exp(-np.asarray(want_margin))))
+    np.testing.assert_allclose(float(loss), float(want_loss[0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(margin), np.asarray(want_margin), rtol=1e-6)
+
+
+def test_dpo_label_smoothing_zero_is_identity():
+    pc, pr = jnp.asarray([-1.0, -0.5]), jnp.asarray([-2.0, -0.7])
+    rc, rr = jnp.asarray([-1.5, -0.6]), jnp.asarray([-1.8, -0.9])
+    l0, _ = dpo_loss(pc, pr, rc, rr, 0.1, label_smoothing=0.0)
+    l1, _ = dpo_loss(pc, pr, rc, rr, 0.1, label_smoothing=0.1)
+    assert not np.allclose(float(l0), float(l1))
+
+
+def test_pairwise_reward_loss():
+    c, r = jnp.asarray([2.0, 0.0]), jnp.asarray([1.0, 1.0])
+    got = float(pairwise_reward_loss(c, r))
+    want = -np.mean(np.log(1 / (1 + np.exp(-np.asarray([1.0, -1.0])))))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_reinforce_loss_gradient_direction():
+    # positive advantage should push logp up (negative loss gradient on logp)
+    logp = jnp.asarray([-1.0])
+    adv = jnp.asarray([2.0])
+    g = jax.grad(lambda lp: reinforce_loss(lp, adv))(logp)
+    assert float(g[0]) < 0  # increasing logp decreases loss
+
+
+def test_ppo_clip_matches_unclipped_in_trust_region():
+    logp = jnp.asarray([-1.0, -1.0])
+    behav = jnp.asarray([-1.05, -1.0])
+    adv = jnp.asarray([1.0, -1.0])
+    loss, frac = ppo_clip_loss(logp, behav, adv, clip_ratio=0.2)
+    ratio = np.exp(np.asarray(logp) - np.asarray(behav))
+    want = -np.mean(ratio * np.asarray(adv))
+    np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+    assert float(frac) == 0.0
+
+
+def test_kl_distill_zero_when_teacher_equals_student():
+    rs = np.random.RandomState(4)
+    logits = jnp.asarray(rs.randn(2, 5, 11).astype(np.float32))
+    mask = jnp.ones((2, 5))
+    kl = float(kl_distill_loss(logits, [logits], mask))
+    assert abs(kl) < 1e-5
+
+
+def test_kl_distill_ensemble_averaging():
+    rs = np.random.RandomState(5)
+    a = jnp.asarray(rs.randn(1, 4, 6).astype(np.float32))
+    b = jnp.asarray(rs.randn(1, 4, 6).astype(np.float32))
+    s = jnp.asarray(rs.randn(1, 4, 6).astype(np.float32))
+    mask = jnp.ones((1, 4))
+    kl_ab = float(kl_distill_loss(s, [a, b], mask))
+    # averaging probs, not logits: verify against manual computation
+    import jax.nn as jnn
+    pa = np.asarray(jnn.softmax(a[:, :-1], -1))
+    pb = np.asarray(jnn.softmax(b[:, :-1], -1))
+    pm = (pa + pb) / 2
+    slp = np.asarray(jnn.log_softmax(s[:, :-1], -1))
+    want = (pm * (np.log(pm + 1e-20) - slp)).sum(-1).mean()
+    np.testing.assert_allclose(kl_ab, want, rtol=1e-4)
+
+
+def test_top_k_mask():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.5]])
+    out = np.asarray(top_k_mask(logits, 2))
+    assert out[0, 1] == 3.0 and out[0, 2] == 2.0
+    assert out[0, 0] < -1e29 and out[0, 3] < -1e29
+
+
+def test_top_p_mask_keeps_threshold_token():
+    # probs ~ [0.7, 0.2, 0.1]; p=0.75 keeps first two
+    logits = jnp.log(jnp.asarray([[0.7, 0.2, 0.1]]))
+    out = np.asarray(top_p_mask(logits, 0.75))
+    assert out[0, 0] > -1e29 and out[0, 1] > -1e29
+    assert out[0, 2] < -1e29
+
+
+def test_sample_token_greedy_and_temperature():
+    logits = jnp.asarray([[0.0, 5.0, 1.0]])
+    tok = sample_token(jax.random.key(0), logits, do_sample=False)
+    assert int(tok[0]) == 1
+    tok = sample_token(jax.random.key(0), logits, temperature=0.0)
+    assert int(tok[0]) == 1
+    # with sampling, draws follow the distribution (peaked logits -> mode)
+    draws = [int(sample_token(jax.random.key(i), logits, temperature=1.0)[0])
+             for i in range(20)]
+    assert draws.count(1) >= 15
