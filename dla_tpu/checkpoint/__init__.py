@@ -1,0 +1,13 @@
+from dla_tpu.checkpoint.checkpointer import (
+    Checkpointer,
+    is_checkpoint_path,
+    load_tree_numpy,
+    resolve_checkpoint_dir,
+)
+
+__all__ = [
+    "Checkpointer",
+    "is_checkpoint_path",
+    "load_tree_numpy",
+    "resolve_checkpoint_dir",
+]

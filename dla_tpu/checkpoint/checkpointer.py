@@ -1,0 +1,241 @@
+"""Sharded checkpoint save/restore.
+
+The reference only *saves* (accelerator.save_state, utils.py:99-102); no
+trainer can resume, no `latest` pointer is ever written, and keep-last-N is
+documented but unimplemented (SURVEY.md sec 5). Here all three are
+first-class:
+
+- step-tagged directories ``step_000123/`` + a ``latest`` pointer file
+- atomic writes (tmp dir + rename)
+- keep-last-N retention
+- restore onto an arbitrary mesh/sharding (cross-topology reshard: leaves
+  are stored as whole logical arrays; ``jax.make_array_from_callback``
+  reads just the slice each device needs via np.load mmap)
+- multi-host: partially-addressable leaves are allgathered across hosts
+  and process 0 writes whole logical arrays. This is simple and correct
+  but serializes I/O through host 0 and materializes full arrays in host
+  RAM — per-host shard files (no gather) are planned once the multi-host
+  path is exercised on real pods.
+
+Format: one ``.npy`` per pytree leaf (path-encoded filename) + an
+``index.json`` with tree structure, dtypes, shapes, and auxiliary
+JSON-serializable state (step, data-iterator position, RNG key data).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+SEP = "."
+
+
+def _as_logical(arr: np.ndarray, dtype_str: str) -> np.ndarray:
+    """Undo npy's void-encoding of non-native dtypes (bfloat16 etc. save as
+    |V2); view back to the logical dtype recorded in the index."""
+    if arr.dtype.kind == "V":
+        import ml_dtypes  # ships with jax; registers bfloat16/fp8 dtypes
+        return arr.view(np.dtype(dtype_str))
+    return arr
+
+
+def _flatten_with_paths(tree: Any) -> List[Tuple[str, Any]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for path, leaf in flat:
+        keys = []
+        for p in path:
+            if hasattr(p, "key"):
+                keys.append(str(p.key))
+            elif hasattr(p, "idx"):
+                keys.append(str(p.idx))
+            else:
+                keys.append(str(p))
+        out.append((SEP.join(keys), leaf))
+    return out
+
+
+def _leaf_filename(path: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.\-]", "_", path) + ".npy"
+
+
+class Checkpointer:
+    def __init__(self, output_dir: str, keep_last_n: int = 3):
+        self.dir = Path(output_dir)
+        self.keep_last_n = keep_last_n
+        self.is_main = jax.process_index() == 0
+
+    # ----------------------------------------------------------------- save
+
+    def save(self, step: int, tree: Any, aux: Optional[Dict[str, Any]] = None,
+             tag: Optional[str] = None) -> Path:
+        tag = tag or f"step_{step:08d}"
+        final = self.dir / tag
+        tmp = self.dir / f".tmp_{tag}_{jax.process_index()}"
+        if self.is_main:
+            tmp.mkdir(parents=True, exist_ok=True)
+
+        leaves = _flatten_with_paths(tree)
+        index = {"format": 1, "step": int(step), "aux": aux or {},
+                 "leaves": {}}
+        for path, leaf in leaves:
+            if leaf is None:
+                continue
+            # All hosts participate (partially-addressable arrays gather via
+            # a collective); only process 0 writes.
+            np_arr = self._to_numpy(leaf)
+            index["leaves"][path] = {
+                "file": _leaf_filename(path),
+                "shape": list(np_arr.shape),
+                "dtype": str(np_arr.dtype),
+            }
+            if self.is_main:
+                np.save(tmp / _leaf_filename(path), np_arr)
+        if self.is_main:
+            with (tmp / "index.json").open("w") as fh:
+                json.dump(index, fh)
+            if final.exists():
+                shutil.rmtree(final)
+            tmp.rename(final)
+            self._write_latest(tag)
+            self._retain()
+        return final
+
+    @staticmethod
+    def _to_numpy(arr: Any) -> np.ndarray:
+        if isinstance(arr, (np.ndarray, np.generic, int, float)):
+            return np.asarray(arr)
+        if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            arr = multihost_utils.process_allgather(arr)
+        if hasattr(arr, "dtype") and jax.dtypes.issubdtype(
+                arr.dtype, jax.dtypes.prng_key):
+            arr = jax.random.key_data(arr)
+        return np.asarray(arr)
+
+    def _write_latest(self, tag: str) -> None:
+        with (self.dir / "latest").open("w") as fh:
+            fh.write(tag)
+
+    def _retain(self) -> None:
+        if self.keep_last_n <= 0:
+            return
+        steps = sorted(
+            (d for d in self.dir.glob("step_*") if d.is_dir()),
+            key=lambda d: d.name)
+        for old in steps[: max(0, len(steps) - self.keep_last_n)]:
+            shutil.rmtree(old, ignore_errors=True)
+
+    # -------------------------------------------------------------- restore
+
+    def latest_tag(self) -> Optional[str]:
+        latest = self.dir / "latest"
+        if latest.is_file():
+            tag = latest.read_text().strip()
+            if (self.dir / tag).is_dir():
+                return tag
+        steps = sorted(d.name for d in self.dir.glob("step_*") if d.is_dir())
+        return steps[-1] if steps else None
+
+    def restore(self, template: Any, tag: Optional[str] = None,
+                shardings: Optional[Any] = None
+                ) -> Tuple[Any, Dict[str, Any]]:
+        """Restore a pytree like ``template``; place leaves per ``shardings``
+        (a matching pytree of jax.sharding.Sharding) or on the default
+        device. Returns (tree, aux)."""
+        tag = tag or self.latest_tag()
+        if tag is None:
+            raise FileNotFoundError(f"No checkpoint under {self.dir}")
+        ckpt = resolve_checkpoint_dir(self.dir / tag)
+        with (ckpt / "index.json").open() as fh:
+            index = json.load(fh)
+
+        leaves_t = _flatten_with_paths(template)
+        shard_leaves = (_flatten_with_paths(shardings)[0:] if shardings is not None
+                        else None)
+        shard_by_path = dict(shard_leaves) if shard_leaves else {}
+        restored: Dict[str, Any] = {}
+        for path, tmpl_leaf in leaves_t:
+            meta = index["leaves"].get(path)
+            if meta is None:
+                raise KeyError(f"Checkpoint {ckpt} missing leaf '{path}'")
+            fname = ckpt / meta["file"]
+            arr = _as_logical(np.load(fname, mmap_mode="r"), meta["dtype"])
+            is_key = hasattr(tmpl_leaf, "dtype") and jax.dtypes.issubdtype(
+                getattr(tmpl_leaf, "dtype", None), jax.dtypes.prng_key)
+            sharding = shard_by_path.get(path)
+            if sharding is not None and not is_key:
+                out = jax.make_array_from_callback(
+                    tuple(meta["shape"]), sharding,
+                    lambda idx, a=arr: np.asarray(a[idx]))
+            else:
+                out = jax.device_put(np.asarray(arr))
+                if is_key:
+                    out = jax.random.wrap_key_data(out)
+            restored[path] = out
+
+        tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(template),
+            [restored[p] for p, _ in leaves_t])
+        return tree, index.get("aux", {})
+
+
+def load_tree_numpy(ckpt_dir, prefix: Optional[str] = None
+                    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Load a checkpoint's leaves as host numpy arrays, rebuilt into nested
+    dicts from their path-encoded names. Used for model loading, where the
+    caller shards the result onto its own mesh afterwards. Returns
+    (tree, aux)."""
+    ckpt = resolve_checkpoint_dir(ckpt_dir)
+    with (ckpt / "index.json").open() as fh:
+        index = json.load(fh)
+    tree: Dict[str, Any] = {}
+    for path, meta in index["leaves"].items():
+        if prefix is not None:
+            if not path.startswith(prefix + SEP):
+                continue
+            rel = path[len(prefix) + 1:]
+        else:
+            rel = path
+        node = tree
+        keys = rel.split(SEP)
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = _as_logical(
+            np.load(ckpt / meta["file"]), meta["dtype"])
+    return tree, index.get("aux", {})
+
+
+def resolve_checkpoint_dir(path) -> Path:
+    """Follow a ``latest`` pointer if ``path`` is a checkpoint root or ends
+    in /latest (the reference configs point at ``checkpoints/X/latest``,
+    e.g. dpo_config.yaml:6-7)."""
+    p = Path(path)
+    if p.name == "latest":
+        root = p.parent
+        ck = Checkpointer(str(root))
+        tag = ck.latest_tag()
+        if tag is None:
+            raise FileNotFoundError(f"No checkpoint under {root}")
+        return root / tag
+    if (p / "index.json").is_file():
+        return p
+    ck = Checkpointer(str(p))
+    tag = ck.latest_tag()
+    if tag:
+        return p / tag
+    raise FileNotFoundError(f"No checkpoint at {p}")
+
+
+def is_checkpoint_path(path) -> bool:
+    try:
+        resolve_checkpoint_dir(path)
+        return True
+    except (FileNotFoundError, NotADirectoryError):
+        return False

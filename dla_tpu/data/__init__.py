@@ -1,0 +1,32 @@
+from dla_tpu.data.jsonl import append_jsonl, iter_jsonl, read_jsonl, write_jsonl
+from dla_tpu.data.tokenizers import ByteTokenizer, HFTokenizer, load_tokenizer
+from dla_tpu.data.datasets import (
+    IGNORE_INDEX,
+    EvalPromptDataset,
+    InstructionDataset,
+    PreferenceDataset,
+    TeacherRolloutDataset,
+    encode_prompt_response,
+    pad_batch,
+)
+from dla_tpu.data.loaders import (
+    build_instruction_dataset,
+    build_preference_dataset,
+    build_teacher_dataset,
+    load_instruction_records,
+    load_preference_records,
+    load_prompt_records,
+)
+from dla_tpu.data.iterator import ShardedBatchIterator
+from dla_tpu.data.packing import PackedInstructionDataset
+
+__all__ = [
+    "append_jsonl", "iter_jsonl", "read_jsonl", "write_jsonl",
+    "ByteTokenizer", "HFTokenizer", "load_tokenizer",
+    "IGNORE_INDEX", "EvalPromptDataset", "InstructionDataset",
+    "PreferenceDataset", "TeacherRolloutDataset", "encode_prompt_response",
+    "pad_batch", "build_instruction_dataset", "build_preference_dataset",
+    "build_teacher_dataset", "load_instruction_records",
+    "load_preference_records", "load_prompt_records",
+    "ShardedBatchIterator", "PackedInstructionDataset",
+]

@@ -1,0 +1,173 @@
+"""Tokenized datasets for every phase, with the reference's exact text
+contract but TPU-static batch shapes.
+
+Contract parity (reference src/data/datasets.py):
+- template ``"{prompt}\n\n{response}{eos}"`` (datasets.py:56,107,177)
+- prompt masking: the tokens of ``"{prompt}\n\n"`` get label -100
+  (datasets.py:66-75)
+- preference pairs tokenize chosen/rejected independently (datasets.py:121-122)
+- teacher rollouts: labels = input_ids, no prompt mask, scalar reward
+  carried through (datasets.py:172-190)
+
+Deliberate divergence (documented, SURVEY.md sec 7): batches are padded to a
+**fixed** ``max_length``, not to the batch max — dynamic shapes force XLA
+recompilation per batch; a single static shape compiles once. Sequence
+packing (dla_tpu.data.packing) recovers the wasted pad FLOPs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dla_tpu.data.jsonl import read_jsonl
+from dla_tpu.data.tokenizers import Tokenizer
+
+IGNORE_INDEX = -100
+
+PROMPT_TEMPLATE = "{prompt}\n\n"
+FULL_TEMPLATE = "{prompt}\n\n{response}"
+
+
+def encode_prompt_response(
+    tokenizer: Tokenizer, prompt: str, response: str, max_length: int,
+    mask_prompt: bool = True,
+) -> Dict[str, np.ndarray]:
+    """Tokenize one example to (input_ids, attention_mask, labels), unpadded."""
+    prompt = prompt.strip()
+    response = response.strip()
+    full_ids = tokenizer.encode(
+        FULL_TEMPLATE.format(prompt=prompt, response=response), add_eos=True)
+    prompt_ids = tokenizer.encode(
+        PROMPT_TEMPLATE.format(prompt=prompt), add_eos=False)
+    full_ids = full_ids[:max_length]
+    labels = list(full_ids)
+    if mask_prompt:
+        cut = min(len(prompt_ids), len(labels))
+        labels[:cut] = [IGNORE_INDEX] * cut
+    return {
+        "input_ids": np.asarray(full_ids, np.int32),
+        "attention_mask": np.ones(len(full_ids), np.int32),
+        "labels": np.asarray(labels, np.int32),
+    }
+
+
+def pad_to(arr: np.ndarray, length: int, pad_value: int) -> np.ndarray:
+    if arr.shape[0] >= length:
+        return arr[:length]
+    pad = np.full((length - arr.shape[0],) + arr.shape[1:], pad_value, arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def pad_batch(examples: Sequence[Dict[str, np.ndarray]], pad_token_id: int,
+              length: int) -> Dict[str, np.ndarray]:
+    """Stack variable-length examples into fixed [B, length] arrays.
+
+    Pad values follow the reference (datasets.py:212-229): input_ids ->
+    pad_token_id, attention_mask -> 0, labels -> -100; any other integer
+    key -> 0; scalar keys are stacked unpadded.
+    """
+    out: Dict[str, np.ndarray] = {}
+    for key in examples[0]:
+        vals = [ex[key] for ex in examples]
+        if vals[0].ndim == 0:
+            out[key] = np.stack(vals)
+            continue
+        if key == "labels":
+            pv = IGNORE_INDEX
+        elif key == "input_ids":
+            pv = pad_token_id
+        else:
+            pv = 0
+        out[key] = np.stack([pad_to(v, length, pv) for v in vals])
+    return out
+
+
+class _RecordDataset:
+    records: List[Dict[str, Any]]
+
+    def __init__(self, tokenizer: Tokenizer, max_length: int,
+                 path: Optional[str] = None,
+                 records: Optional[List[Dict[str, Any]]] = None):
+        if records is None and path is None:
+            raise ValueError(f"{type(self).__name__} needs records or a path")
+        self.records = records if records is not None else read_jsonl(path)
+        self.tokenizer = tokenizer
+        self.max_length = max_length
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+
+class InstructionDataset(_RecordDataset):
+    """SFT examples: {prompt, response} with prompt-masked labels."""
+
+    def __init__(self, tokenizer: Tokenizer, max_length: int,
+                 mask_prompt: bool = True, path: Optional[str] = None,
+                 records: Optional[List[Dict[str, Any]]] = None):
+        super().__init__(tokenizer, max_length, path, records)
+        self.mask_prompt = mask_prompt
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        rec = self.records[idx]
+        return encode_prompt_response(
+            self.tokenizer, rec["prompt"], rec["response"],
+            self.max_length, self.mask_prompt)
+
+    def collate(self, batch: Sequence[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        return pad_batch(batch, self.tokenizer.pad_token_id, self.max_length)
+
+
+class PreferenceDataset(_RecordDataset):
+    """DPO / reward-model pairs: {prompt, chosen, rejected}."""
+
+    def __getitem__(self, idx: int) -> Dict[str, Dict[str, np.ndarray]]:
+        rec = self.records[idx]
+        return {
+            "chosen": encode_prompt_response(
+                self.tokenizer, rec["prompt"], rec["chosen"],
+                self.max_length, mask_prompt=True),
+            "rejected": encode_prompt_response(
+                self.tokenizer, rec["prompt"], rec["rejected"],
+                self.max_length, mask_prompt=True),
+        }
+
+    def collate(self, batch) -> Dict[str, Dict[str, np.ndarray]]:
+        return {
+            side: pad_batch([ex[side] for ex in batch],
+                            self.tokenizer.pad_token_id, self.max_length)
+            for side in ("chosen", "rejected")
+        }
+
+
+class TeacherRolloutDataset(_RecordDataset):
+    """Distillation examples: {prompt, teacher_response, reward?}.
+
+    Labels = input_ids (no prompt mask) and the scalar reward rides along,
+    matching reference datasets.py:172-190.
+    """
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        rec = self.records[idx]
+        ex = encode_prompt_response(
+            self.tokenizer, rec["prompt"], rec["teacher_response"],
+            self.max_length, mask_prompt=False)
+        ex["labels"] = ex["input_ids"].copy()
+        ex["reward"] = np.asarray(float(rec.get("reward", 1.0)), np.float32)
+        return ex
+
+    def collate(self, batch) -> Dict[str, np.ndarray]:
+        return pad_batch(batch, self.tokenizer.pad_token_id, self.max_length)
+
+
+class EvalPromptDataset:
+    """Plain prompt records for evaluation (reference datasets.py:199-209)."""
+
+    def __init__(self, path: str):
+        self.records = read_jsonl(path)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def __getitem__(self, idx: int) -> Dict[str, Any]:
+        return self.records[idx]

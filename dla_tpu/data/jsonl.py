@@ -1,0 +1,43 @@
+"""JSONL IO — the on-disk data contract shared with the reference
+(README.md:88-94: {prompt, response}, {prompt, chosen, rejected},
+{prompt, teacher_response, reward?})."""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Iterable, Iterator, List, Union
+
+PathLike = Union[str, Path]
+
+
+def read_jsonl(path: PathLike) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    with Path(path).open("r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def iter_jsonl(path: PathLike) -> Iterator[Dict[str, Any]]:
+    with Path(path).open("r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def write_jsonl(path: PathLike, records: Iterable[Dict[str, Any]]) -> None:
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with p.open("w", encoding="utf-8") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, ensure_ascii=False) + "\n")
+
+
+def append_jsonl(path: PathLike, record: Dict[str, Any]) -> None:
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with p.open("a", encoding="utf-8") as fh:
+        fh.write(json.dumps(record, ensure_ascii=False) + "\n")

@@ -1,0 +1,117 @@
+"""Record loaders + dataset builders: local JSONL and HF-hub sources with
+the reference's preset schema (source/hf_path/hf_name/split/columns/
+template/limit — reference src/data/datasets.py:232-315, presets under
+config/data_sources/)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from dla_tpu.data.datasets import (
+    InstructionDataset,
+    PreferenceDataset,
+    TeacherRolloutDataset,
+)
+from dla_tpu.data.jsonl import read_jsonl
+from dla_tpu.data.tokenizers import Tokenizer
+
+
+def _hf_rows(cfg: Dict[str, Any], split: str):
+    from datasets import load_dataset  # local import: optional heavy dep
+    split_name = cfg.get(f"{split}_split") or cfg.get("split", split)
+    return load_dataset(cfg["hf_path"], cfg.get("hf_name"), split=split_name)
+
+
+def _apply_limit(records: List[Dict[str, Any]], limit) -> List[Dict[str, Any]]:
+    return records[: int(limit)] if limit else records
+
+
+def load_instruction_records(cfg: Dict[str, Any],
+                             split: str = "train") -> List[Dict[str, Any]]:
+    """{prompt, response} records from a local JSONL or an HF dataset with
+    column remapping and optional prompt template."""
+    if cfg.get("source", "local") == "hf":
+        cols = cfg.get("columns", {})
+        pk = cols.get("prompt", "prompt")
+        rk = cols.get("response", "response")
+        template = cfg.get("template")
+        records = []
+        for row in _hf_rows(cfg, split):
+            prompt = template.format(**row) if template else row[pk]
+            records.append({"prompt": prompt, "response": row[rk]})
+    else:
+        path = cfg.get(f"{split}_path")
+        if path is None and split == "train":
+            path = cfg.get("path")
+        if path is None:
+            # never silently fall back to the training file for eval
+            raise ValueError(f"No {split}_path in data config")
+        records = read_jsonl(path)
+    return _apply_limit(records, cfg.get("limit"))
+
+
+def load_preference_records(cfg: Dict[str, Any],
+                            split: str = "train") -> List[Dict[str, Any]]:
+    """{prompt, chosen, rejected} records; same source rules."""
+    if cfg.get("source", "local") == "hf":
+        cols = cfg.get("columns", {})
+        pk = cols.get("prompt", "prompt")
+        ck = cols.get("chosen", "chosen")
+        rk = cols.get("rejected", "rejected")
+        template = cfg.get("template")
+        records = []
+        for row in _hf_rows(cfg, split):
+            prompt = template.format(**row) if template else row[pk]
+            records.append(
+                {"prompt": prompt, "chosen": row[ck], "rejected": row[rk]})
+    else:
+        path = cfg.get(f"{split}_path")
+        if path is None and split == "train":
+            path = cfg.get("path") or cfg.get("preference_path")
+        if path is None:
+            raise ValueError(f"No {split}_path in data config")
+        records = read_jsonl(path)
+    return _apply_limit(records, cfg.get("limit"))
+
+
+def load_prompt_records(cfg: Dict[str, Any],
+                        split: str = "train") -> List[str]:
+    """Bare prompt strings for RLHF rollouts (reference train_rlhf.py:34-47:
+    HF source with prompt_key, else local JSONL with 'prompt')."""
+    if cfg.get("source", "local") == "hf":
+        pk = cfg.get("prompt_key", "prompt")
+        rows = _hf_rows(cfg, split)
+        prompts = [row[pk] for row in rows]
+    else:
+        path = cfg.get("prompt_path") or cfg.get("path")
+        if path is None:
+            raise ValueError("No prompt_path/path in sampling config")
+        prompts = [r["prompt"] for r in read_jsonl(path)]
+    return [p for p in _apply_limit(prompts, cfg.get("limit")) if p]
+
+
+def build_instruction_dataset(cfg: Dict[str, Any], tokenizer: Tokenizer,
+                              split: str = "train") -> InstructionDataset:
+    return InstructionDataset(
+        tokenizer=tokenizer,
+        max_length=int(cfg.get("max_length", cfg.get("max_seq_length", 2048))),
+        mask_prompt=bool(cfg.get("mask_prompt", True)),
+        records=load_instruction_records(cfg, split),
+    )
+
+
+def build_preference_dataset(cfg: Dict[str, Any], tokenizer: Tokenizer,
+                             split: str = "train") -> PreferenceDataset:
+    return PreferenceDataset(
+        tokenizer=tokenizer,
+        max_length=int(cfg.get("max_length", cfg.get("max_seq_length", 1024))),
+        records=load_preference_records(cfg, split),
+    )
+
+
+def build_teacher_dataset(cfg: Dict[str, Any], tokenizer: Tokenizer,
+                          ) -> TeacherRolloutDataset:
+    return TeacherRolloutDataset(
+        tokenizer=tokenizer,
+        max_length=int(cfg.get("max_length", cfg.get("max_seq_length", 2048))),
+        path=cfg.get("teacher_samples_path") or cfg.get("path"),
+    )

@@ -1,0 +1,90 @@
+"""Sequence packing: fill fixed-length rows with multiple examples.
+
+Implements for real the ``data.packing: true`` config key the reference
+declares but never wires (config/sft_config.yaml:16, SURVEY.md sec 2.5).
+Packed rows carry ``segment_ids``; the transformer masks cross-segment
+attention and restarts positions per segment
+(dla_tpu.models.transformer.Transformer.hidden_states), so packing is
+loss-equivalent to unpacked batching while filling the pad FLOPs that
+fixed-shape batching would otherwise waste.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from dla_tpu.data.datasets import IGNORE_INDEX
+
+
+class PackedInstructionDataset:
+    """Greedy first-fit packing of tokenized instruction examples into rows
+    of exactly ``max_length`` tokens. Presents the same dataset protocol
+    (__len__/__getitem__/collate) as InstructionDataset, so it is a drop-in
+    for the trainer's iterator."""
+
+    def __init__(self, base, max_length: int):
+        """``base``: an InstructionDataset (or anything yielding dicts with
+        input_ids/attention_mask/labels 1-D arrays)."""
+        self.max_length = max_length
+        self.pad_token_id = base.tokenizer.pad_token_id
+        self.rows: List[List[Dict[str, np.ndarray]]] = []
+        open_rows: List[int] = []   # indices into self.rows still open
+        lengths: List[int] = []
+        for i in range(len(base)):
+            ex = base[i]
+            n = int(ex["input_ids"].shape[0])
+            if n > max_length:
+                ex = {k: v[:max_length] for k, v in ex.items()}
+                n = max_length
+            placed = False
+            for open_i in open_rows:
+                if lengths[open_i] + n <= max_length:
+                    self.rows[open_i].append(ex)
+                    lengths[open_i] += n
+                    placed = True
+                    break
+            if not placed:
+                self.rows.append([ex])
+                lengths.append(n)
+                open_rows.append(len(self.rows) - 1)
+            # close rows that cannot take even a tiny example
+            open_rows = [r for r in open_rows if lengths[r] + 8 <= max_length]
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        segs = self.rows[idx]
+        L = self.max_length
+        input_ids = np.full(L, self.pad_token_id, np.int32)
+        labels = np.full(L, IGNORE_INDEX, np.int32)
+        attention_mask = np.zeros(L, np.int32)
+        segment_ids = np.zeros(L, np.int32)  # 0 = padding segment
+        pos = 0
+        for si, ex in enumerate(segs, start=1):
+            n = ex["input_ids"].shape[0]
+            input_ids[pos:pos + n] = ex["input_ids"]
+            labels[pos:pos + n] = ex["labels"]
+            # the next-token shift would otherwise train segment i's last
+            # token to predict segment i+1's first token
+            labels[pos] = IGNORE_INDEX
+            attention_mask[pos:pos + n] = 1
+            segment_ids[pos:pos + n] = si
+            pos += n
+        return {
+            "input_ids": input_ids,
+            "attention_mask": attention_mask,
+            "labels": labels,
+            "segment_ids": segment_ids,
+        }
+
+    def collate(self, batch: Sequence[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        return {k: np.stack([ex[k] for ex in batch]) for k in batch[0]}
+
+    def packing_efficiency(self) -> float:
+        """Fraction of token slots holding real tokens (1.0 = perfect)."""
+        total = len(self.rows) * self.max_length
+        used = sum(sum(int(e["input_ids"].shape[0]) for e in row)
+                   for row in self.rows)
+        return used / max(total, 1)

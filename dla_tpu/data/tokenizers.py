@@ -1,0 +1,93 @@
+"""Tokenizer layer.
+
+The reference uses HF ``AutoTokenizer`` everywhere (src/models/base_model.py:23-28,
+pad_token := eos). Here tokenization is host-side and pluggable:
+
+- ``HFTokenizer`` wraps a transformers tokenizer (local path or hub id)
+  when one is available.
+- ``ByteTokenizer`` is a dependency-free byte-level tokenizer used by
+  tests and smoke runs (zero-egress environments cannot fetch HF vocab
+  files).
+
+Both satisfy the small protocol the data layer needs: ``encode``,
+``decode``, ``pad_token_id``, ``eos_token_id``, ``vocab_size``.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    pad_token_id: int
+    eos_token_id: int
+    bos_token_id: Optional[int]
+    vocab_size: int
+
+    def encode(self, text: str, *, add_bos: bool = True,
+               add_eos: bool = False) -> List[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes shifted by 3; ids 0/1/2 = pad/bos/eos. vocab_size 259."""
+
+    def __init__(self) -> None:
+        self.pad_token_id = 0
+        self.bos_token_id = 1
+        self.eos_token_id = 2
+        self.vocab_size = 259
+
+    def encode(self, text: str, *, add_bos: bool = True,
+               add_eos: bool = False) -> List[int]:
+        ids = [b + 3 for b in text.encode("utf-8")]
+        if add_bos:
+            ids = [self.bos_token_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_token_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i - 3 for i in ids if i >= 3)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Adapter over ``transformers`` tokenizers; pad falls back to eos like
+    the reference (base_model.py:26-28)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer  # heavy import kept local
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        if self._tok.pad_token is None:
+            self._tok.pad_token = self._tok.eos_token
+        self.pad_token_id = int(self._tok.pad_token_id)
+        self.eos_token_id = int(self._tok.eos_token_id)
+        self.bos_token_id = (int(self._tok.bos_token_id)
+                             if self._tok.bos_token_id is not None else None)
+        self.vocab_size = int(len(self._tok))
+
+    def encode(self, text: str, *, add_bos: bool = True,
+               add_eos: bool = False) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=add_bos)
+        if add_eos:
+            ids = ids + [self.eos_token_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(name_or_path: str) -> Tokenizer:
+    """Resolve a tokenizer: 'byte' -> ByteTokenizer; otherwise HF (local
+    path or hub id). Falls back to ByteTokenizer with a warning when the HF
+    load fails (e.g. zero-egress machine and no local files)."""
+    if name_or_path in ("byte", "bytes", "test"):
+        return ByteTokenizer()
+    try:
+        return HFTokenizer(name_or_path)
+    except Exception as exc:  # noqa: BLE001 — any load failure gets the fallback
+        print(f"[dla_tpu] tokenizer '{name_or_path}' unavailable ({exc}); "
+              "falling back to ByteTokenizer", flush=True)
+        return ByteTokenizer()

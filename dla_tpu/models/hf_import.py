@@ -1,0 +1,122 @@
+"""Import HuggingFace Llama/Mistral-family weights from a local directory.
+
+Replaces the weight-loading half of the reference's
+``AutoModelForCausalLM.from_pretrained`` (src/models/base_model.py:30-35):
+reads ``config.json`` + ``*.safetensors`` (or ``pytorch_model.bin``) and
+produces this framework's stacked-layer param pytree:
+
+  HF [out, in] Linear weights are transposed to [in, out] (we compute
+  ``x @ w``), and per-layer tensors are stacked along a leading [L] dim to
+  match the scan-over-layers layout (dla_tpu.models.transformer).
+
+Zero-egress: only local files are read; there is no hub download here.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dla_tpu.models.config import ModelConfig
+
+
+def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfig:
+    """Map a Llama/Mistral-style HF config.json to ModelConfig."""
+    n_heads = int(hf_cfg["num_attention_heads"])
+    fields = dict(
+        vocab_size=int(hf_cfg["vocab_size"]),
+        hidden_size=int(hf_cfg["hidden_size"]),
+        intermediate_size=int(hf_cfg["intermediate_size"]),
+        num_layers=int(hf_cfg["num_hidden_layers"]),
+        num_heads=n_heads,
+        num_kv_heads=int(hf_cfg.get("num_key_value_heads", n_heads)),
+        head_dim=hf_cfg.get("head_dim"),
+        rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+        rms_norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", False)),
+        max_seq_length=int(hf_cfg.get("max_position_embeddings", 4096)),
+    )
+    fields.update(overrides)
+    return ModelConfig(**fields)
+
+
+def read_hf_config(model_dir) -> Optional[Dict[str, Any]]:
+    p = Path(model_dir) / "config.json"
+    if not p.is_file():
+        return None
+    with p.open() as fh:
+        return json.load(fh)
+
+
+def _load_state_dict(model_dir: Path) -> Dict[str, np.ndarray]:
+    """All tensors from safetensors shards (preferred) or a torch bin."""
+    st_files = sorted(model_dir.glob("*.safetensors"))
+    if st_files:
+        from safetensors import safe_open
+        out: Dict[str, np.ndarray] = {}
+        for f in st_files:
+            with safe_open(str(f), framework="np") as sf:
+                for key in sf.keys():
+                    out[key] = sf.get_tensor(key)
+        return out
+    bin_files = sorted(model_dir.glob("pytorch_model*.bin"))
+    if bin_files:
+        import torch
+        out = {}
+        for f in bin_files:
+            sd = torch.load(str(f), map_location="cpu", weights_only=True)
+            for k, v in sd.items():
+                out[k] = v.float().numpy() if v.dtype == torch.bfloat16 \
+                    else v.numpy()
+        return out
+    raise FileNotFoundError(
+        f"No *.safetensors or pytorch_model*.bin under {model_dir}")
+
+
+def import_hf_weights(model_dir, cfg: ModelConfig,
+                      dtype: Optional[str] = None) -> Dict[str, Any]:
+    """Local HF checkpoint dir -> dla_tpu param pytree (host numpy)."""
+    model_dir = Path(model_dir)
+    sd = _load_state_dict(model_dir)
+    pdtype = np.dtype(dtype or cfg.param_dtype)
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def take(name: str) -> np.ndarray:
+        key = pre + name
+        if key not in sd:
+            raise KeyError(f"HF checkpoint missing tensor '{key}'")
+        return np.asarray(sd[key])
+
+    def linear(name: str) -> np.ndarray:
+        return take(name).T.astype(pdtype)  # [out,in] -> [in,out]
+
+    L = cfg.num_layers
+    stacked: Dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo",
+        "mlp_norm", "w_gate", "w_up", "w_down")}
+    for i in range(L):
+        p = f"layers.{i}."
+        stacked["attn_norm"].append(take(p + "input_layernorm.weight").astype(pdtype))
+        stacked["wq"].append(linear(p + "self_attn.q_proj.weight"))
+        stacked["wk"].append(linear(p + "self_attn.k_proj.weight"))
+        stacked["wv"].append(linear(p + "self_attn.v_proj.weight"))
+        stacked["wo"].append(linear(p + "self_attn.o_proj.weight"))
+        stacked["mlp_norm"].append(
+            take(p + "post_attention_layernorm.weight").astype(pdtype))
+        stacked["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
+        stacked["w_up"].append(linear(p + "mlp.up_proj.weight"))
+        stacked["w_down"].append(linear(p + "mlp.down_proj.weight"))
+
+    params: Dict[str, Any] = {
+        "embed": {"embedding": take("embed_tokens.weight").astype(pdtype)},
+        "layers": {k: np.stack(v) for k, v in stacked.items()},
+        "final_norm": take("norm.weight").astype(pdtype),
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = np.asarray(sd["lm_head.weight"]).T.astype(pdtype)
+        else:
+            params["lm_head"] = params["embed"]["embedding"].T.copy()
+    return params

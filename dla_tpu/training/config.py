@@ -1,0 +1,117 @@
+"""Config system: the reference's YAML block shapes, plus the pieces it
+lacks (SURVEY.md sec 5 config row): overlay merging for the ablation
+fragments (reference README says "merge manually", config/ablations/),
+dotted CLI overrides, and validation warnings — while tolerating GPU-era
+keys (hardware.deepspeed_config / fsdp / mixed_precision / num_processes)
+so reference configs keep launching runs.
+
+Block shapes kept verbatim: experiment_name / seed / model / data /
+optimization / logging / hardware (/ ppo / reward_model / sampling /
+distill / benchmarks / latency / generation).
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+import yaml
+
+GPU_ERA_HARDWARE_KEYS = {
+    "deepspeed_config": "parameter sharding comes from hardware.mesh.fsdp",
+    "fsdp": "parameter sharding comes from hardware.mesh.fsdp",
+    "mixed_precision": "bf16 activations are the default on TPU",
+    "num_processes": "host count comes from jax.process_count()",
+}
+
+
+def load_yaml(path) -> Dict[str, Any]:
+    with Path(path).open("r", encoding="utf-8") as fh:
+        out = yaml.safe_load(fh)
+    return out or {}
+
+
+def deep_merge(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive dict merge; overlay wins; lists replace wholesale."""
+    out = copy.deepcopy(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def set_dotted(cfg: Dict[str, Any], dotted: str, value: Any) -> None:
+    keys = dotted.split(".")
+    node = cfg
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+        if not isinstance(node, dict):
+            raise ValueError(f"Cannot set '{dotted}': '{k}' is not a mapping")
+    node[keys[-1]] = value
+
+
+def get_dotted(cfg: Dict[str, Any], dotted: str, default: Any = None) -> Any:
+    node: Any = cfg
+    for k in dotted.split("."):
+        if not isinstance(node, dict) or k not in node:
+            return default
+        node = node[k]
+    return node
+
+
+def apply_overrides(cfg: Dict[str, Any], overrides: Sequence[str]) -> Dict[str, Any]:
+    """``a.b.c=value`` overrides; values parsed as YAML (so 1e-5, true, [1,2])."""
+    out = copy.deepcopy(cfg)
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"Override '{ov}' is not of the form key=value")
+        key, raw = ov.split("=", 1)
+        set_dotted(out, key.strip(), yaml.safe_load(raw))
+    return out
+
+
+def warn_legacy_keys(cfg: Dict[str, Any]) -> List[str]:
+    warnings = []
+    hw = cfg.get("hardware", {}) or {}
+    for key, why in GPU_ERA_HARDWARE_KEYS.items():
+        if key in hw:
+            warnings.append(
+                f"hardware.{key} is a GPU-era key and is ignored on TPU ({why})")
+    if cfg.get("backend") == "accelerate":
+        warnings.append("backend: accelerate is ignored (TPU-native runtime)")
+    return warnings
+
+
+def load_config(path, overlays: Sequence[str] = (),
+                overrides: Sequence[str] = (), quiet: bool = False
+                ) -> Dict[str, Any]:
+    cfg = load_yaml(path)
+    for ov_path in overlays:
+        cfg = deep_merge(cfg, load_yaml(ov_path))
+    cfg = apply_overrides(cfg, overrides)
+    if not quiet:
+        for w in warn_legacy_keys(cfg):
+            print(f"[dla_tpu][config] {w}", flush=True)
+    return cfg
+
+
+def make_arg_parser(description: str) -> argparse.ArgumentParser:
+    """The shared CLI shape: ``train_X --config cfg.yaml [--overlay o.yaml]
+    [--set key=value] [--resume]`` — superset of the reference's single
+    --config flag (train_sft.py:27-30)."""
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--config", required=True, help="YAML config path")
+    p.add_argument("--overlay", action="append", default=[],
+                   help="overlay YAML fragment(s), e.g. config/ablations/low_lr.yaml")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="KEY=VALUE", help="dotted config override")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in logging.output_dir")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Dict[str, Any]:
+    return load_config(args.config, args.overlay, args.overrides)

@@ -1,0 +1,160 @@
+"""Model resolution: config name / HF id / checkpoint path -> ModelBundle.
+
+TPU-native counterpart of the reference's loaders
+(src/models/base_model.py:17-42 ``load_causal_lm`` and
+src/models/reward_model.py:20-35 ``build_reward_model``): the same config
+keys (``model_name_or_path`` etc.) accept
+
+1. a dla_tpu checkpoint directory (or its ``latest`` pointer) — the chain
+   the reference uses between phases (checkpoints/sft/latest -> DPO, ...);
+2. a registry preset / HF repo id (dla_tpu.models.config) — fresh init, or
+   HF safetensors import when local weight files exist
+   (dla_tpu.models.hf_import).
+"""
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+
+from dla_tpu.checkpoint.checkpointer import (
+    is_checkpoint_path,
+    load_tree_numpy,
+)
+from dla_tpu.data.tokenizers import ByteTokenizer, Tokenizer, load_tokenizer
+from dla_tpu.models.config import ModelConfig, get_model_config
+from dla_tpu.models.reward import RewardModel
+from dla_tpu.models.transformer import Transformer
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    """(reference base_model.py:11-14 ModelBundle carried tokenizer+model)"""
+    model: Any                 # Transformer | RewardModel
+    params: Any
+    specs: Any
+    tokenizer: Tokenizer
+    config: ModelConfig
+
+
+def _tokenizer_for(name_or_path: str, model_cfg: Dict[str, Any],
+                   aux: Optional[Dict] = None) -> Tokenizer:
+    tok_name = model_cfg.get("tokenizer")
+    if tok_name:
+        return load_tokenizer(tok_name)
+    if aux and aux.get("tokenizer"):
+        return load_tokenizer(aux["tokenizer"])
+    if is_checkpoint_path(name_or_path):
+        return ByteTokenizer()
+    return load_tokenizer(name_or_path)
+
+
+def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """Config keys that override preset architecture fields."""
+    out: Dict[str, Any] = {}
+    if "max_seq_length" in model_cfg:
+        out["max_seq_length"] = int(model_cfg["max_seq_length"])
+    if model_cfg.get("gradient_checkpointing") is False:
+        out["remat"] = "none"
+    elif model_cfg.get("gradient_checkpointing") is True:
+        out["remat"] = "full"
+    for key in ("dtype", "param_dtype", "remat", "vocab_size"):
+        if key in model_cfg:
+            out[key] = model_cfg[key]
+    return out
+
+
+def load_causal_lm(name_or_path: str, model_cfg: Dict[str, Any],
+                   rng: jax.Array) -> ModelBundle:
+    """Resolve a causal LM (policy/teacher/student):
+    dla_tpu checkpoint > local HF weight dir > registry preset."""
+    overrides = _arch_overrides(model_cfg)
+    if is_checkpoint_path(name_or_path):
+        params, aux = load_tree_numpy(name_or_path, prefix="params")
+        mc = aux.get("model_config")
+        if mc is None:
+            raise ValueError(
+                f"checkpoint {name_or_path} lacks model_config aux; "
+                "cannot rebuild the architecture")
+        cfg = ModelConfig.from_dict({**mc, **overrides})
+        model = Transformer(cfg)
+        tok = _tokenizer_for(name_or_path, model_cfg, aux)
+        return ModelBundle(model, params, model.partition_specs(), tok, cfg)
+
+    hf = _try_hf_dir(name_or_path, overrides)
+    if hf is not None:
+        cfg, params = hf
+        model = Transformer(cfg)
+        tok = _tokenizer_for(name_or_path, model_cfg)
+        return ModelBundle(model, params, model.partition_specs(), tok, cfg)
+
+    cfg = get_model_config(name_or_path, **overrides)
+    model = Transformer(cfg)
+    tok = _tokenizer_for(name_or_path, model_cfg)
+    if getattr(tok, "vocab_size", cfg.vocab_size) > cfg.vocab_size:
+        cfg = dataclasses.replace(cfg, vocab_size=int(tok.vocab_size))
+        model = Transformer(cfg)
+    params = model.init(rng)
+    return ModelBundle(model, params, model.partition_specs(), tok, cfg)
+
+
+def build_reward_model(model_cfg: Dict[str, Any], rng: jax.Array) -> ModelBundle:
+    """Reward model from ``model.base_model_name_or_path`` + pooling/dropout
+    (reference reward_model.py:20-35, config/reward_config.yaml)."""
+    name = (model_cfg.get("base_model_name_or_path")
+            or model_cfg.get("model_name_or_path"))
+    pooling = model_cfg.get("pooling", "last_token")
+    dropout = float(model_cfg.get("dropout", 0.0))
+    overrides = _arch_overrides(model_cfg)
+    if is_checkpoint_path(name):
+        params, aux = load_tree_numpy(name, prefix="params")
+        mc = aux.get("model_config")
+        if mc is None:
+            raise ValueError(f"checkpoint {name} lacks model_config aux")
+        cfg = ModelConfig.from_dict({**mc, **overrides})
+        rm = RewardModel(cfg, pooling=pooling, dropout=dropout)
+        if "reward_head" not in params:
+            # warm-starting a reward model from a causal-LM checkpoint:
+            # fresh head, drop the unembedding
+            params.pop("lm_head", None)
+            fresh = rm.init(rng)
+            params["reward_head"] = fresh["reward_head"]
+        tok = _tokenizer_for(name, model_cfg, aux)
+        return ModelBundle(rm, params, rm.partition_specs(), tok, cfg)
+
+    cfg = get_model_config(name, **overrides)
+    tok = _tokenizer_for(name, model_cfg)
+    if getattr(tok, "vocab_size", cfg.vocab_size) > cfg.vocab_size:
+        cfg = dataclasses.replace(cfg, vocab_size=int(tok.vocab_size))
+    rm = RewardModel(cfg, pooling=pooling, dropout=dropout)
+    params = rm.init(rng)
+    return ModelBundle(rm, params, rm.partition_specs(), tok, cfg)
+
+
+def _try_hf_dir(name_or_path: str, overrides: Dict[str, Any]):
+    """(ModelConfig, params) from a local HF weight directory, else None."""
+    p = Path(name_or_path)
+    if not p.is_dir():
+        return None
+    from dla_tpu.models.hf_import import (
+        hf_config_to_model_config,
+        import_hf_weights,
+        read_hf_config,
+    )
+    hf_cfg = read_hf_config(p)
+    if hf_cfg is None:
+        return None
+    cfg = hf_config_to_model_config(hf_cfg, **{
+        k: v for k, v in overrides.items() if k != "vocab_size"})
+    return cfg, import_hf_weights(p, cfg)
+
+
+def model_aux(bundle: ModelBundle, tokenizer_name: Optional[str] = None
+              ) -> Dict[str, Any]:
+    """aux dict to store with checkpoints so they are self-describing."""
+    out: Dict[str, Any] = {"model_config": bundle.config.to_dict()}
+    if tokenizer_name:
+        out["tokenizer"] = tokenizer_name
+    return out

@@ -1,0 +1,59 @@
+"""Optimizer + LR schedule factory.
+
+Reference behavior reproduced: AdamW betas (0.9, 0.95) (train_sft.py:89-94),
+global-norm clipping at optimization.max_grad_norm (utils.py:121-123),
+cosine schedule with warmup via optimization.lr_scheduler/warmup_steps
+(train_sft.py:105-110). Unlike the reference — where only SFT got a
+scheduler (SURVEY.md sec 2.1) — every trainer here goes through this factory.
+
+Gradients and Adam moments live in fp32; the optimizer state inherits the
+parameter sharding, which is the ZeRO-style "partitioned optimizer state"
+for free.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import optax
+
+
+def build_schedule(opt_cfg: Dict[str, Any]) -> Callable[[int], float]:
+    lr = float(opt_cfg.get("learning_rate", 1e-5))
+    warmup = int(opt_cfg.get("warmup_steps", 0))
+    total = int(opt_cfg.get("max_train_steps", 10000))
+    kind = str(opt_cfg.get("lr_scheduler", "cosine")).lower()
+    if kind in ("cosine", "cosine_with_warmup"):
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr,
+            warmup_steps=max(warmup, 1),
+            decay_steps=max(total, warmup + 1),
+            end_value=0.0)
+    if kind in ("linear",):
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, max(warmup, 1)),
+             optax.linear_schedule(lr, 0.0, max(total - warmup, 1))],
+            [max(warmup, 1)])
+    if kind in ("constant", "constant_with_warmup", "none"):
+        if warmup:
+            return optax.join_schedules(
+                [optax.linear_schedule(0.0, lr, warmup),
+                 optax.constant_schedule(lr)], [warmup])
+        return optax.constant_schedule(lr)
+    raise ValueError(f"Unknown lr_scheduler '{kind}'")
+
+
+def build_optimizer(opt_cfg: Dict[str, Any]
+                    ) -> Tuple[optax.GradientTransformation, Callable[[int], float]]:
+    schedule = build_schedule(opt_cfg)
+    max_norm = float(opt_cfg.get("max_grad_norm", 0.0) or 0.0)
+    chain = []
+    if max_norm > 0:
+        chain.append(optax.clip_by_global_norm(max_norm))
+    chain.append(optax.adamw(
+        learning_rate=schedule,
+        b1=float(opt_cfg.get("adam_beta1", 0.9)),
+        b2=float(opt_cfg.get("adam_beta2", 0.95)),
+        eps=float(opt_cfg.get("adam_eps", 1e-8)),
+        weight_decay=float(opt_cfg.get("weight_decay", 0.0)),
+    ))
+    return optax.chain(*chain), schedule

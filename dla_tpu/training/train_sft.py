@@ -1,0 +1,105 @@
+"""Supervised fine-tuning (phase 1).
+
+CLI parity with the reference (src/training/train_sft.py):
+``python -m dla_tpu.training.train_sft --config config/sft_config.yaml``.
+Behavior parity: next-token CE on "{prompt}\n\n{response}{eos}" with
+prompt-masked labels, AdamW betas (0.9, 0.95), warmup+cosine schedule,
+periodic eval (mean loss over eval split), periodic + final checkpointing.
+
+TPU-native differences: one jitted SPMD step with in-step grad
+accumulation on a (data, fsdp, model, sequence) mesh; optional sequence
+packing actually implemented (``data.packing: true``,
+config/sft_config.yaml:16 was a dead key in the reference); resume via
+``--resume``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+from dla_tpu.data.loaders import build_instruction_dataset
+from dla_tpu.data.iterator import ShardedBatchIterator
+from dla_tpu.data.packing import PackedInstructionDataset
+from dla_tpu.ops.losses import cross_entropy_loss
+from dla_tpu.parallel.dist import initialize_distributed
+from dla_tpu.parallel.mesh import mesh_from_config
+from dla_tpu.training.config import config_from_args, make_arg_parser
+from dla_tpu.training.model_io import load_causal_lm, model_aux
+from dla_tpu.training.trainer import Trainer
+from dla_tpu.training.utils import seed_everything
+from dla_tpu.utils.logging import log_rank_zero
+
+
+def make_sft_loss(model):
+    def loss_fn(params, frozen, batch, rng):
+        del frozen, rng
+        logits = model.apply(
+            params, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            segment_ids=batch.get("segment_ids"))
+        loss, n_tokens = cross_entropy_loss(logits, batch["labels"])
+        return loss, {"ce": loss, "tokens": n_tokens}
+    return loss_fn
+
+
+def build_trainer(config: Dict[str, Any], mesh, rng) -> tuple:
+    model_cfg = config.get("model", {})
+    bundle = load_causal_lm(
+        model_cfg.get("model_name_or_path", "tiny"), model_cfg, rng)
+    trainer = Trainer(
+        config=config, mesh=mesh,
+        loss_fn=make_sft_loss(bundle.model),
+        params=bundle.params, param_specs=bundle.specs)
+    return trainer, bundle
+
+
+def main(argv=None) -> None:
+    args = make_arg_parser("dla_tpu SFT trainer").parse_args(argv)
+    config = config_from_args(args)
+    initialize_distributed(config.get("hardware"))
+    mesh = mesh_from_config(config.get("hardware"))
+    rng = seed_everything(int(config.get("seed", 0)))
+
+    with jax.sharding.set_mesh(mesh):
+        trainer, bundle = build_trainer(config, mesh, rng)
+        data_cfg = {**config.get("data", {}),
+                    "max_seq_length": bundle.config.max_seq_length,
+                    **{k: v for k, v in config.get("model", {}).items()
+                       if k == "max_seq_length"}}
+        train_ds = build_instruction_dataset(data_cfg, bundle.tokenizer, "train")
+        if data_cfg.get("packing"):
+            train_ds = PackedInstructionDataset(
+                train_ds, int(data_cfg.get("max_seq_length", 2048)))
+            log_rank_zero(
+                f"[dla_tpu] packing: {len(train_ds)} rows, "
+                f"{train_ds.packing_efficiency():.1%} token efficiency")
+        train_it = ShardedBatchIterator(
+            train_ds, trainer.global_batch,
+            seed=int(config.get("seed", 0)),
+            process_index=jax.process_index(),
+            process_count=jax.process_count())
+
+        eval_iter_fn = None
+        has_eval = (data_cfg.get("eval_path") if
+                    data_cfg.get("source", "local") == "local"
+                    else data_cfg.get("eval_split") or data_cfg.get("split"))
+        if has_eval:
+            eval_ds = build_instruction_dataset(data_cfg, bundle.tokenizer, "eval")
+            micro_global = trainer.micro * trainer.dp
+
+            def eval_iter_fn():
+                return iter(ShardedBatchIterator(
+                    eval_ds, micro_global, shuffle=False,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count()))
+
+        trainer.fit(
+            train_it, rng=rng, eval_iter_fn=eval_iter_fn,
+            data_state=train_it.state_dict, resume=args.resume,
+            extra_aux=model_aux(
+                bundle, config.get("model", {}).get("tokenizer")))
+
+
+if __name__ == "__main__":
+    main()

