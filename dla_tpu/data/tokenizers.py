@@ -49,7 +49,7 @@ class ByteTokenizer:
         return ids
 
     def decode(self, ids: Sequence[int]) -> str:
-        data = bytes(i - 3 for i in ids if i >= 3)
+        data = bytes(i - 3 for i in ids if 3 <= i <= 258)
         return data.decode("utf-8", errors="replace")
 
 

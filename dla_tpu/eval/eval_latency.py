@@ -1,0 +1,144 @@
+"""Latency / throughput harness (phase 5b) — the framework's measurement
+tool and the source of BASELINE numbers.
+
+CLI parity: ``python -m dla_tpu.eval.eval_latency --config
+config/eval_config.yaml`` (reference src/eval/eval_latency.py). Artifact
+parity: ``latency.json`` maps model -> list of {batch_size, seq_length,
+tokens_per_second, latency_ms} rows over the configured grid with
+warmup + synchronized timing (reference measure_model, :22-63).
+
+Extensions the reference lacks (SURVEY.md sec 6): each row also reports
+``tokens_per_second_per_chip``, and a ``decode`` section measures true
+autoregressive decode throughput (the reference measured only forward
+passes despite its docstring, eval_latency.py:1).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
+from dla_tpu.training.config import load_config
+from dla_tpu.training.model_io import load_causal_lm
+from dla_tpu.training.utils import seed_everything
+from dla_tpu.utils.logging import log_rank_zero
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dla_tpu latency benchmark")
+    p.add_argument("--config", required=True)
+    return p.parse_args(argv)
+
+
+def measure_forward(model, params, batch_sizes: List[int],
+                    seq_lengths: List[int], warmup: int, steps: int
+                    ) -> List[Dict[str, float]]:
+    fwd = jax.jit(lambda p, ids, mask: model.apply(
+        p, ids, attention_mask=mask))
+    rows: List[Dict[str, float]] = []
+    n_chips = jax.device_count()
+    rs = np.random.RandomState(0)
+    for b in batch_sizes:
+        for s in seq_lengths:
+            ids = jnp.asarray(
+                rs.randint(0, model.cfg.vocab_size - 1, (b, s)), jnp.int32)
+            mask = jnp.ones((b, s), jnp.int32)
+            for _ in range(warmup):
+                fwd(params, ids, mask).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fwd(params, ids, mask)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
+            tokens = b * s * steps
+            rows.append({
+                "batch_size": b,
+                "seq_length": s,
+                "tokens_per_second": tokens / dt,
+                "tokens_per_second_per_chip": tokens / dt / n_chips,
+                "latency_ms": dt / steps * 1000,
+            })
+            log_rank_zero(f"[dla_tpu][latency] b={b} s={s}: "
+                          f"{rows[-1]['tokens_per_second']:.0f} tok/s "
+                          f"{rows[-1]['latency_ms']:.2f} ms/step")
+    return rows
+
+
+def measure_decode(model, params, batch_size: int, prompt_len: int,
+                   new_tokens: int, warmup: int = 1, reps: int = 3
+                   ) -> Dict[str, float]:
+    """True autoregressive decode throughput through the KV-cache engine."""
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=True,
+                           temperature=1.0, eos_token_id=-1)  # never stop
+    fn = jax.jit(build_generate_fn(model, gen))
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(
+        rs.randint(3, model.cfg.vocab_size - 1, (batch_size, prompt_len)),
+        jnp.int32)
+    mask = jnp.ones((batch_size, prompt_len), jnp.int32)
+    for _ in range(warmup):
+        jax.tree.map(lambda x: x.block_until_ready(),
+                     fn(params, ids, mask, jax.random.key(0)))
+    t0 = time.perf_counter()
+    for r in range(reps):
+        out = fn(params, ids, mask, jax.random.key(r))
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    dt = time.perf_counter() - t0
+    total_new = batch_size * new_tokens * reps
+    return {
+        "batch_size": batch_size,
+        "prompt_length": prompt_len,
+        "new_tokens": new_tokens,
+        "decode_tokens_per_second": total_new / dt,
+        "decode_tokens_per_second_per_chip": total_new / dt / jax.device_count(),
+        "ms_per_token": dt / (new_tokens * reps) * 1000,
+    }
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    config = load_config(args.config)
+    rng = seed_everything(int(config.get("seed", 0)))
+    lat = config["latency"]
+    model_extra = dict(config.get("model", {}))
+
+    results: Dict[str, object] = {"hardware": lat.get("hardware", "tpu")}
+    for model_name, model_path in config["models"].items():
+        log_rank_zero(f"[dla_tpu][latency] loading {model_name}: {model_path}")
+        bundle = load_causal_lm(str(model_path), model_extra, rng)
+        entry: Dict[str, object] = {}
+        entry["forward"] = measure_forward(
+            bundle.model, bundle.params,
+            [int(b) for b in lat.get("batch_sizes", [1, 4, 8])],
+            [int(s) for s in lat.get("seq_lengths", [256, 512, 1024])],
+            int(lat.get("warmup_steps", 3)),
+            int(lat.get("measure_steps", 10)))
+        dec = lat.get("decode", {})
+        if dec.get("enabled", True):
+            entry["decode"] = measure_decode(
+                bundle.model, bundle.params,
+                int(dec.get("batch_size", 8)),
+                int(dec.get("prompt_length", 128)),
+                int(dec.get("new_tokens", 64)))
+            log_rank_zero(f"[dla_tpu][latency] decode: "
+                          f"{entry['decode']['decode_tokens_per_second']:.0f}"
+                          " tok/s")
+        results[model_name] = entry
+
+    out_path = Path(config.get("logging", {})
+                    .get("output_path", "logs/eval/results.json"))
+    out_path = out_path.with_name("latency.json")
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(json.dumps(results, indent=2))
+    log_rank_zero(f"[dla_tpu][latency] wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

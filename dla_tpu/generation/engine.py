@@ -1,0 +1,150 @@
+"""Jitted autoregressive generation: prefill + fixed-length scan decode
+over a preallocated KV cache, with in-graph temperature/top-p/top-k
+sampling.
+
+This is the TPU-native replacement for HF ``model.generate`` in all three
+reference call sites: PPO rollouts (train_rlhf.py:123-124), teacher
+sampling (generate_teacher_data.py:72-79), and evaluation
+(eval_alignment.py:71-77). The whole rollout stays on device: no decode to
+strings, no re-tokenization round-trip (the reference's host bounce,
+SURVEY.md sec 3.3).
+
+Design: prompts arrive right-padded to a static width P; decode runs a
+``lax.scan`` of exactly ``max_new_tokens`` steps (static shapes; finished
+rows keep writing pad). Per-row true positions are tracked so rotary
+phases match contiguous sequences; ``left_align`` compacts
+[prompt pad gap response] rows into contiguous right-padded sequences for
+downstream in-graph consumers (logprob, reward scoring).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dla_tpu.models.transformer import Transformer
+from dla_tpu.ops.sampling import sample_token
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Mirrors the reference's generation_params / sampling blocks
+    (config/rlhf_config.yaml:19-22, config/eval_config.yaml generation)."""
+    max_new_tokens: int = 128
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    do_sample: bool = True
+    eos_token_id: int = 2
+    pad_token_id: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], **defaults) -> "GenerationConfig":
+        d = dict(d or {})
+        fields = {f.name for f in dataclasses.fields(cls)}
+        merged = {**defaults, **{k: v for k, v in d.items() if k in fields}}
+        return cls(**merged)
+
+
+def left_align(ids: jnp.ndarray, mask: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compact masked-out gaps: real tokens slide left, pads to the right.
+    Stable order among real tokens is preserved."""
+    order = jnp.argsort(~mask.astype(bool), axis=1, stable=True)
+    return (jnp.take_along_axis(ids, order, axis=1),
+            jnp.take_along_axis(mask, order, axis=1))
+
+
+def encode_prompt_batch(tokenizer, prompts, width: int):
+    """Host-side prompt encoding to fixed-width right-padded arrays —
+    the single implementation shared by the engine, the RLHF rollout loop,
+    and the teacher-gen/eval chunk paths."""
+    import numpy as np
+    ids = np.full((len(prompts), width), tokenizer.pad_token_id, np.int32)
+    mask = np.zeros((len(prompts), width), np.int32)
+    for i, p in enumerate(prompts):
+        enc = tokenizer.encode(p)[:width]
+        ids[i, :len(enc)] = enc
+        mask[i, :len(enc)] = 1
+    return ids, mask
+
+
+def build_generate_fn(model: Transformer, gen: GenerationConfig):
+    """Returns a jittable ``fn(params, input_ids, attention_mask, rng)`` ->
+    dict of device arrays:
+
+      sequences/sequence_mask  [B, P+N]  prompt + response, left-aligned
+      response_tokens/response_mask [B, N]
+      lengths [B] total real tokens (prompt + generated, incl. eos)
+    """
+    def generate(params, input_ids, attention_mask, rng):
+        b, p_width = input_ids.shape
+        n = gen.max_new_tokens
+        logits, cache = model.start_decode(
+            params, input_ids, attention_mask, n)
+
+        def body(carry, step_rng):
+            logits, cache, done = carry
+            tok = sample_token(
+                step_rng, logits,
+                temperature=gen.temperature, top_p=gen.top_p,
+                top_k=gen.top_k, do_sample=gen.do_sample)
+            tok = jnp.where(done, gen.pad_token_id, tok)
+            emit_mask = ~done
+            done = done | (tok == gen.eos_token_id)
+            logits, cache = model.decode_step(params, cache, tok)
+            return (logits, cache, done), (tok, emit_mask)
+
+        rngs = jax.random.split(rng, n)
+        done0 = jnp.zeros((b,), bool)
+        (_, _, _), (toks, emits) = jax.lax.scan(
+            body, (logits, cache, done0), rngs)
+        response_tokens = toks.T                      # [B, N]
+        response_mask = emits.T.astype(jnp.int32)     # [B, N]
+
+        raw_ids = jnp.concatenate([input_ids, response_tokens], axis=1)
+        raw_mask = jnp.concatenate(
+            [attention_mask.astype(jnp.int32), response_mask], axis=1)
+        sequences, sequence_mask = left_align(raw_ids, raw_mask)
+        return {
+            "sequences": sequences,
+            "sequence_mask": sequence_mask,
+            "response_tokens": response_tokens,
+            "response_mask": response_mask,
+            "lengths": jnp.sum(raw_mask, axis=1),
+        }
+
+    return generate
+
+
+class GenerationEngine:
+    """Convenience wrapper that jits per (batch, prompt_width) shape and
+    tokenizes/detokenizes at the host boundary."""
+
+    def __init__(self, model: Transformer, tokenizer, gen: GenerationConfig):
+        self.model = model
+        self.tokenizer = tokenizer
+        self.gen = dataclasses.replace(
+            gen,
+            eos_token_id=tokenizer.eos_token_id,
+            pad_token_id=tokenizer.pad_token_id)
+        self._fn = jax.jit(build_generate_fn(model, self.gen))
+
+    def encode_prompts(self, prompts, max_prompt_len: int):
+        return encode_prompt_batch(self.tokenizer, prompts, max_prompt_len)
+
+    def generate_text(self, params, prompts, max_prompt_len: int,
+                      rng) -> Tuple[list, Dict[str, Any]]:
+        import numpy as np
+        ids, mask = self.encode_prompts(prompts, max_prompt_len)
+        out = self._fn(params, jnp.asarray(ids), jnp.asarray(mask), rng)
+        texts = []
+        resp = np.asarray(out["response_tokens"])
+        rmask = np.asarray(out["response_mask"])
+        for i in range(len(prompts)):
+            toks = [int(t) for t, m in zip(resp[i], rmask[i])
+                    if m and t != self.tokenizer.eos_token_id]
+            texts.append(self.tokenizer.decode(toks))
+        return texts, out

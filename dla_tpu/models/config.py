@@ -29,6 +29,10 @@ class ModelConfig:
     param_dtype: str = "float32"        # master param dtype
     # remat: "none" | "full" | "dots"  (jax.checkpoint policy per block)
     remat: str = "full"
+    # attention backend: "xla" (fused einsum) | "flash" (pallas kernel,
+    # used on the full-sequence path when shapes allow; decode/packed
+    # paths always use xla)
+    attention: str = "xla"
 
     @property
     def head_dim_(self) -> int:

@@ -40,6 +40,11 @@ Params = Dict[str, Any]
 ACT_SPEC = P(("data", "fsdp"), "sequence", None)
 
 
+def _flash_tileable(t: int) -> bool:
+    """The flash kernel tiles T into blocks of min(128, T)."""
+    return t % min(128, t) == 0
+
+
 def _constrain(x, spec):
     try:
         return jax.lax.with_sharding_constraint(x, spec)
@@ -125,6 +130,7 @@ class Transformer:
                q_positions: jnp.ndarray,
                kv_positions: jnp.ndarray,
                kv_override: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+               allow_flash: bool = False,
                ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
         """One decoder block. Returns (output, (k, v)) — k/v before override,
         for cache writes."""
@@ -146,10 +152,8 @@ class Transformer:
         new_kv = (k, v)
         if kv_override is not None:
             k, v = kv_override
-        attn = causal_attention(
-            q, k, v,
-            kv_segment_mask=kv_segment_mask,
-            q_positions=q_positions, kv_positions=kv_positions)
+        attn = self._attention(q, k, v, kv_segment_mask,
+                               q_positions, kv_positions, allow_flash)
         attn = attn.reshape(b, t, cfg.num_heads * dh)
         x = x + _constrain(attn @ cast(layer["wo"]), ACT_SPEC)
 
@@ -159,6 +163,21 @@ class Transformer:
         ff = _constrain(gate * up, P(("data", "fsdp"), "sequence", "model"))
         x = x + _constrain(ff @ cast(layer["w_down"]), ACT_SPEC)
         return x, new_kv
+
+    def _attention(self, q, k, v, kv_segment_mask, q_positions, kv_positions,
+                   allow_flash: bool = False):
+        """Pick the attention backend. The pallas flash kernel handles the
+        full-sequence causal path on contiguous right-padded batches whose
+        length tiles its blocks; everything else (decode against a cache,
+        packed segments, odd lengths) takes the XLA path."""
+        t, s = q.shape[1], k.shape[1]
+        if (self.cfg.attention == "flash" and allow_flash and t == s
+                and _flash_tileable(t)):
+            from dla_tpu.ops.flash_attention import flash_causal_attention
+            return flash_causal_attention(q, k, v)
+        return causal_attention(
+            q, k, v, kv_segment_mask=kv_segment_mask,
+            q_positions=q_positions, kv_positions=kv_positions)
 
     def _maybe_remat(self, fn):
         if self.cfg.remat == "none":
@@ -177,12 +196,27 @@ class Transformer:
         attention_mask: Optional[jnp.ndarray] = None,   # [B, T] 1 = real
         segment_ids: Optional[jnp.ndarray] = None,      # [B, T] for packing
         positions: Optional[jnp.ndarray] = None,        # [B, T]
+        gapped_mask: bool = False,
     ) -> jnp.ndarray:
-        """Full-sequence forward up to the final norm. [B, T, D]."""
+        """Full-sequence forward up to the final norm. [B, T, D].
+
+        ``gapped_mask``: declare that attention_mask may have internal
+        zero gaps (not plain right-padding). Gapped masks are handled
+        correctly by the XLA attention path (cumsum positions + explicit
+        kv mask) but NOT by the flash kernel, so setting this disables
+        flash. All internal callers produce right-padded or compacted
+        (left_align-ed) batches and keep the default.
+        """
         cfg = self.cfg
         b, t = input_ids.shape
         if positions is None:
-            if segment_ids is not None:
+            if segment_ids is None and attention_mask is not None:
+                # position = index among *real* tokens, so sequences with
+                # masked gaps (e.g. prompt pad + generated tail) see the
+                # same rotary phases as their contiguous equivalents
+                positions = jnp.maximum(
+                    jnp.cumsum(attention_mask.astype(jnp.int32), axis=1) - 1, 0)
+            elif segment_ids is not None:
                 # restart positions at each packed segment boundary
                 seg_start = jnp.concatenate(
                     [jnp.ones((b, 1), bool),
@@ -209,9 +243,12 @@ class Transformer:
         x = _constrain(x, ACT_SPEC)
         cos, sin = rotary_angles(positions, cfg.head_dim_, cfg.rope_theta)
 
+        allow_flash = segment_ids is None and not gapped_mask
+
         def body(carry, layer):
             h, _ = self._block(layer, carry, cos, sin, kv_mask,
-                               positions, positions)
+                               positions, positions,
+                               allow_flash=allow_flash)
             return h, None
 
         x, _ = jax.lax.scan(self._maybe_remat(body), x, params["layers"])
@@ -228,10 +265,12 @@ class Transformer:
     def apply(self, params: Params, input_ids: jnp.ndarray,
               attention_mask: Optional[jnp.ndarray] = None,
               segment_ids: Optional[jnp.ndarray] = None,
-              positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+              positions: Optional[jnp.ndarray] = None,
+              gapped_mask: bool = False) -> jnp.ndarray:
         """Logits forward: [B, T] -> [B, T, V]."""
         h = self.hidden_states(params, input_ids, attention_mask,
-                               segment_ids, positions)
+                               segment_ids, positions,
+                               gapped_mask=gapped_mask)
         return self.unembed(params, h)
 
     __call__ = apply

@@ -1,0 +1,181 @@
+"""Pallas flash attention (TPU): blockwise causal attention with online
+softmax — O(T) memory instead of the [B, H, T, S] score materialization.
+
+This is the kernel the reference only gestures at (its
+``use_flash_attention`` flag merely sets ``use_cache=False``,
+src/models/base_model.py:39-40; the real CUDA kernel lived in a
+third-party wheel). Here it is first-party, tiled for the MXU:
+
+- grid (B, H, Tq/bq, S/bk); the kv dimension is the innermost,
+  sequentially-executed axis, so the running max/sum/accumulator live in
+  VMEM scratch across kv steps (the standard TPU pallas flash pattern);
+- GQA folds into the BlockSpec index map (q head h reads kv head
+  h // group_size) — no materialized kv repeat;
+- fully-masked kv blocks above the causal diagonal are skipped with
+  ``pl.when``.
+
+Correctness domain: contiguous sequences, right-padding only (the
+framework's universal batch layout). Pad queries produce garbage rows that
+the loss masks; pad kv columns sit above the causal diagonal of every real
+query. Packed batches (segment_ids) route to the XLA path instead.
+
+Backward: ``jax.custom_vjp`` with an XLA recompute backward (v1) — the
+forward pass gets the flash memory/bandwidth win (and decode/rollout paths
+are forward-only); a blockwise pallas backward is the planned follow-up.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dla_tpu.ops.attention import causal_attention
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
+                  m_scratch, l_scratch, acc_scratch,
+                  *, scale: float, block_q: int, block_k: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    # skip kv blocks entirely above the causal diagonal
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scratch[:]                         # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                        # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                # [bq, 1]
+        l_new = l_scratch[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scratch[:] = m_new
+        l_scratch[:] = l_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scratch[:]
+        o_ref[0, 0] = (acc_scratch[:] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   scale: float, block_q: int, block_k: int,
+                   interpret: bool) -> jnp.ndarray:
+    """q [B, H, T, D], k/v [B, KH, S, D] -> out [B, H, T, D]."""
+    b, h, t, d = q.shape
+    _, kh, s, _ = k.shape
+    groups = h // kh
+    bq = min(block_q, t)
+    bk = min(block_k, s)
+    if t % bq or s % bk:
+        raise ValueError(f"flash attention needs T%{bq}==0 and S%{bk}==0, "
+                         f"got T={t} S={s}")
+    grid = (b, h, t // bq, s // bk)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_q=bq, block_k=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_core(q, k, v, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, scale, block_q, block_k, interpret)
+
+
+def _xla_reference(q, k, v, scale):
+    """[B, H, T, D] layout XLA attention used for the v1 backward."""
+    out = causal_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), softmax_scale=scale)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _core_fwd(q, k, v, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _core_bwd(scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_reference(q_, k_, v_, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_attention_core.defvjp(_core_fwd, _core_bwd)
+
+
+def flash_causal_attention(
+    q: jnp.ndarray,   # [B, T, H, D]
+    k: jnp.ndarray,   # [B, S, K, D]
+    v: jnp.ndarray,   # [B, S, K, D]
+    *,
+    softmax_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Drop-in for ops.attention.causal_attention on contiguous right-padded
+    sequences (same [B, T, H, D] layout). GQA supported."""
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    out = _flash_attention_core(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), scale, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -96,6 +96,23 @@ def host_local_batch_size(global_batch: int, mesh: Mesh) -> int:
     return global_batch // n_proc
 
 
+def local_numpy(arr) -> "np.ndarray":
+    """Bring this host's slice of a batch-sharded global array to host
+    numpy (inverse of make_global_batch). Single-host: the whole array.
+    Multi-host: the addressable rows, deduped across replica shards and
+    ordered by global offset."""
+    import numpy as np
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    by_start = {}
+    for shard in arr.addressable_shards:
+        idx = shard.index[0]
+        start = idx.start or 0
+        by_start.setdefault(start, np.asarray(shard.data))
+    return np.concatenate(
+        [by_start[s] for s in sorted(by_start)], axis=0)
+
+
 def make_global_batch(local_arrays: Pytree, mesh: Mesh, spec: Optional[P] = None) -> Pytree:
     """Assemble per-host numpy batches into globally-sharded jax.Arrays.
 

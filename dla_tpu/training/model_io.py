@@ -60,7 +60,10 @@ def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
         out["remat"] = "none"
     elif model_cfg.get("gradient_checkpointing") is True:
         out["remat"] = "full"
-    for key in ("dtype", "param_dtype", "remat", "vocab_size"):
+    if "use_flash_attention" in model_cfg:
+        out["attention"] = ("flash" if model_cfg["use_flash_attention"]
+                            else "xla")
+    for key in ("dtype", "param_dtype", "remat", "vocab_size", "attention"):
         if key in model_cfg:
             out[key] = model_cfg[key]
     return out

@@ -1,0 +1,108 @@
+"""Reward-model training (phase 2).
+
+CLI parity: ``python -m dla_tpu.training.train_reward --config
+config/reward_config.yaml`` (reference src/training/train_reward.py).
+Behavior parity: Bradley-Terry pairwise loss over two backbone forwards
+per batch (chosen, rejected; reference train_reward.py:140-148), eval
+reports loss and preference accuracy (chosen > rejected,
+train_reward.py:31-54).
+
+TPU-native: both forwards live in one jitted SPMD step; the backbone and
+scalar head are sharded over the (data, fsdp, model) mesh like every other
+model here.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from dla_tpu.data.iterator import ShardedBatchIterator
+from dla_tpu.data.loaders import build_preference_dataset
+from dla_tpu.ops.losses import pairwise_reward_loss
+from dla_tpu.parallel.dist import initialize_distributed
+from dla_tpu.parallel.mesh import mesh_from_config
+from dla_tpu.training.config import config_from_args, make_arg_parser
+from dla_tpu.training.model_io import build_reward_model, model_aux
+from dla_tpu.training.trainer import Trainer
+
+
+def make_reward_loss(model):
+    def loss_fn(params, frozen, batch, rng):
+        del frozen
+        drng = jax.random.split(rng, 2)
+        chosen = model.apply(
+            params, batch["chosen"]["input_ids"],
+            batch["chosen"]["attention_mask"], dropout_rng=drng[0])
+        rejected = model.apply(
+            params, batch["rejected"]["input_ids"],
+            batch["rejected"]["attention_mask"], dropout_rng=drng[1])
+        loss = pairwise_reward_loss(chosen, rejected)
+        acc = jnp.mean((chosen > rejected).astype(jnp.float32))
+        return loss, {"acc": acc,
+                      "reward_margin": jnp.mean(chosen - rejected)}
+    return loss_fn
+
+
+def make_reward_eval(model):
+    def eval_fn(params, frozen, batch, rng):
+        del frozen, rng
+        chosen = model.apply(params, batch["chosen"]["input_ids"],
+                             batch["chosen"]["attention_mask"])
+        rejected = model.apply(params, batch["rejected"]["input_ids"],
+                               batch["rejected"]["attention_mask"])
+        loss = pairwise_reward_loss(chosen, rejected)
+        acc = jnp.mean((chosen > rejected).astype(jnp.float32))
+        return loss, {"acc": acc}
+    return eval_fn
+
+
+def main(argv=None) -> None:
+    args = make_arg_parser("dla_tpu reward-model trainer").parse_args(argv)
+    config = config_from_args(args)
+    initialize_distributed(config.get("hardware"))
+    mesh = mesh_from_config(config.get("hardware"))
+    from dla_tpu.training.utils import seed_everything
+    rng = seed_everything(int(config.get("seed", 0)))
+
+    with jax.sharding.set_mesh(mesh):
+        bundle = build_reward_model(config.get("model", {}), rng)
+        trainer = Trainer(
+            config=config, mesh=mesh,
+            loss_fn=make_reward_loss(bundle.model),
+            eval_fn=make_reward_eval(bundle.model),
+            params=bundle.params, param_specs=bundle.specs)
+
+        data_cfg = {**config.get("data", {}),
+                    "max_seq_length": bundle.config.max_seq_length}
+        train_ds = build_preference_dataset(data_cfg, bundle.tokenizer, "train")
+        train_it = ShardedBatchIterator(
+            train_ds, trainer.global_batch,
+            seed=int(config.get("seed", 0)),
+            process_index=jax.process_index(),
+            process_count=jax.process_count())
+
+        eval_iter_fn = None
+        has_eval = (data_cfg.get("eval_path")
+                    if data_cfg.get("source", "local") == "local"
+                    else data_cfg.get("eval_split"))
+        if has_eval:
+            eval_ds = build_preference_dataset(data_cfg, bundle.tokenizer, "eval")
+            micro_global = trainer.micro * trainer.dp
+
+            def eval_iter_fn():
+                return iter(ShardedBatchIterator(
+                    eval_ds, micro_global, shuffle=False,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count()))
+
+        trainer.fit(
+            train_it, rng=rng, eval_iter_fn=eval_iter_fn,
+            data_state=train_it.state_dict, resume=args.resume,
+            extra_aux=model_aux(bundle,
+                                config.get("model", {}).get("tokenizer")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,286 @@
+"""PPO-RLHF (phase 3b): rollout -> score -> update, all models colocated on
+one mesh.
+
+CLI parity: ``python -m dla_tpu.training.train_rlhf --config
+config/rlhf_config.yaml`` (reference src/training/train_rlhf.py).
+
+Behavior parity (``ppo.algo: reinforce``, the default — what the reference
+actually implements despite its name, SURVEY.md sec 2.1):
+- sample ``ppo.batch_size`` prompts per step, sharded across hosts
+  (reference random.sample + split_between_processes, train_rlhf.py:113-114)
+- policy generates with temperature/top-p (generation_params,
+  rlhf_config.yaml:19-22)
+- sequence-mean logp of the full generated sequence incl. prompt for
+  policy and frozen ref (reference sequence_logprob, train_rlhf.py:50-58)
+- reward = RM(sequence) - kl_coef * (logp_pi - logp_ref)
+  (train_rlhf.py:149-150); advantage = reward - batch mean (:151)
+- loss = -(advantage.detach() * policy_logp).mean() (:153), one update per
+  rollout
+
+``ppo.algo: ppo`` additionally implements what the reference only declares
+(dead keys mini_batch_size/target_kl, SURVEY.md sec 2.5): clipped-ratio PPO
+over minibatch epochs with an adaptive KL coefficient.
+
+TPU-native design (vs reference sec 3.3's device->host->device bounces):
+generation is a jitted scan with a KV cache; scoring consumes token ids
+directly (policy, ref, and RM share one tokenizer — prompts are templated
+"{prompt}\n\n" so the RM sees the same text layout it was trained on);
+only the compacted token arrays cross the host boundary, for minibatch
+slicing.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dla_tpu.data.loaders import load_prompt_records
+from dla_tpu.generation.engine import (
+    GenerationConfig,
+    build_generate_fn,
+    encode_prompt_batch,
+)
+from dla_tpu.ops.losses import ppo_clip_loss, reinforce_loss, sequence_logprob_mean
+from dla_tpu.parallel.dist import initialize_distributed
+from dla_tpu.parallel.mesh import mesh_from_config
+from dla_tpu.parallel.sharding import local_numpy, make_global_batch
+from dla_tpu.training.config import config_from_args, make_arg_parser
+from dla_tpu.training.model_io import build_reward_model, load_causal_lm, model_aux
+from dla_tpu.training.trainer import Trainer
+from dla_tpu.training.utils import seed_everything
+from dla_tpu.utils.logging import log_rank_zero
+
+PROMPT_TEMPLATE = "{prompt}\n\n"
+
+
+def make_policy_gradient_loss(policy_model, algo: str, clip_ratio: float):
+    def loss_fn(params, frozen, batch, rng):
+        del frozen, rng
+        logits = policy_model.apply(
+            params, batch["sequences"],
+            attention_mask=batch["sequence_mask"])
+        logp = sequence_logprob_mean(
+            logits, batch["sequences"], batch["sequence_mask"])
+        if algo == "ppo":
+            loss, clip_frac = ppo_clip_loss(
+                logp, batch["behavior_logp"], batch["advantages"], clip_ratio)
+            return loss, {"policy_logp": jnp.mean(logp),
+                          "clip_frac": clip_frac}
+        loss = reinforce_loss(logp, batch["advantages"])
+        return loss, {"policy_logp": jnp.mean(logp)}
+    return loss_fn
+
+
+def make_score_fn(policy_model, ref_model, reward_model):
+    """Jitted SPMD scoring over the global rollout batch. jnp.means are
+    global (the computation spans the whole sharded batch), so the
+    advantage baseline is the global batch mean like the reference's."""
+    def score(policy_params, ref_params, rm_params, seqs, mask, kl_coef):
+        p_logits = policy_model.apply(policy_params, seqs, attention_mask=mask)
+        logp_pi = sequence_logprob_mean(p_logits, seqs, mask)
+        r_logits = ref_model.apply(ref_params, seqs, attention_mask=mask)
+        logp_ref = sequence_logprob_mean(r_logits, seqs, mask)
+        rm_score = reward_model.apply(rm_params, seqs, mask)
+        kl = logp_pi - logp_ref
+        reward = rm_score - kl_coef * kl
+        adv = reward - jnp.mean(reward)
+        return {
+            "advantages": adv,
+            "behavior_logp": logp_pi,
+            "reward_mean": jnp.mean(reward),
+            "rm_score_mean": jnp.mean(rm_score),
+            "kl": jnp.mean(kl),
+        }
+    return jax.jit(score)
+
+
+def main(argv=None) -> None:
+    args = make_arg_parser("dla_tpu PPO-RLHF trainer").parse_args(argv)
+    config = config_from_args(args)
+    initialize_distributed(config.get("hardware"))
+    mesh = mesh_from_config(config.get("hardware"))
+    rng = seed_everything(int(config.get("seed", 0)))
+
+    model_cfg = config.get("model", {})
+    ppo_cfg: Dict[str, Any] = config.get("ppo", {})
+    algo = str(ppo_cfg.get("algo", "reinforce")).lower()
+    batch_size = int(ppo_cfg.get("batch_size", 64))
+    mini_batch = int(ppo_cfg.get("mini_batch_size", batch_size))
+    ppo_epochs = int(ppo_cfg.get("epochs", 1))
+    kl_coef = float(ppo_cfg.get("kl_coef", 0.1))
+    target_kl = ppo_cfg.get("target_kl")
+    clip_ratio = float(ppo_cfg.get("clip_ratio", 0.2))
+    n_steps = int(ppo_cfg.get("steps", 1024))
+    max_seq = int(model_cfg.get("max_seq_length", 1024))
+
+    gen = GenerationConfig.from_dict(
+        ppo_cfg.get("generation_params"), max_new_tokens=256,
+        temperature=1.0, top_p=1.0, do_sample=True)
+    prompt_width = int(ppo_cfg.get(
+        "max_prompt_length", max_seq - gen.max_new_tokens))
+
+    with jax.sharding.set_mesh(mesh):
+        policy = load_causal_lm(
+            model_cfg.get("policy_model_name_or_path", "tiny"), model_cfg, rng)
+        ref = load_causal_lm(
+            model_cfg.get("reference_model_name_or_path",
+                          model_cfg.get("policy_model_name_or_path", "tiny")),
+            model_cfg, jax.random.fold_in(rng, 1))
+        rm_cfg = {**config.get("reward_model", {})}
+        rm_cfg.setdefault("base_model_name_or_path", rm_cfg.pop("path", None))
+        rm_cfg.setdefault("tokenizer", model_cfg.get("tokenizer"))
+        rm = build_reward_model(rm_cfg, jax.random.fold_in(rng, 2))
+
+        gen = GenerationConfig(
+            **{**gen.__dict__,
+               "eos_token_id": policy.tokenizer.eos_token_id,
+               "pad_token_id": policy.tokenizer.pad_token_id})
+
+        # one rollout = this many optimizer steps (sizes the LR horizon and
+        # the resume position)
+        updates_per_rollout = (max(1, (batch_size // mini_batch) * ppo_epochs)
+                               if algo == "ppo" else 1)
+        # optimizer config: optimization block is the base, ppo.* wins
+        base_opt = dict(config.get("optimization", {}))
+        opt_block = {
+            **base_opt,
+            "learning_rate": ppo_cfg.get(
+                "learning_rate", base_opt.get("learning_rate", 1e-6)),
+            "max_train_steps": n_steps * updates_per_rollout,
+            "total_batch_size": mini_batch if algo == "ppo" else batch_size,
+            "micro_batch_size": ppo_cfg.get(
+                "micro_batch_size", base_opt.get("micro_batch_size")),
+            "lr_scheduler": ppo_cfg.get(
+                "lr_scheduler", base_opt.get("lr_scheduler", "constant")),
+            "max_grad_norm": ppo_cfg.get(
+                "max_grad_norm", base_opt.get("max_grad_norm", 1.0)),
+        }
+        accum = int(config.get("hardware", {}).get(
+            "gradient_accumulation_steps", 1))
+        update_bs = mini_batch if algo == "ppo" else batch_size
+        if not opt_block.get("micro_batch_size"):
+            dp = mesh.shape["data"] * mesh.shape["fsdp"]
+            opt_block["micro_batch_size"] = max(1, update_bs // (dp * accum))
+        cfg_for_trainer = {**config, "optimization": opt_block}
+
+        trainer = Trainer(
+            config=cfg_for_trainer, mesh=mesh,
+            loss_fn=make_policy_gradient_loss(policy.model, algo, clip_ratio),
+            params=policy.params, param_specs=policy.specs)
+
+        # frozen models placed once; reuse policy specs for the ref
+        from dla_tpu.parallel.sharding import sharding_tree
+        ref_params = jax.device_put(
+            ref.params, sharding_tree(ref.specs, mesh))
+        rm_params = jax.device_put(
+            rm.params, sharding_tree(rm.specs, mesh))
+
+        generate_fn = jax.jit(build_generate_fn(policy.model, gen))
+        score_fn = make_score_fn(policy.model, ref.model, rm.model)
+
+        prompts = load_prompt_records(config.get("sampling", {}))
+        if not prompts:
+            raise ValueError("no prompts loaded for RLHF sampling")
+        log_rank_zero(f"[dla_tpu] RLHF: {len(prompts)} prompts, algo={algo}, "
+                      f"batch {batch_size}, {n_steps} steps")
+
+        host_rng = random.Random(int(config.get("seed", 0)) + jax.process_index())
+        local_bs = batch_size // jax.process_count()
+        tok = policy.tokenizer
+
+        rollout_idx = 0
+        if args.resume:
+            if trainer.try_resume() is not None:
+                # optimizer steps -> completed rollouts, so a resumed run
+                # executes only the remainder (fit() gets this via
+                # step < max_steps; this loop must too)
+                rollout_idx = trainer.step // updates_per_rollout
+                log_rank_zero(
+                    f"[dla_tpu] resuming at rollout {rollout_idx}/{n_steps}")
+
+        while rollout_idx < n_steps:
+            # 1. sample + encode prompts (host, this rank's share only)
+            batch_prompts = [
+                PROMPT_TEMPLATE.format(prompt=p)
+                for p in (host_rng.sample(prompts, local_bs)
+                          if len(prompts) >= local_bs
+                          else host_rng.choices(prompts, k=local_bs))]
+            ids, mask = encode_prompt_batch(tok, batch_prompts, prompt_width)
+            gbatch = make_global_batch(
+                {"ids": ids, "mask": mask}, mesh)
+
+            # 2. rollout (jitted scan decode) + 3. score (jitted SPMD)
+            roll_rng = jax.random.fold_in(rng, 10_000 + rollout_idx)
+            out = generate_fn(trainer.params, gbatch["ids"], gbatch["mask"],
+                              roll_rng)
+            scores = score_fn(trainer.params, ref_params, rm_params,
+                              out["sequences"], out["sequence_mask"],
+                              jnp.float32(kl_coef))
+
+            # 4. update(s) — token arrays cross to host for minibatch slicing
+            up = {
+                "sequences": local_numpy(out["sequences"]),
+                "sequence_mask": local_numpy(out["sequence_mask"]),
+                "advantages": local_numpy(scores["advantages"]),
+                "behavior_logp": local_numpy(scores["behavior_logp"]),
+            }
+            losses = []
+            if algo == "ppo":
+                n_local_mb = max(1, local_bs * jax.process_count() // mini_batch)
+                local_mb = up["sequences"].shape[0] // n_local_mb
+                for epoch in range(ppo_epochs):
+                    order = np.random.default_rng(
+                        (rollout_idx, epoch)).permutation(
+                            up["sequences"].shape[0])
+                    for k in range(n_local_mb):
+                        sl = order[k * local_mb:(k + 1) * local_mb]
+                        mb = {key: v[sl] for key, v in up.items()}
+                        loss, _ = trainer.step_on_batch(
+                            mb, jax.random.fold_in(rng, trainer.step))
+                        losses.append(loss)
+            else:
+                loss, _ = trainer.step_on_batch(
+                    up, jax.random.fold_in(rng, trainer.step))
+                losses.append(loss)
+
+            kl_now = float(scores["kl"])
+            if algo == "ppo" and target_kl:
+                # adaptive KL controller on the dead-in-reference target_kl
+                if kl_now > 1.5 * float(target_kl):
+                    kl_coef *= 2.0
+                elif kl_now < float(target_kl) / 1.5:
+                    kl_coef *= 0.5
+
+            rollout_idx += 1
+            if rollout_idx % int(config.get("logging", {})
+                                 .get("log_every_steps", 10)) == 0:
+                payload = {
+                    "train/loss": float(np.mean(losses)),
+                    "train/kl": kl_now,
+                    "train/kl_coef": kl_coef,
+                    "train/reward_mean": float(scores["reward_mean"]),
+                    "train/rm_score_mean": float(scores["rm_score_mean"]),
+                    "train/response_len": float(
+                        np.mean(local_numpy(out["response_mask"]).sum(-1))),
+                }
+                trainer.logger.log(payload, rollout_idx)
+                log_rank_zero(
+                    f"rollout {rollout_idx}: reward "
+                    f"{payload['train/reward_mean']:.4f} kl {kl_now:.4f}")
+
+            save_every = int(config.get("logging", {})
+                             .get("save_every_steps", 0))
+            if save_every and rollout_idx % save_every == 0:
+                trainer.save(extra_aux=model_aux(
+                    policy, model_cfg.get("tokenizer")))
+
+        trainer.save(extra_aux=model_aux(policy, model_cfg.get("tokenizer")),
+                     tag="final")
+        trainer.logger.finish()
+
+
+if __name__ == "__main__":
+    main()

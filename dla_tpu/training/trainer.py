@@ -82,6 +82,14 @@ class Trainer:
         self.params = jax.device_put(params, self.param_shardings)
         self.frozen = None
         if frozen is not None:
+            # DPO-style "ref = initial policy" passes the same leaf objects
+            # for params and frozen; device_put would alias them and the
+            # donated train step would then consume the frozen buffers.
+            param_leaf_ids = {id(l) for l in jax.tree.leaves(self.params)}
+            param_leaf_ids |= {id(l) for l in jax.tree.leaves(params)}
+            frozen = jax.tree.map(
+                lambda x: jnp.copy(x) if id(x) in param_leaf_ids else x,
+                frozen)
             fs = sharding_tree(frozen_specs, mesh)
             self.frozen = jax.device_put(frozen, fs)
 
@@ -214,6 +222,19 @@ class Trainer:
         return make_global_batch(np_batch, self.mesh,
                                  spec=P(("data", "fsdp")))
 
+    # ---------------------------------------------------------- single step
+
+    def step_on_batch(self, np_batch: Dict[str, np.ndarray], rng: jax.Array
+                      ) -> Tuple[float, Dict[str, float]]:
+        """One optimizer step on an externally-produced batch (the RLHF
+        rollout loop drives this instead of fit())."""
+        batch = self.place_batch(np_batch)
+        step_fn = self.compile_train_step()
+        self.params, self.opt_state, loss, metrics = step_fn(
+            self.params, self.opt_state, self.frozen, batch, rng)
+        self.step += 1
+        return float(loss), {k: float(v) for k, v in metrics.items()}
+
     # ------------------------------------------------------------- the loop
 
     def fit(
@@ -242,10 +263,8 @@ class Trainer:
         gen = iter(train_iter)
         while self.step < self.max_steps:
             np_batch = next(gen)
-            mask = np_batch.get(tokens_per_batch_key)
-            n_local = int(mask.sum()) if mask is not None \
-                else int(np_batch["input_ids"].size)
-            n_tokens = n_local * jax.process_count()
+            n_tokens = _count_tokens(np_batch, tokens_per_batch_key) \
+                * jax.process_count()
             batch = self.place_batch(np_batch)
             step_rng = jax.random.fold_in(rng, self.step)
             self.params, self.opt_state, loss, metrics = step_fn(
@@ -322,3 +341,25 @@ class Trainer:
         self.step = int(aux.get("step", 0))
         log_rank_zero(f"[dla_tpu] resumed from {tag} @ step {self.step}")
         return aux
+
+
+def _count_tokens(np_batch: Dict[str, Any], mask_key: Optional[str]) -> int:
+    """Real-token count for throughput metrics: sum every ``mask_key`` array
+    in the (possibly nested, e.g. chosen/rejected) batch; fall back to the
+    first leaf's element count."""
+    total = 0
+    if mask_key:
+        def visit(node):
+            nonlocal total
+            if isinstance(node, dict):
+                v = node.get(mask_key)
+                if v is not None and hasattr(v, "sum"):
+                    total += int(v.sum())
+                for k, child in node.items():
+                    if isinstance(child, dict):
+                        visit(child)
+        visit(np_batch)
+    if total == 0:
+        leaves = jax.tree.leaves(np_batch)
+        total = int(leaves[0].size) if leaves else 0
+    return total

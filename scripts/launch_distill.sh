@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Launch the distill phase. Usage: bash scripts/launch_distill.sh [config.yaml]
+set -euo pipefail
+
+CONFIG=${1:-config/distill_config.yaml}
+export TOKENIZERS_PARALLELISM=false
+
+python -m dla_tpu.training.train_distill --config "$CONFIG"

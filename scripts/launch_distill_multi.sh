@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Teacher-ensemble distillation: same entrypoint, ensemble toggled in YAML
+# (distill.teacher_model_names_or_paths + use_kl/on_policy).
+set -euo pipefail
+
+CONFIG=${1:-config/distill_config.yaml}
+export TOKENIZERS_PARALLELISM=false
+
+python -m dla_tpu.training.train_distill --config "$CONFIG"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Launch the dpo phase. Usage: bash scripts/launch_dpo.sh [config.yaml]
+set -euo pipefail
+
+CONFIG=${1:-config/dpo_config.yaml}
+export TOKENIZERS_PARALLELISM=false
+
+python -m dla_tpu.training.train_dpo --config "$CONFIG"

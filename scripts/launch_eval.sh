@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Run both evaluation harnesses. Usage: bash scripts/launch_eval.sh [config.yaml]
+set -euo pipefail
+
+CONFIG=${1:-config/eval_config.yaml}
+export TOKENIZERS_PARALLELISM=false
+
+python -m dla_tpu.eval.eval_alignment --config "$CONFIG"
+python -m dla_tpu.eval.eval_latency --config "$CONFIG"

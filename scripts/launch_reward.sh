@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Launch the reward phase. Usage: bash scripts/launch_reward.sh [config.yaml]
+set -euo pipefail
+
+CONFIG=${1:-config/reward_config.yaml}
+export TOKENIZERS_PARALLELISM=false
+
+python -m dla_tpu.training.train_reward --config "$CONFIG"

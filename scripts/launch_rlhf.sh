@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Launch the rlhf phase. Usage: bash scripts/launch_rlhf.sh [config.yaml]
+set -euo pipefail
+
+CONFIG=${1:-config/rlhf_config.yaml}
+export TOKENIZERS_PARALLELISM=false
+
+python -m dla_tpu.training.train_rlhf --config "$CONFIG"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Launch the sft phase. Usage: bash scripts/launch_sft.sh [config.yaml]
+set -euo pipefail
+
+CONFIG=${1:-config/sft_config.yaml}
+export TOKENIZERS_PARALLELISM=false
+
+python -m dla_tpu.training.train_sft --config "$CONFIG"

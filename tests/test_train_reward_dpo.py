@@ -1,0 +1,109 @@
+"""End-to-end smoke tests for reward-model and DPO training on the CPU mesh."""
+import json
+
+import numpy as np
+import yaml
+
+from dla_tpu.data.jsonl import write_jsonl
+
+
+def _pref_records(n=48, seed=0):
+    """Chosen responses are polite/helpful, rejected are curt — a signal a
+    tiny model can separate within a few dozen steps."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        a, b = int(rng.integers(0, 30)), int(rng.integers(0, 30))
+        recs.append({
+            "prompt": f"add {a} {b}",
+            "chosen": f"the answer is {a + b} thanks",
+            "rejected": "no idea",
+        })
+    return recs
+
+
+def _base_cfg(tmp_path, name):
+    return {
+        "experiment_name": name,
+        "seed": 0,
+        "data": {"source": "local",
+                 "train_path": str(tmp_path / "pref.jsonl")},
+        "optimization": {
+            "total_batch_size": 16, "micro_batch_size": 2,
+            "learning_rate": 1e-3, "warmup_steps": 2,
+            "max_train_steps": 10, "lr_scheduler": "cosine",
+            "max_grad_norm": 1.0,
+        },
+        "logging": {
+            "output_dir": str(tmp_path / "ckpt"),
+            "log_dir": str(tmp_path / "logs"),
+            "log_every_steps": 2, "save_every_steps": 0,
+        },
+        "hardware": {
+            "gradient_accumulation_steps": 2,
+            "mesh": {"data": 2, "fsdp": 2, "model": 2},
+        },
+    }
+
+
+def _metric(log_dir, key):
+    out = []
+    with open(log_dir / "metrics.jsonl") as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if key in rec:
+                out.append((rec["step"], rec[key]))
+    return out
+
+
+def test_reward_training_learns_preferences(tmp_path):
+    from dla_tpu.training.train_reward import main
+    write_jsonl(tmp_path / "pref.jsonl", _pref_records())
+    cfg = _base_cfg(tmp_path, "reward_smoke")
+    cfg["model"] = {"base_model_name_or_path": "tiny", "tokenizer": "byte",
+                    "max_seq_length": 32, "pooling": "last_token",
+                    "dropout": 0.1}
+    cfg["optimization"]["max_train_steps"] = 20
+    cfg["optimization"]["learning_rate"] = 2e-3
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    main(["--config", str(p)])
+    losses = _metric(tmp_path / "logs", "train/loss_instant")
+    accs = _metric(tmp_path / "logs", "train/acc")
+    assert np.mean([v for _, v in losses[-2:]]) < losses[0][1]
+    assert accs[-1][1] > 0.6  # pairwise accuracy should beat chance
+
+
+def test_dpo_training_improves_preference_rate(tmp_path):
+    from dla_tpu.training.train_dpo import main
+    write_jsonl(tmp_path / "pref.jsonl", _pref_records())
+    cfg = _base_cfg(tmp_path, "dpo_smoke")
+    cfg["model"] = {"policy_model_name_or_path": "tiny", "tokenizer": "byte",
+                    "max_seq_length": 24, "beta": 0.5}
+    cfg["data"]["preference_path"] = cfg["data"].pop("train_path")
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    main(["--config", str(p)])
+    losses = _metric(tmp_path / "logs", "train/loss_instant")
+    prefs = _metric(tmp_path / "logs", "train/preference_rate")
+    # DPO loss starts at log(2) with identical policy/ref and must fall
+    assert abs(losses[0][1] - np.log(2)) < 0.35
+    assert losses[-1][1] < losses[0][1]
+    assert prefs[-1][1] > 0.5
+
+
+def test_dpo_mesh_shapes_vary(tmp_path):
+    """Same run on a pure-fsdp mesh — sharding-shape robustness."""
+    from dla_tpu.training.train_dpo import main
+    write_jsonl(tmp_path / "pref.jsonl", _pref_records(n=32))
+    cfg = _base_cfg(tmp_path, "dpo_mesh")
+    cfg["model"] = {"policy_model_name_or_path": "tiny", "tokenizer": "byte",
+                    "max_seq_length": 24, "beta": 0.1}
+    cfg["data"]["preference_path"] = cfg["data"].pop("train_path")
+    cfg["hardware"]["mesh"] = {"data": 1, "fsdp": 8, "model": 1}
+    cfg["optimization"]["max_train_steps"] = 4
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    main(["--config", str(p)])
+    losses = _metric(tmp_path / "logs", "train/loss_instant")
+    assert losses and np.isfinite(losses[-1][1])

@@ -1,0 +1,80 @@
+"""RLHF rollout-update loop smoke tests (reinforce + ppo modes)."""
+import json
+
+import numpy as np
+import pytest
+import yaml
+
+from dla_tpu.data.jsonl import write_jsonl
+
+
+def _rlhf_cfg(tmp_path, algo="reinforce", steps=6):
+    write_jsonl(tmp_path / "prompts.jsonl",
+                [{"prompt": f"say something about topic {i}"}
+                 for i in range(32)])
+    cfg = {
+        "experiment_name": f"rlhf_{algo}",
+        "seed": 0,
+        "model": {
+            "policy_model_name_or_path": "tiny",
+            "reference_model_name_or_path": "tiny",
+            "tokenizer": "byte",
+            "max_seq_length": 48,
+        },
+        "reward_model": {"base_model_name_or_path": "tiny",
+                         "tokenizer": "byte", "max_seq_length": 48},
+        "ppo": {
+            "algo": algo,
+            "batch_size": 8,
+            "mini_batch_size": 4,
+            "epochs": 1,
+            "learning_rate": 1e-4,
+            "kl_coef": 0.1,
+            "target_kl": 6.0,
+            "steps": steps,
+            "generation_params": {
+                "max_new_tokens": 8, "temperature": 0.7, "top_p": 0.9},
+        },
+        "sampling": {"source": "local",
+                     "prompt_path": str(tmp_path / "prompts.jsonl")},
+        "logging": {
+            "output_dir": str(tmp_path / "ckpt"),
+            "log_dir": str(tmp_path / "logs"),
+            "log_every_steps": 2,
+        },
+        "hardware": {"mesh": {"data": 2, "fsdp": 2, "model": 2}},
+    }
+    p = tmp_path / "rlhf.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    return p
+
+
+def _metrics(tmp_path):
+    recs = []
+    with open(tmp_path / "logs" / "metrics.jsonl") as fh:
+        for line in fh:
+            recs.append(json.loads(line))
+    return recs
+
+
+def test_rlhf_reinforce_runs_and_logs(tmp_path):
+    from dla_tpu.training.train_rlhf import main
+    main(["--config", str(_rlhf_cfg(tmp_path, "reinforce"))])
+    recs = _metrics(tmp_path)
+    assert recs, "no metrics logged"
+    last = recs[-1]
+    for key in ("train/loss", "train/kl", "train/reward_mean",
+                "train/rm_score_mean", "train/response_len"):
+        assert key in last and np.isfinite(last[key]), key
+    # fresh identical policy/ref: first-step KL must be near zero
+    assert abs(recs[0]["train/kl"]) < 0.5
+    assert (tmp_path / "ckpt" / "final").is_dir()
+
+
+def test_rlhf_ppo_minibatch_mode(tmp_path):
+    from dla_tpu.training.train_rlhf import main
+    main(["--config", str(_rlhf_cfg(tmp_path, "ppo", steps=4))])
+    recs = _metrics(tmp_path)
+    assert recs
+    assert np.isfinite(recs[-1]["train/loss"])
+    assert "train/kl_coef" in recs[-1]
