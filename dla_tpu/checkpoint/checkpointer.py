@@ -140,6 +140,9 @@ class Checkpointer:
             tag = latest.read_text().strip()
             if (self.dir / tag).is_dir():
                 return tag
+        return self.newest_step_tag()
+
+    def newest_step_tag(self) -> Optional[str]:
         steps = sorted(d.name for d in self.dir.glob("step_*") if d.is_dir())
         return steps[-1] if steps else None
 

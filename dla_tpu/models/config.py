@@ -33,6 +33,19 @@ class ModelConfig:
     # used on the full-sequence path when shapes allow; decode/packed
     # paths always use xla)
     attention: str = "xla"
+    # context parallelism over the `sequence` mesh axis (long-context):
+    # "ring" (ppermute KV rotation, any head count) | "ulysses" (head
+    # all-to-all, needs kv_heads % seq_axis == 0). Active only when the
+    # ambient mesh has sequence > 1; decode paths always run unsharded.
+    context_parallel: str = "ring"
+    # LoRA (the reference's model.lora block, advertised but never wired —
+    # reference base_model.py:45-49 dead code, SURVEY.md sec 2.5; here it
+    # is functional). lora_r == 0 disables. Adapters are a separate
+    # trainable pytree (Transformer.init_lora); base params stay frozen.
+    lora_r: int = 0
+    lora_alpha: float = 32.0
+    lora_dropout: float = 0.0
+    lora_targets: tuple = ("wq", "wk", "wv", "wo")
 
     @property
     def head_dim_(self) -> int:
@@ -41,7 +54,10 @@ class ModelConfig:
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ModelConfig":
         fields = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in d.items() if k in fields})
+        d = {k: v for k, v in d.items() if k in fields}
+        if "lora_targets" in d:
+            d["lora_targets"] = tuple(d["lora_targets"])
+        return cls(**d)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

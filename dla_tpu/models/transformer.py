@@ -52,6 +52,17 @@ def _constrain(x, spec):
         return x  # outside a mesh context (plain single-device use)
 
 
+def _sequence_axis_size() -> int:
+    """Size of the `sequence` axis of the ambient mesh (1 if no mesh)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or mesh.empty:
+            return 1
+        return mesh.shape.get("sequence", 1)
+    except (ValueError, RuntimeError):
+        return 1
+
+
 class Transformer:
     """Functional model: a namespace of pure functions bound to a config."""
 
@@ -95,6 +106,93 @@ class Transformer:
                 jax.random.fold_in(rng, 99), (D, cfg.vocab_size), std)
         return params
 
+    # ----------------------------------------------------------------- LoRA
+
+    # target -> (in-dim key, out-dim key) of the base matrix [L, in, out]
+    _LORA_SHAPES = {
+        "wq": ("hidden", "q"), "wk": ("hidden", "kv"), "wv": ("hidden", "kv"),
+        "wo": ("q", "hidden"), "w_gate": ("hidden", "ffn"),
+        "w_up": ("hidden", "ffn"), "w_down": ("ffn", "hidden"),
+    }
+
+    def _lora_dims(self):
+        cfg = self.cfg
+        dh = cfg.head_dim_
+        return {"hidden": cfg.hidden_size, "q": cfg.num_heads * dh,
+                "kv": cfg.num_kv_heads * dh, "ffn": cfg.intermediate_size}
+
+    def init_lora(self, rng: jax.Array) -> Params:
+        """Adapter pytree for cfg.lora_targets: per target, A [L, in, r]
+        (gaussian) and B [L, r, out] (zeros) — the functional version of the
+        reference's dead ``freeze_except_lora``/``model.lora`` surface
+        (reference base_model.py:45-49, config/distill_config.yaml:10-14)."""
+        cfg = self.cfg
+        if cfg.lora_r <= 0:
+            raise ValueError("init_lora requires lora_r > 0")
+        dims = self._lora_dims()
+        layers: Params = {}
+        for i, t in enumerate(cfg.lora_targets):
+            din, dout = (dims[k] for k in self._LORA_SHAPES[t])
+            key = jax.random.fold_in(rng, i)
+            layers[f"{t}_lora_a"] = (
+                jax.random.normal(key, (cfg.num_layers, din, cfg.lora_r),
+                                  jnp.float32) * 0.02).astype(self.pdtype)
+            layers[f"{t}_lora_b"] = jnp.zeros(
+                (cfg.num_layers, cfg.lora_r, dout), self.pdtype)
+        return {"layers": layers}
+
+    def lora_partition_specs(self) -> Params:
+        """A shards its input dim like the base matrix; B its output dim."""
+        base = {
+            "wq": P(None, "fsdp", "model"), "wk": P(None, "fsdp", "model"),
+            "wv": P(None, "fsdp", "model"), "wo": P(None, "model", "fsdp"),
+            "w_gate": P(None, "fsdp", "model"),
+            "w_up": P(None, "fsdp", "model"),
+            "w_down": P(None, "model", "fsdp"),
+        }
+        layers: Params = {}
+        for t in self.cfg.lora_targets:
+            spec = base[t]
+            layers[f"{t}_lora_a"] = P(None, spec[1], None)
+            layers[f"{t}_lora_b"] = P(None, None, spec[2])
+        return {"layers": layers}
+
+    def merge_lora(self, params: Params, lora: Params) -> Params:
+        """Fold adapters into a standalone param tree (for decode/export:
+        the KV-cache generation path runs merged weights)."""
+        cfg = self.cfg
+        scale = cfg.lora_alpha / cfg.lora_r
+        out = jax.tree.map(lambda x: x, params)  # shallow-ish copy
+        new_layers = dict(out["layers"])
+        for t in cfg.lora_targets:
+            a = lora["layers"][f"{t}_lora_a"].astype(jnp.float32)
+            b = lora["layers"][f"{t}_lora_b"].astype(jnp.float32)
+            delta = jnp.einsum("lir,lro->lio", a, b) * scale
+            new_layers[t] = (new_layers[t].astype(jnp.float32) + delta
+                             ).astype(new_layers[t].dtype)
+        out["layers"] = new_layers
+        return out
+
+    def _lora_proj(self, layer: Params, name: str, x: jnp.ndarray,
+                   base_out: jnp.ndarray,
+                   dropout_key: Optional[jax.Array]) -> jnp.ndarray:
+        """base_out + scale * dropout(x) @ A @ B when adapters are present."""
+        a = layer.get(f"{name}_lora_a")
+        if a is None:
+            return base_out
+        cfg = self.cfg
+        b_ = layer[f"{name}_lora_b"]
+        z = x
+        if dropout_key is not None and cfg.lora_dropout > 0:
+            idx = list(cfg.lora_targets).index(name)
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(dropout_key, idx),
+                1.0 - cfg.lora_dropout, z.shape)
+            z = jnp.where(keep, z / (1.0 - cfg.lora_dropout), 0.0)
+        scale = cfg.lora_alpha / cfg.lora_r
+        return base_out + ((z @ a.astype(self.adtype))
+                           @ b_.astype(self.adtype)) * scale
+
     # ------------------------------------------------------- partition specs
 
     def partition_specs(self) -> Params:
@@ -131,9 +229,11 @@ class Transformer:
                kv_positions: jnp.ndarray,
                kv_override: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
                allow_flash: bool = False,
+               cp: Optional[Tuple] = None,
+               dropout_key: Optional[jax.Array] = None,
                ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
         """One decoder block. Returns (output, (k, v)) — k/v before override,
-        for cache writes."""
+        for cache writes. ``layer`` may carry LoRA leaves (merged upstream)."""
         cfg = self.cfg
         dh = cfg.head_dim_
         b, t, d = x.shape
@@ -141,10 +241,14 @@ class Transformer:
         def cast(w):
             return w.astype(self.adtype)
 
+        def proj(name, inp):
+            return self._lora_proj(layer, name, inp, inp @ cast(layer[name]),
+                                   dropout_key)
+
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ cast(layer["wq"])).reshape(b, t, cfg.num_heads, dh)
-        k = (h @ cast(layer["wk"])).reshape(b, t, cfg.num_kv_heads, dh)
-        v = (h @ cast(layer["wv"])).reshape(b, t, cfg.num_kv_heads, dh)
+        q = proj("wq", h).reshape(b, t, cfg.num_heads, dh)
+        k = proj("wk", h).reshape(b, t, cfg.num_kv_heads, dh)
+        v = proj("wv", h).reshape(b, t, cfg.num_kv_heads, dh)
         q = _constrain(q, P(("data", "fsdp"), "sequence", "model", None))
         k = _constrain(k, P(("data", "fsdp"), "sequence", "model", None))
         q = apply_rotary(q, cos, sin)
@@ -153,24 +257,38 @@ class Transformer:
         if kv_override is not None:
             k, v = kv_override
         attn = self._attention(q, k, v, kv_segment_mask,
-                               q_positions, kv_positions, allow_flash)
+                               q_positions, kv_positions, allow_flash, cp)
         attn = attn.reshape(b, t, cfg.num_heads * dh)
-        x = x + _constrain(attn @ cast(layer["wo"]), ACT_SPEC)
+        x = x + _constrain(proj("wo", attn), ACT_SPEC)
 
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu(h @ cast(layer["w_gate"]))
-        up = h @ cast(layer["w_up"])
+        gate = jax.nn.silu(proj("w_gate", h))
+        up = proj("w_up", h)
         ff = _constrain(gate * up, P(("data", "fsdp"), "sequence", "model"))
-        x = x + _constrain(ff @ cast(layer["w_down"]), ACT_SPEC)
+        x = x + _constrain(proj("w_down", ff), ACT_SPEC)
         return x, new_kv
 
     def _attention(self, q, k, v, kv_segment_mask, q_positions, kv_positions,
-                   allow_flash: bool = False):
+                   allow_flash: bool = False, cp: Optional[Tuple] = None):
         """Pick the attention backend. The pallas flash kernel handles the
         full-sequence causal path on contiguous right-padded batches whose
         length tiles its blocks; everything else (decode against a cache,
-        packed segments, odd lengths) takes the XLA path."""
+        packed segments, odd lengths) takes the XLA path. When ``cp`` is
+        set (mode, kv_valid, segment_ids), the sequence dim is sharded
+        over the mesh and attention runs ring / ulysses context-parallel."""
         t, s = q.shape[1], k.shape[1]
+        if cp is not None:
+            mode, kv_valid, seg = cp
+            if mode == "ulysses":
+                from dla_tpu.ops.ulysses import ulysses_causal_attention
+                return ulysses_causal_attention(
+                    q, k, v, q_positions=q_positions,
+                    kv_positions=kv_positions, kv_valid=kv_valid,
+                    segment_ids=seg)
+            from dla_tpu.ops.ring_attention import ring_causal_attention
+            return ring_causal_attention(
+                q, k, v, q_positions=q_positions, kv_positions=kv_positions,
+                kv_valid=kv_valid, segment_ids=seg)
         if (self.cfg.attention == "flash" and allow_flash and t == s
                 and _flash_tileable(t)):
             from dla_tpu.ops.flash_attention import flash_causal_attention
@@ -197,6 +315,8 @@ class Transformer:
         segment_ids: Optional[jnp.ndarray] = None,      # [B, T] for packing
         positions: Optional[jnp.ndarray] = None,        # [B, T]
         gapped_mask: bool = False,
+        lora: Optional[Params] = None,                  # adapter pytree
+        dropout_rng: Optional[jax.Array] = None,        # enables lora dropout
     ) -> jnp.ndarray:
         """Full-sequence forward up to the final norm. [B, T, D].
 
@@ -230,28 +350,57 @@ class Transformer:
             else:
                 positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
 
+        # Context parallelism: when the ambient mesh shards `sequence`,
+        # attention runs ring/ulysses from 1-D metadata and the [B, T, T]
+        # mask is never materialized.
+        cp = None
+        if cfg.context_parallel != "none" and _sequence_axis_size() > 1:
+            kv_valid = (attention_mask if attention_mask is not None
+                        else jnp.ones((b, t), jnp.int32))
+            seg = (segment_ids if segment_ids is not None
+                   else jnp.zeros((b, t), jnp.int32))
+            cp = (cfg.context_parallel, kv_valid, seg)
+
         kv_mask = None
-        if attention_mask is not None:
-            kv_mask = jnp.broadcast_to(
-                attention_mask[:, None, :].astype(bool), (b, t, t))
-        if segment_ids is not None:
-            same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
-            kv_mask = same_seg if kv_mask is None else (kv_mask & same_seg)
+        if cp is None:
+            if attention_mask is not None:
+                kv_mask = jnp.broadcast_to(
+                    attention_mask[:, None, :].astype(bool), (b, t, t))
+            if segment_ids is not None:
+                same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
+                kv_mask = same_seg if kv_mask is None else (kv_mask & same_seg)
 
         x = jnp.take(params["embed"]["embedding"], input_ids, axis=0
                      ).astype(self.adtype)
         x = _constrain(x, ACT_SPEC)
         cos, sin = rotary_angles(positions, cfg.head_dim_, cfg.rope_theta)
 
-        allow_flash = segment_ids is None and not gapped_mask
+        allow_flash = segment_ids is None and not gapped_mask and cp is None
 
-        def body(carry, layer):
-            h, _ = self._block(layer, carry, cos, sin, kv_mask,
-                               positions, positions,
-                               allow_flash=allow_flash)
-            return h, None
+        layers = params["layers"]
+        keys = None
+        if lora is not None:
+            layers = {**layers, **lora["layers"]}
+            if dropout_rng is not None and cfg.lora_dropout > 0:
+                keys = jax.random.split(dropout_rng, cfg.num_layers)
 
-        x, _ = jax.lax.scan(self._maybe_remat(body), x, params["layers"])
+        if keys is None:
+            def body(carry, layer):
+                h, _ = self._block(layer, carry, cos, sin, kv_mask,
+                                   positions, positions,
+                                   allow_flash=allow_flash, cp=cp)
+                return h, None
+        else:
+            def body(carry, xs):
+                layer, key = xs
+                h, _ = self._block(layer, carry, cos, sin, kv_mask,
+                                   positions, positions,
+                                   allow_flash=allow_flash, cp=cp,
+                                   dropout_key=key)
+                return h, None
+            layers = (layers, keys)
+
+        x, _ = jax.lax.scan(self._maybe_remat(body), x, layers)
         return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
 
     def unembed(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
@@ -266,11 +415,14 @@ class Transformer:
               attention_mask: Optional[jnp.ndarray] = None,
               segment_ids: Optional[jnp.ndarray] = None,
               positions: Optional[jnp.ndarray] = None,
-              gapped_mask: bool = False) -> jnp.ndarray:
+              gapped_mask: bool = False,
+              lora: Optional[Params] = None,
+              dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
         """Logits forward: [B, T] -> [B, T, V]."""
         h = self.hidden_states(params, input_ids, attention_mask,
                                segment_ids, positions,
-                               gapped_mask=gapped_mask)
+                               gapped_mask=gapped_mask, lora=lora,
+                               dropout_rng=dropout_rng)
         return self.unembed(params, h)
 
     __call__ = apply

@@ -19,9 +19,12 @@ framework's universal batch layout). Pad queries produce garbage rows that
 the loss masks; pad kv columns sit above the causal diagonal of every real
 query. Packed batches (segment_ids) route to the XLA path instead.
 
-Backward: ``jax.custom_vjp`` with an XLA recompute backward (v1) — the
-forward pass gets the flash memory/bandwidth win (and decode/rollout paths
-are forward-only); a blockwise pallas backward is the planned follow-up.
+Backward: blockwise pallas kernels (FlashAttention-2 style). The forward
+additionally emits the per-row log-sum-exp; the backward recomputes P
+tile-by-tile from (q, k, lse) — never materializing [T, S] — with one
+kernel accumulating dQ over kv blocks and one accumulating dK/dV over q
+blocks. GQA: dK/dV are produced per *query* head and group-summed to kv
+heads outside the kernel.
 """
 from __future__ import annotations
 
@@ -40,7 +43,7 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                   m_scratch, l_scratch, acc_scratch,
                   *, scale: float, block_q: int, block_k: int):
     iq = pl.program_id(2)
@@ -86,14 +89,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
     @pl.when(ik == nk - 1)
     def _finalize():
         l = l_scratch[:]
-        o_ref[0, 0] = (acc_scratch[:] /
-                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scratch[:] + jnp.log(safe_l)   # [bq, 1]
 
 
 def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    scale: float, block_q: int, block_k: int,
-                   interpret: bool) -> jnp.ndarray:
-    """q [B, H, T, D], k/v [B, KH, S, D] -> out [B, H, T, D]."""
+                   interpret: bool):
+    """q [B, H, T, D], k/v [B, KH, S, D] -> (out [B, H, T, D],
+    lse [B, H, T, 1] log-sum-exp of each score row, for the backward;
+    trailing singleton keeps the block 2-D for mosaic's tiling rules)."""
     b, h, t, d = q.shape
     _, kh, s, _ = k.shape
     groups = h // kh
@@ -117,9 +123,16 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -132,13 +145,188 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     )(q, k, v)
 
 
+# ----------------------------------------------------------------- backward
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scratch,
+                         *, scale: float, block_q: int, block_k: int):
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    iq = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        do = do_ref[0, 0].astype(jnp.float32)        # [bq, D]
+        lse = lse_ref[0, 0]                          # [bq, 1]
+        delta = delta_ref[0, 0]                      # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bk]
+        ds = p * (dp - delta)                                  # [bq, bk]
+        dq_scratch[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scratch, dv_scratch,
+                          *, scale: float, block_q: int, block_k: int):
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+    ik = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    @pl.when(q_start + block_q - 1 >= k_start)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        do = do_ref[0, 0].astype(jnp.float32)        # [bq, D]
+        lse = lse_ref[0, 0]                          # [bq, 1]
+        delta = delta_ref[0, 0]                      # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse), 0.0)  # [bq, bk]
+
+        dv_scratch[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bk]
+        ds = p * (dp - delta)
+        dk_scratch[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, D]
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, scale, block_q, block_k,
+                    interpret):
+    """Blockwise backward. Returns (dq [B,H,T,D], dk, dv [B,KH,S,D])."""
+    b, h, t, d = q.shape
+    _, kh, s, _ = k.shape
+    groups = h // kh
+    bq = min(block_q, t)
+    bk = min(block_k, s)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                    # [B, H, T, 1]
+
+    kq = functools.partial(_flash_bwd_dq_kernel, scale=scale,
+                           block_q=bq, block_k=bk)
+    dq = pl.pallas_call(
+        kq,
+        grid=(b, h, t // bq, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    kkv = functools.partial(_flash_bwd_dkv_kernel, scale=scale,
+                            block_q=bq, block_k=bk)
+    # dk/dv computed per *query* head ([B, H, S, D]) so each grid cell owns
+    # its output block exclusively; the GQA group-sum happens below in XLA.
+    dk_h, dv_h = pl.pallas_call(
+        kkv,
+        grid=(b, h, s // bk, t // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, qi, g=groups: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, qi, g=groups: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk = dk_h.reshape(b, kh, groups, s, d).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(b, kh, groups, s, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_attention_core(q, k, v, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, scale, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, scale, block_q, block_k, interpret)[0]
 
 
 def _xla_reference(q, k, v, scale):
-    """[B, H, T, D] layout XLA attention used for the v1 backward."""
+    """[B, H, T, D]-layout XLA attention (kept for tests/debugging)."""
     out = causal_attention(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), softmax_scale=scale)
@@ -146,15 +334,14 @@ def _xla_reference(q, k, v, scale):
 
 
 def _core_fwd(q, k, v, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _core_bwd(scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_reference(q_, k_, v_, scale),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, scale, block_q, block_k,
+                           interpret)
 
 
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)

@@ -1,0 +1,133 @@
+"""Ring attention: context parallelism over the ``sequence`` mesh axis.
+
+Long-context capability the reference does not have (SURVEY.md sec 2.3:
+no CP/ring/Ulysses anywhere; max seq 2048 in its configs) but that the
+TPU build treats as first-class. The sequence is sharded over the
+``sequence`` mesh axis; each device keeps its local Q shard resident and
+the K/V shards rotate around the ring with ``ppermute`` while an online
+softmax (same math as the pallas flash kernel,
+dla_tpu/ops/flash_attention.py) accumulates the output — so no device
+ever materializes more than [B, T/n, S/n] scores and the KV rotation
+rides the ICI ring links neighbor-to-neighbor.
+
+Implementation notes:
+- written to run INSIDE ``jax.shard_map`` (the public wrapper below sets
+  that up); shapes in ``_ring_local`` are per-device shards;
+- the ring loop is a ``lax.scan`` (not fori_loop) so reverse-mode
+  autodiff works: the VJP of ``ppermute`` is a ``ppermute`` with the
+  inverted permutation, and scan transposes cleanly — training through
+  ring attention needs no custom VJP;
+- causality, right-padding, and packed segments are all evaluated on
+  *global* metadata (absolute positions, validity, segment ids) that
+  rotates with K/V, so any chunk can attend to any other correctly
+  regardless of where it currently sits in the ring;
+- GQA: q is grouped to [B, K, G, Tl, D] exactly like
+  ops.attention.causal_attention — no materialized KV repeat.
+
+Ulysses (all-to-all over heads) is the alternative CP mode, in
+dla_tpu/ops/ulysses.py.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+SEQ_AXIS = "sequence"
+
+
+def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
+                *, axis_name: str, scale: float):
+    """Per-device ring attention. All args are local shards:
+
+    q [B, Tl, H, D]; k/v [B, Sl, K, D]; q_pos/q_seg [B, Tl];
+    kv_pos/kv_valid/kv_seg [B, Sl]. Returns [B, Tl, H, D].
+    """
+    b, tl, h, d = q.shape
+    _, sl, kh, _ = k.shape
+    groups = h // kh
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qg = q.reshape(b, tl, kh, groups, d).astype(jnp.float32)
+
+    m0 = jnp.full((b, kh, groups, tl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kh, groups, tl, 1), jnp.float32)
+    acc0 = jnp.zeros((b, kh, groups, tl, d), jnp.float32)
+
+    def step(carry, _):
+        m, l, acc, k_c, v_c, pos_c, valid_c, seg_c = carry
+        s = jnp.einsum("btkgd,bskd->bkgts", qg,
+                       k_c.astype(jnp.float32)) * scale     # [B,K,G,Tl,Sl]
+        mask = ((q_pos[:, :, None] >= pos_c[:, None, :])
+                & valid_c[:, None, :].astype(bool)
+                & (q_seg[:, :, None] == seg_c[:, None, :]))  # [B,Tl,Sl]
+        s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # guard the all-masked case: m_new == NEG_INF would make
+        # exp(s - m_new) == 1 on masked entries
+        safe = m_new > NEG_INF / 2
+        p = jnp.where(safe, jnp.exp(s - m_new), 0.0)
+        corr = jnp.where(safe, jnp.exp(m - m_new), 1.0)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum(
+            "bkgts,bskd->bkgtd", p, v_c.astype(jnp.float32))
+
+        rot = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        return (m_new, l, acc, rot(k_c), rot(v_c), rot(pos_c),
+                rot(valid_c), rot(seg_c)), None
+
+    (m, l, acc, *_), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v, kv_pos, kv_valid, kv_seg), None,
+        length=n)
+    out = acc / jnp.where(l == 0.0, 1.0, l)          # [B, K, G, Tl, D]
+    out = out.transpose(0, 3, 1, 2, 4)               # [B, Tl, K, G, D]
+    return out.reshape(b, tl, h, d).astype(q.dtype)
+
+
+def ring_causal_attention(
+    q: jnp.ndarray,        # [B, T, H, D] (sequence-sharded under the mesh)
+    k: jnp.ndarray,        # [B, S, K, D]
+    v: jnp.ndarray,        # [B, S, K, D]
+    *,
+    q_positions: jnp.ndarray,            # [B, T] absolute positions
+    kv_positions: jnp.ndarray,           # [B, S]
+    kv_valid: Optional[jnp.ndarray] = None,      # [B, S] 1 = real token
+    segment_ids: Optional[jnp.ndarray] = None,   # [B, T] packed-segment ids
+    mesh: Optional[jax.sharding.Mesh] = None,
+    softmax_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal (GQA) self-attention with the sequence dim ring-sharded.
+
+    Drop-in for ops.attention.causal_attention when the ambient mesh has
+    ``sequence > 1``; also correct (just pointless) at sequence == 1.
+    """
+    b, t, h, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or mesh.empty:
+            mesh = jax.sharding.get_mesh()
+    if kv_valid is None:
+        kv_valid = jnp.ones((b, k.shape[1]), jnp.int32)
+    if segment_ids is None:
+        segment_ids = jnp.zeros((b, t), jnp.int32)
+
+    batch = ("data", "fsdp")
+    qspec = P(batch, SEQ_AXIS, "model", None)
+    sspec = P(batch, SEQ_AXIS)
+
+    fn = jax.shard_map(
+        functools.partial(_ring_local, axis_name=SEQ_AXIS, scale=scale),
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec, sspec),
+        out_specs=qspec,
+        check_vma=False,
+    )
+    return fn(q, k, v, q_positions, kv_positions,
+              kv_valid.astype(jnp.int32), segment_ids, segment_ids)

@@ -1,0 +1,94 @@
+"""Ulysses-style sequence parallelism: all-to-all over attention heads.
+
+The alternative context-parallel mode to ring attention
+(dla_tpu/ops/ring_attention.py). Activations arrive sequence-sharded
+[B, T/n, H, D]; one ``all_to_all`` re-shards them head-wise to
+[B, T, H/n, D], each device runs ordinary full-sequence causal attention
+over its head slice, and a second ``all_to_all`` restores the sequence
+sharding. Two collectives per layer instead of ring's n ppermutes —
+cheaper for moderate sequence lengths, but requires
+``num_kv_heads % (sequence axis size) == 0`` (ring has no such
+constraint). New capability vs the reference (SURVEY.md sec 2.3: no CP of
+any kind).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dla_tpu.ops.attention import causal_attention
+
+SEQ_AXIS = "sequence"
+
+
+def _ulysses_local(q, k, v, q_pos, kv_pos, kv_valid, seg,
+                   *, axis_name: str, scale: float):
+    """Per-device: q [B, Tl, H, D], k/v [B, Tl, K, D], metadata [B, Tl]."""
+
+    def to_heads(x):  # [B, Tl, H, D] -> [B, T, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    gather = lambda x: jax.lax.all_gather(
+        x, axis_name, axis=1, tiled=True)                     # [B, T]
+    q_pos_g, kv_pos_g = gather(q_pos), gather(kv_pos)
+    kv_valid_g, seg_g = gather(kv_valid), gather(seg)
+
+    mask = kv_valid_g[:, None, :].astype(bool) & (
+        seg_g[:, :, None] == seg_g[:, None, :])
+    out = causal_attention(qh, kh, vh, kv_segment_mask=mask,
+                           q_positions=q_pos_g, kv_positions=kv_pos_g,
+                           softmax_scale=scale)               # [B, T, H/n, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)                     # [B, Tl, H, D]
+
+
+def ulysses_causal_attention(
+    q: jnp.ndarray,        # [B, T, H, D] (sequence-sharded under the mesh)
+    k: jnp.ndarray,        # [B, S, K, D]
+    v: jnp.ndarray,        # [B, S, K, D]
+    *,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    kv_valid: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    softmax_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal GQA self-attention, sequence dim sharded via head all-to-all."""
+    b, t, h, d = q.shape
+    kheads = k.shape[2]
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or mesh.empty:
+            mesh = jax.sharding.get_mesh()
+    n = mesh.shape[SEQ_AXIS]
+    tp = mesh.shape.get("model", 1)
+    h_local, kh_local = h // tp, kheads // tp
+    if h_local % n or kh_local % n:
+        raise ValueError(
+            f"ulysses needs sequence axis ({n}) to divide per-TP-shard heads "
+            f"({h_local}) and kv heads ({kh_local}); use ring attention instead")
+    if kv_valid is None:
+        kv_valid = jnp.ones((b, k.shape[1]), jnp.int32)
+    if segment_ids is None:
+        segment_ids = jnp.zeros((b, t), jnp.int32)
+
+    batch = ("data", "fsdp")
+    qspec = P(batch, SEQ_AXIS, "model", None)
+    sspec = P(batch, SEQ_AXIS)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=SEQ_AXIS, scale=scale),
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec),
+        out_specs=qspec,
+        check_vma=False,
+    )
+    return fn(q, k, v, q_positions, kv_positions,
+              kv_valid.astype(jnp.int32), segment_ids)

@@ -63,9 +63,19 @@ def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
     if "use_flash_attention" in model_cfg:
         out["attention"] = ("flash" if model_cfg["use_flash_attention"]
                             else "xla")
-    for key in ("dtype", "param_dtype", "remat", "vocab_size", "attention"):
+    for key in ("dtype", "param_dtype", "remat", "vocab_size", "attention",
+                "context_parallel"):
         if key in model_cfg:
             out[key] = model_cfg[key]
+    # reference model.lora block (config/distill_config.yaml:10-14; dead
+    # there, functional here — Transformer.init_lora)
+    lora = model_cfg.get("lora") or {}
+    if lora.get("enabled"):
+        out["lora_r"] = int(lora.get("r", 8))
+        out["lora_alpha"] = float(lora.get("alpha", 32.0))
+        out["lora_dropout"] = float(lora.get("dropout", 0.0))
+        if lora.get("target_modules"):
+            out["lora_targets"] = tuple(lora["target_modules"])
     return out
 
 
@@ -161,3 +171,32 @@ def model_aux(bundle: ModelBundle, tokenizer_name: Optional[str] = None
     if tokenizer_name:
         out["tokenizer"] = tokenizer_name
     return out
+
+
+def init_lora_adapters(bundle: ModelBundle, rng: jax.Array):
+    """(adapters, specs) for a LoRA run, with a rank-0 size report."""
+    from dla_tpu.utils.logging import log_rank_zero
+    adapters = bundle.model.init_lora(rng)
+    n_adapt = sum(int(l.size) for l in jax.tree.leaves(adapters))
+    n_base = sum(int(l.size) for l in jax.tree.leaves(bundle.params))
+    log_rank_zero(
+        f"[dla_tpu] LoRA r={bundle.config.lora_r}: "
+        f"{n_adapt:,} trainable / {n_base:,} frozen params")
+    return adapters, bundle.model.lora_partition_specs()
+
+
+def save_merged_lora_final(trainer, bundle: ModelBundle, base_params,
+                           tokenizer_name: Optional[str] = None) -> None:
+    """Re-write the `final` checkpoint with adapters folded into the base
+    weights so downstream phases (configs chain via checkpoints/X/latest)
+    load a plain model. Adapter step checkpoints remain for resume —
+    Trainer.try_resume falls back to them when `latest` names this
+    artifact."""
+    from dla_tpu.utils.logging import log_rank_zero
+    merged = bundle.model.merge_lora(base_params, trainer.params)
+    aux = {"step": trainer.step, **model_aux(bundle, tokenizer_name)}
+    aux["model_config"] = dataclasses.replace(
+        bundle.config, lora_r=0).to_dict()
+    trainer.checkpointer.save(
+        trainer.step, {"params": merged}, aux, tag="final")
+    log_rank_zero("[dla_tpu] wrote merged (LoRA-folded) final checkpoint")

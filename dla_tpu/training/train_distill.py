@@ -32,19 +32,31 @@ from dla_tpu.ops.losses import cross_entropy_loss, kl_distill_loss
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
-from dla_tpu.training.model_io import load_causal_lm, model_aux
+from dla_tpu.training.model_io import (
+    init_lora_adapters,
+    load_causal_lm,
+    model_aux,
+    save_merged_lora_final,
+)
 from dla_tpu.training.trainer import Trainer
 from dla_tpu.training.utils import seed_everything
 from dla_tpu.utils.logging import log_rank_zero
 
 
 def make_distill_loss(student_model, teacher_models: List[Any],
-                      use_kl: bool, temperature: float):
+                      use_kl: bool, temperature: float, lora: bool = False,
+                      train: bool = True):
     def loss_fn(params, frozen, batch, rng):
-        del rng
-        logits = student_model.apply(
-            params, batch["input_ids"],
-            attention_mask=batch["attention_mask"])
+        if lora:
+            logits = student_model.apply(
+                frozen["student_base"], batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                lora=params, dropout_rng=rng if train else None)
+        else:
+            del rng
+            logits = student_model.apply(
+                params, batch["input_ids"],
+                attention_mask=batch["attention_mask"])
         metrics = {"reward_mean": jnp.mean(batch["reward"])}
         if use_kl and teacher_models:
             teacher_logits = [
@@ -101,12 +113,29 @@ def main(argv=None) -> None:
             log_rank_zero(f"[dla_tpu] KL distillation from "
                           f"{len(teacher_models)} teacher(s), T={temperature}")
 
-        trainer = Trainer(
-            config=config, mesh=mesh,
-            loss_fn=make_distill_loss(student.model, teacher_models,
-                                      use_kl, temperature),
-            params=student.params, param_specs=student.specs,
-            frozen=frozen, frozen_specs=frozen_specs)
+        use_lora = student.config.lora_r > 0
+        if use_lora:
+            adapters, lora_specs = init_lora_adapters(
+                student, jax.random.fold_in(rng, 17))
+            frozen = {**(frozen or {}), "student_base": student.params}
+            frozen_specs = {**(frozen_specs or {}),
+                            "student_base": student.specs}
+            trainer = Trainer(
+                config=config, mesh=mesh,
+                loss_fn=make_distill_loss(student.model, teacher_models,
+                                          use_kl, temperature, lora=True),
+                eval_fn=make_distill_loss(student.model, teacher_models,
+                                          use_kl, temperature, lora=True,
+                                          train=False),
+                params=adapters, param_specs=lora_specs,
+                frozen=frozen, frozen_specs=frozen_specs)
+        else:
+            trainer = Trainer(
+                config=config, mesh=mesh,
+                loss_fn=make_distill_loss(student.model, teacher_models,
+                                          use_kl, temperature),
+                params=student.params, param_specs=student.specs,
+                frozen=frozen, frozen_specs=frozen_specs)
 
         data_cfg = {**config.get("data", {}),
                     "max_seq_length": student.config.max_seq_length}
@@ -121,6 +150,11 @@ def main(argv=None) -> None:
             train_it, rng=rng,
             data_state=train_it.state_dict, resume=args.resume,
             extra_aux=model_aux(student, model_cfg.get("tokenizer")))
+
+        if use_lora:
+            save_merged_lora_final(
+                trainer, student, trainer.frozen["student_base"],
+                model_cfg.get("tokenizer"))
 
 
 if __name__ == "__main__":

@@ -25,19 +25,33 @@ from dla_tpu.ops.losses import cross_entropy_loss
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
-from dla_tpu.training.model_io import load_causal_lm, model_aux
+from dla_tpu.training.model_io import (
+    init_lora_adapters,
+    load_causal_lm,
+    model_aux,
+    save_merged_lora_final,
+)
 from dla_tpu.training.trainer import Trainer
 from dla_tpu.training.utils import seed_everything
 from dla_tpu.utils.logging import log_rank_zero
 
 
-def make_sft_loss(model):
+def make_sft_loss(model, lora: bool = False, train: bool = True):
     def loss_fn(params, frozen, batch, rng):
-        del frozen, rng
-        logits = model.apply(
-            params, batch["input_ids"],
-            attention_mask=batch["attention_mask"],
-            segment_ids=batch.get("segment_ids"))
+        if lora:
+            # trainable tree = adapters; base weights ride in `frozen`.
+            # dropout only on the train path — eval runs deterministic.
+            logits = model.apply(
+                frozen, batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                segment_ids=batch.get("segment_ids"),
+                lora=params, dropout_rng=rng if train else None)
+        else:
+            del frozen, rng
+            logits = model.apply(
+                params, batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                segment_ids=batch.get("segment_ids"))
         loss, n_tokens = cross_entropy_loss(logits, batch["labels"])
         return loss, {"ce": loss, "tokens": n_tokens}
     return loss_fn
@@ -47,10 +61,20 @@ def build_trainer(config: Dict[str, Any], mesh, rng) -> tuple:
     model_cfg = config.get("model", {})
     bundle = load_causal_lm(
         model_cfg.get("model_name_or_path", "tiny"), model_cfg, rng)
-    trainer = Trainer(
-        config=config, mesh=mesh,
-        loss_fn=make_sft_loss(bundle.model),
-        params=bundle.params, param_specs=bundle.specs)
+    if bundle.config.lora_r > 0:
+        adapters, specs = init_lora_adapters(
+            bundle, jax.random.fold_in(rng, 17))
+        trainer = Trainer(
+            config=config, mesh=mesh,
+            loss_fn=make_sft_loss(bundle.model, lora=True),
+            eval_fn=make_sft_loss(bundle.model, lora=True, train=False),
+            params=adapters, param_specs=specs,
+            frozen=bundle.params, frozen_specs=bundle.specs)
+    else:
+        trainer = Trainer(
+            config=config, mesh=mesh,
+            loss_fn=make_sft_loss(bundle.model),
+            params=bundle.params, param_specs=bundle.specs)
     return trainer, bundle
 
 
@@ -99,6 +123,11 @@ def main(argv=None) -> None:
             data_state=train_it.state_dict, resume=args.resume,
             extra_aux=model_aux(
                 bundle, config.get("model", {}).get("tokenizer")))
+
+        if bundle.config.lora_r > 0:
+            save_merged_lora_final(
+                trainer, bundle, trainer.frozen,
+                config.get("model", {}).get("tokenizer"))
 
 
 if __name__ == "__main__":

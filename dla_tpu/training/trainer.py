@@ -334,8 +334,20 @@ class Trainer:
             return None
         shardings = {"params": self.param_shardings,
                      "opt_state": self.opt_state_shardings}
-        tree, aux = self.checkpointer.restore(
-            self._state_tree(), tag=tag, shardings=shardings)
+        try:
+            tree, aux = self.checkpointer.restore(
+                self._state_tree(), tag=tag, shardings=shardings)
+        except KeyError:
+            # `latest` may name an export artifact (e.g. the LoRA-merged
+            # final model written for phase chaining) whose tree doesn't
+            # match the training state; resume from the newest step
+            # checkpoint instead.
+            step_tag = self.checkpointer.newest_step_tag()
+            if step_tag is None or step_tag == tag:
+                raise
+            tag = step_tag
+            tree, aux = self.checkpointer.restore(
+                self._state_tree(), tag=tag, shardings=shardings)
         self.params = tree["params"]
         self.opt_state = tree["opt_state"]
         self.step = int(aux.get("step", 0))
