@@ -109,27 +109,46 @@ def main(argv=None) -> None:
     lat = config["latency"]
     model_extra = dict(config.get("model", {}))
 
+    # optional xplane trace of the measured grid (`latency.trace_dir`):
+    # the TPU-native replacement for the reference's nonexistent profiler
+    # story (SURVEY.md sec 5 "Tracing / profiling"). One trace per model,
+    # started AFTER load/compile so the dump holds the measured loops, not
+    # checkpoint IO. Process 0 only — multi-host writers would race on
+    # the directory.
+    trace_dir = lat.get("trace_dir") if jax.process_index() == 0 else None
+
     results: Dict[str, object] = {"hardware": lat.get("hardware", "tpu")}
     for model_name, model_path in config["models"].items():
-        log_rank_zero(f"[dla_tpu][latency] loading {model_name}: {model_path}")
+        log_rank_zero(
+            f"[dla_tpu][latency] loading {model_name}: {model_path}")
         bundle = load_causal_lm(str(model_path), model_extra, rng)
         entry: Dict[str, object] = {}
-        entry["forward"] = measure_forward(
-            bundle.model, bundle.params,
-            [int(b) for b in lat.get("batch_sizes", [1, 4, 8])],
-            [int(s) for s in lat.get("seq_lengths", [256, 512, 1024])],
-            int(lat.get("warmup_steps", 3)),
-            int(lat.get("measure_steps", 10)))
-        dec = lat.get("decode", {})
-        if dec.get("enabled", True):
-            entry["decode"] = measure_decode(
+        if trace_dir:
+            jax.profiler.start_trace(f"{trace_dir}/{model_name}")
+        try:
+            entry["forward"] = measure_forward(
                 bundle.model, bundle.params,
-                int(dec.get("batch_size", 8)),
-                int(dec.get("prompt_length", 128)),
-                int(dec.get("new_tokens", 64)))
-            log_rank_zero(f"[dla_tpu][latency] decode: "
-                          f"{entry['decode']['decode_tokens_per_second']:.0f}"
-                          " tok/s")
+                [int(b) for b in lat.get("batch_sizes", [1, 4, 8])],
+                [int(s) for s in lat.get("seq_lengths", [256, 512, 1024])],
+                int(lat.get("warmup_steps", 3)),
+                int(lat.get("measure_steps", 10)))
+            dec = lat.get("decode", {})
+            if dec.get("enabled", True):
+                entry["decode"] = measure_decode(
+                    bundle.model, bundle.params,
+                    int(dec.get("batch_size", 8)),
+                    int(dec.get("prompt_length", 128)),
+                    int(dec.get("new_tokens", 64)))
+                log_rank_zero(f"[dla_tpu][latency] decode: "
+                              f"{entry['decode']['decode_tokens_per_second']:.0f}"
+                              " tok/s")
+        finally:
+            # a mid-grid failure must not lose the already-captured trace
+            if trace_dir:
+                jax.profiler.stop_trace()
+                log_rank_zero(
+                    f"[dla_tpu][latency] xplane trace in "
+                    f"{trace_dir}/{model_name}")
         results[model_name] = entry
 
     out_path = Path(config.get("logging", {})

@@ -351,8 +351,10 @@ class Transformer:
                 positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
 
         # Context parallelism: when the ambient mesh shards `sequence`,
-        # attention runs ring/ulysses from 1-D metadata and the [B, T, T]
-        # mask is never materialized.
+        # attention runs ring/ulysses from 1-D metadata. Ring stays
+        # blockwise (no [B, T, T] mask); ulysses re-shards heads and still
+        # materializes full-length scores per head slice — prefer ring for
+        # very long sequences (see dla_tpu/ops/ulysses.py memory note).
         cp = None
         if cfg.context_parallel != "none" and _sequence_axis_size() > 1:
             kv_valid = (attention_mask if attention_mask is not None

@@ -10,6 +10,13 @@ cheaper for moderate sequence lengths, but requires
 ``num_kv_heads % (sequence axis size) == 0`` (ring has no such
 constraint). New capability vs the reference (SURVEY.md sec 2.3: no CP of
 any kind).
+
+Memory note: after the head all-to-all each device attends over the FULL
+sequence for its head slice, so scores are [B, H/n, T, T] and the
+segment/validity mask is [B, T, T] — full-length quadratic memory, unlike
+ring attention which stays blockwise ([B, Tl, Tl] per rotation step).
+Pick ring for very long sequences (>=16k); ulysses pays off at moderate T
+where two all-to-alls beat n ppermutes.
 """
 from __future__ import annotations
 

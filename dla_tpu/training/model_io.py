@@ -185,18 +185,32 @@ def init_lora_adapters(bundle: ModelBundle, rng: jax.Array):
     return adapters, bundle.model.lora_partition_specs()
 
 
+def require_no_lora(bundle: ModelBundle, phase: str) -> None:
+    """Trainers that don't wire adapters must refuse a LoRA config rather
+    than silently full-rank fine-tune (full AdamW state — OOM at 70B, and
+    not what the user asked for). SFT and distillation wire adapters;
+    DPO/reward/RLHF call this guard."""
+    if bundle.config.lora_r > 0:
+        raise ValueError(
+            f"model.lora is configured (r={bundle.config.lora_r}) but the "
+            f"{phase} trainer does not support LoRA adapters yet; train "
+            "adapters in SFT/distill, chain the merged checkpoint, or drop "
+            "the model.lora block")
+
+
 def save_merged_lora_final(trainer, bundle: ModelBundle, base_params,
                            tokenizer_name: Optional[str] = None) -> None:
-    """Re-write the `final` checkpoint with adapters folded into the base
-    weights so downstream phases (configs chain via checkpoints/X/latest)
-    load a plain model. Adapter step checkpoints remain for resume —
-    Trainer.try_resume falls back to them when `latest` names this
-    artifact."""
+    """Write a `merged` checkpoint with adapters folded into the base
+    weights so downstream phases (configs chain via checkpoints/X/latest —
+    save() repoints `latest` here) load a plain model. The adapter `final`
+    and step checkpoints remain intact for resume; Trainer.try_resume
+    falls back to them when `latest` names this export artifact."""
     from dla_tpu.utils.logging import log_rank_zero
     merged = bundle.model.merge_lora(base_params, trainer.params)
     aux = {"step": trainer.step, **model_aux(bundle, tokenizer_name)}
     aux["model_config"] = dataclasses.replace(
         bundle.config, lora_r=0).to_dict()
     trainer.checkpointer.save(
-        trainer.step, {"params": merged}, aux, tag="final")
-    log_rank_zero("[dla_tpu] wrote merged (LoRA-folded) final checkpoint")
+        trainer.step, {"params": merged}, aux, tag="merged")
+    log_rank_zero("[dla_tpu] wrote merged (LoRA-folded) checkpoint "
+                  "(`latest` -> merged; training state kept in `final`)")

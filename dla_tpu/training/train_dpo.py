@@ -25,7 +25,11 @@ from dla_tpu.ops.losses import dpo_loss, sequence_logprob_mean
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
-from dla_tpu.training.model_io import load_causal_lm, model_aux
+from dla_tpu.training.model_io import (
+    load_causal_lm,
+    model_aux,
+    require_no_lora,
+)
 from dla_tpu.training.trainer import Trainer
 from dla_tpu.training.utils import seed_everything
 
@@ -72,6 +76,7 @@ def main(argv=None) -> None:
             model_cfg.get("policy_model_name_or_path",
                           model_cfg.get("model_name_or_path", "tiny")),
             model_cfg, rng)
+        require_no_lora(policy, "DPO")
         ref_name = model_cfg.get("reference_model_name_or_path")
         if ref_name:
             ref = load_causal_lm(ref_name, model_cfg, rng)

@@ -24,7 +24,11 @@ from dla_tpu.ops.losses import pairwise_reward_loss
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
-from dla_tpu.training.model_io import build_reward_model, model_aux
+from dla_tpu.training.model_io import (
+    build_reward_model,
+    model_aux,
+    require_no_lora,
+)
 from dla_tpu.training.trainer import Trainer
 
 
@@ -68,6 +72,7 @@ def main(argv=None) -> None:
 
     with jax.sharding.set_mesh(mesh):
         bundle = build_reward_model(config.get("model", {}), rng)
+        require_no_lora(bundle, "reward")
         trainer = Trainer(
             config=config, mesh=mesh,
             loss_fn=make_reward_loss(bundle.model),

@@ -48,7 +48,12 @@ from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.parallel.sharding import local_numpy, make_global_batch
 from dla_tpu.training.config import config_from_args, make_arg_parser
-from dla_tpu.training.model_io import build_reward_model, load_causal_lm, model_aux
+from dla_tpu.training.model_io import (
+    build_reward_model,
+    load_causal_lm,
+    model_aux,
+    require_no_lora,
+)
 from dla_tpu.training.trainer import Trainer
 from dla_tpu.training.utils import seed_everything
 from dla_tpu.utils.logging import log_rank_zero
@@ -125,6 +130,7 @@ def main(argv=None) -> None:
     with jax.sharding.set_mesh(mesh):
         policy = load_causal_lm(
             model_cfg.get("policy_model_name_or_path", "tiny"), model_cfg, rng)
+        require_no_lora(policy, "RLHF")
         ref = load_causal_lm(
             model_cfg.get("reference_model_name_or_path",
                           model_cfg.get("policy_model_name_or_path", "tiny")),
@@ -201,81 +207,88 @@ def main(argv=None) -> None:
                 log_rank_zero(
                     f"[dla_tpu] resuming at rollout {rollout_idx}/{n_steps}")
 
-        while rollout_idx < n_steps:
-            # 1. sample + encode prompts (host, this rank's share only)
-            batch_prompts = [
-                PROMPT_TEMPLATE.format(prompt=p)
-                for p in (host_rng.sample(prompts, local_bs)
-                          if len(prompts) >= local_bs
-                          else host_rng.choices(prompts, k=local_bs))]
-            ids, mask = encode_prompt_batch(tok, batch_prompts, prompt_width)
-            gbatch = make_global_batch(
-                {"ids": ids, "mask": mask}, mesh)
+        try:
+            while rollout_idx < n_steps:
+                # 1. sample + encode prompts (host, this rank's share only)
+                batch_prompts = [
+                    PROMPT_TEMPLATE.format(prompt=p)
+                    for p in (host_rng.sample(prompts, local_bs)
+                              if len(prompts) >= local_bs
+                              else host_rng.choices(prompts, k=local_bs))]
+                ids, mask = encode_prompt_batch(tok, batch_prompts, prompt_width)
+                gbatch = make_global_batch(
+                    {"ids": ids, "mask": mask}, mesh)
 
-            # 2. rollout (jitted scan decode) + 3. score (jitted SPMD)
-            roll_rng = jax.random.fold_in(rng, 10_000 + rollout_idx)
-            out = generate_fn(trainer.params, gbatch["ids"], gbatch["mask"],
-                              roll_rng)
-            scores = score_fn(trainer.params, ref_params, rm_params,
-                              out["sequences"], out["sequence_mask"],
-                              jnp.float32(kl_coef))
+                # 2. rollout (jitted scan decode) + 3. score (jitted SPMD)
+                roll_rng = jax.random.fold_in(rng, 10_000 + rollout_idx)
+                out = generate_fn(trainer.params, gbatch["ids"], gbatch["mask"],
+                                  roll_rng)
+                scores = score_fn(trainer.params, ref_params, rm_params,
+                                  out["sequences"], out["sequence_mask"],
+                                  jnp.float32(kl_coef))
 
-            # 4. update(s) — token arrays cross to host for minibatch slicing
-            up = {
-                "sequences": local_numpy(out["sequences"]),
-                "sequence_mask": local_numpy(out["sequence_mask"]),
-                "advantages": local_numpy(scores["advantages"]),
-                "behavior_logp": local_numpy(scores["behavior_logp"]),
-            }
-            losses = []
-            if algo == "ppo":
-                n_local_mb = max(1, local_bs * jax.process_count() // mini_batch)
-                local_mb = up["sequences"].shape[0] // n_local_mb
-                for epoch in range(ppo_epochs):
-                    order = np.random.default_rng(
-                        (rollout_idx, epoch)).permutation(
-                            up["sequences"].shape[0])
-                    for k in range(n_local_mb):
-                        sl = order[k * local_mb:(k + 1) * local_mb]
-                        mb = {key: v[sl] for key, v in up.items()}
-                        loss, _ = trainer.step_on_batch(
-                            mb, jax.random.fold_in(rng, trainer.step))
-                        losses.append(loss)
-            else:
-                loss, _ = trainer.step_on_batch(
-                    up, jax.random.fold_in(rng, trainer.step))
-                losses.append(loss)
-
-            kl_now = float(scores["kl"])
-            if algo == "ppo" and target_kl:
-                # adaptive KL controller on the dead-in-reference target_kl
-                if kl_now > 1.5 * float(target_kl):
-                    kl_coef *= 2.0
-                elif kl_now < float(target_kl) / 1.5:
-                    kl_coef *= 0.5
-
-            rollout_idx += 1
-            if rollout_idx % int(config.get("logging", {})
-                                 .get("log_every_steps", 10)) == 0:
-                payload = {
-                    "train/loss": float(np.mean(losses)),
-                    "train/kl": kl_now,
-                    "train/kl_coef": kl_coef,
-                    "train/reward_mean": float(scores["reward_mean"]),
-                    "train/rm_score_mean": float(scores["rm_score_mean"]),
-                    "train/response_len": float(
-                        np.mean(local_numpy(out["response_mask"]).sum(-1))),
+                # 4. update(s) — token arrays cross to host for minibatch slicing
+                up = {
+                    "sequences": local_numpy(out["sequences"]),
+                    "sequence_mask": local_numpy(out["sequence_mask"]),
+                    "advantages": local_numpy(scores["advantages"]),
+                    "behavior_logp": local_numpy(scores["behavior_logp"]),
                 }
-                trainer.logger.log(payload, rollout_idx)
-                log_rank_zero(
-                    f"rollout {rollout_idx}: reward "
-                    f"{payload['train/reward_mean']:.4f} kl {kl_now:.4f}")
+                losses = []
+                if algo == "ppo":
+                    n_local_mb = max(1, local_bs * jax.process_count() // mini_batch)
+                    local_mb = up["sequences"].shape[0] // n_local_mb
+                    for epoch in range(ppo_epochs):
+                        order = np.random.default_rng(
+                            (rollout_idx, epoch)).permutation(
+                                up["sequences"].shape[0])
+                        for k in range(n_local_mb):
+                            sl = order[k * local_mb:(k + 1) * local_mb]
+                            mb = {key: v[sl] for key, v in up.items()}
+                            loss, _ = trainer.step_on_batch(
+                                mb, jax.random.fold_in(rng, trainer.step))
+                            losses.append(loss)
+                else:
+                    loss, _ = trainer.step_on_batch(
+                        up, jax.random.fold_in(rng, trainer.step))
+                    losses.append(loss)
 
-            save_every = int(config.get("logging", {})
-                             .get("save_every_steps", 0))
-            if save_every and rollout_idx % save_every == 0:
-                trainer.save(extra_aux=model_aux(
-                    policy, model_cfg.get("tokenizer")))
+                kl_now = float(scores["kl"])
+                if algo == "ppo" and target_kl:
+                    # adaptive KL controller on the dead-in-reference target_kl
+                    if kl_now > 1.5 * float(target_kl):
+                        kl_coef *= 2.0
+                    elif kl_now < float(target_kl) / 1.5:
+                        kl_coef *= 0.5
+
+                rollout_idx += 1
+                if rollout_idx % int(config.get("logging", {})
+                                     .get("log_every_steps", 10)) == 0:
+                    payload = {
+                        "train/loss": float(np.mean(losses)),
+                        "train/kl": kl_now,
+                        "train/kl_coef": kl_coef,
+                        "train/reward_mean": float(scores["reward_mean"]),
+                        "train/rm_score_mean": float(scores["rm_score_mean"]),
+                        "train/response_len": float(
+                            np.mean(local_numpy(out["response_mask"]).sum(-1))),
+                    }
+                    trainer.logger.log(payload, rollout_idx)
+                    log_rank_zero(
+                        f"rollout {rollout_idx}: reward "
+                        f"{payload['train/reward_mean']:.4f} kl {kl_now:.4f}")
+
+                save_every = int(config.get("logging", {})
+                                 .get("save_every_steps", 0))
+                if save_every and rollout_idx % save_every == 0:
+                    trainer.save(extra_aux=model_aux(
+                        policy, model_cfg.get("tokenizer")))
+
+        finally:
+            # the rollout loop drives step_on_batch directly (no
+            # fit()), so it owns closing an in-flight
+            # logging.profile trace window on exit or error
+            trainer.profile.close()
 
         trainer.save(extra_aux=model_aux(policy, model_cfg.get("tokenizer")),
                      tag="final")

@@ -38,6 +38,7 @@ from dla_tpu.parallel.sharding import (
 from dla_tpu.training.optim import build_optimizer
 from dla_tpu.training.utils import StepTimer, check_batch_identity
 from dla_tpu.utils.logging import MetricsLogger, RunningMean, log_rank_zero
+from dla_tpu.utils.profiling import ProfileWindow, apply_debug_flags, step_annotation
 
 Pytree = Any
 LossFn = Callable[[Pytree, Pytree, Dict[str, jnp.ndarray], jax.Array],
@@ -64,6 +65,8 @@ class Trainer:
 
         opt_cfg = dict(config.get("optimization", {}))
         hw_cfg = dict(config.get("hardware", {}))
+        # numerics/compile debug toggles must land before the first compile
+        apply_debug_flags(hw_cfg)
         # accept the reference's placement of grad-accum under hardware:
         opt_cfg.setdefault("gradient_accumulation_steps",
                            hw_cfg.get("gradient_accumulation_steps", 1))
@@ -117,6 +120,10 @@ class Trainer:
         self.log_every = int(log_cfg.get("log_every_steps", 10))
         self.eval_every = int(log_cfg.get("eval_every_steps", 0))
         self.save_every = int(log_cfg.get("save_every_steps", 0))
+        # one window per trainer so externally-driven loops (RLHF rollout
+        # driving step_on_batch) honor logging.profile too; such drivers
+        # must call trainer.profile.close() when their loop ends
+        self.profile = ProfileWindow(log_cfg.get("profile"))
 
     # ------------------------------------------------------------ the step
 
@@ -230,8 +237,10 @@ class Trainer:
         rollout loop drives this instead of fit())."""
         batch = self.place_batch(np_batch)
         step_fn = self.compile_train_step()
-        self.params, self.opt_state, loss, metrics = step_fn(
-            self.params, self.opt_state, self.frozen, batch, rng)
+        self.profile.on_step(self.step)
+        with step_annotation(self.step):
+            self.params, self.opt_state, loss, metrics = step_fn(
+                self.params, self.opt_state, self.frozen, batch, rng)
         self.step += 1
         return float(loss), {k: float(v) for k, v in metrics.items()}
 
@@ -261,34 +270,42 @@ class Trainer:
                 train_iter.load_state_dict(aux["data_state"])
 
         gen = iter(train_iter)
-        while self.step < self.max_steps:
-            np_batch = next(gen)
-            n_tokens = _count_tokens(np_batch, tokens_per_batch_key) \
-                * jax.process_count()
-            batch = self.place_batch(np_batch)
-            step_rng = jax.random.fold_in(rng, self.step)
-            self.params, self.opt_state, loss, metrics = step_fn(
-                self.params, self.opt_state, self.frozen, batch, step_rng)
-            self.step += 1
-            timer.tick(n_tokens)
-            running.update(float(loss))
+        try:
+            while self.step < self.max_steps:
+                np_batch = next(gen)
+                n_tokens = _count_tokens(np_batch, tokens_per_batch_key) \
+                    * jax.process_count()
+                batch = self.place_batch(np_batch)
+                step_rng = jax.random.fold_in(rng, self.step)
+                self.profile.on_step(self.step)
+                with step_annotation(self.step):
+                    self.params, self.opt_state, loss, metrics = step_fn(
+                        self.params, self.opt_state, self.frozen, batch,
+                        step_rng)
+                self.step += 1
+                timer.tick(n_tokens)
+                running.update(float(loss))
 
-            if self.step % self.log_every == 0:
-                payload = {"train/loss": running.average,
-                           "train/loss_instant": float(loss),
-                           "train/lr": float(self.schedule(self.step)),
-                           **{f"train/{k}": float(v) for k, v in metrics.items()},
-                           **timer.rates()}
-                self.logger.log(payload, self.step)
-                log_rank_zero(
-                    f"step {self.step}: loss {running.average:.4f} "
-                    f"({payload.get('tokens_per_sec_per_chip', 0):.0f} tok/s/chip)")
+                if self.step % self.log_every == 0:
+                    payload = {"train/loss": running.average,
+                               "train/loss_instant": float(loss),
+                               "train/lr": float(self.schedule(self.step)),
+                               **{f"train/{k}": float(v)
+                                  for k, v in metrics.items()},
+                               **timer.rates()}
+                    self.logger.log(payload, self.step)
+                    log_rank_zero(
+                        f"step {self.step}: loss {running.average:.4f} "
+                        f"({payload.get('tokens_per_sec_per_chip', 0):.0f} tok/s/chip)")
 
-            if self.eval_every and eval_iter_fn and self.step % self.eval_every == 0:
-                self.run_eval(eval_iter_fn, eval_batches, rng)
+                if self.eval_every and eval_iter_fn and self.step % self.eval_every == 0:
+                    self.run_eval(eval_iter_fn, eval_batches, rng)
 
-            if self.save_every and self.step % self.save_every == 0:
-                self.save(data_state() if data_state else None, extra_aux)
+                if self.save_every and self.step % self.save_every == 0:
+                    self.save(data_state() if data_state else None, extra_aux)
+        finally:
+            # a failed step must not lose an already-open trace window
+            self.profile.close()
 
         self.save(data_state() if data_state else None, extra_aux, tag="final")
         self.logger.finish()
@@ -337,17 +354,32 @@ class Trainer:
         try:
             tree, aux = self.checkpointer.restore(
                 self._state_tree(), tag=tag, shardings=shardings)
-        except KeyError:
+        except KeyError as exc:
             # `latest` may name an export artifact (e.g. the LoRA-merged
-            # final model written for phase chaining) whose tree doesn't
-            # match the training state; resume from the newest step
-            # checkpoint instead.
-            step_tag = self.checkpointer.newest_step_tag()
-            if step_tag is None or step_tag == tag:
+            # model written for phase chaining) whose tree doesn't match
+            # the training state; fall back to the newest full training
+            # checkpoint (`final`, then step_*). Loud, so a genuinely
+            # corrupt checkpoint isn't mistaken for a normal resume.
+            fallbacks = [t for t in ("final",
+                                     self.checkpointer.newest_step_tag())
+                         if t and t != tag
+                         and (self.checkpointer.dir / t).is_dir()]
+            if not fallbacks:
                 raise
-            tag = step_tag
-            tree, aux = self.checkpointer.restore(
-                self._state_tree(), tag=tag, shardings=shardings)
+            log_rank_zero(
+                f"[dla_tpu] `{tag}` is not a resumable training state "
+                f"({exc}); trying {fallbacks}")
+            tree = aux = None
+            for fb in fallbacks:
+                try:
+                    tree, aux = self.checkpointer.restore(
+                        self._state_tree(), tag=fb, shardings=shardings)
+                    tag = fb
+                    break
+                except KeyError:
+                    continue
+            if tree is None:
+                raise
         self.params = tree["params"]
         self.opt_state = tree["opt_state"]
         self.step = int(aux.get("step", 0))

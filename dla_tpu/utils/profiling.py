@@ -1,0 +1,121 @@
+"""Tracing / profiling / numerics-debug subsystem.
+
+The reference's only perf tooling is ``torch.cuda.synchronize`` +
+``perf_counter`` around forward passes (reference src/eval/eval_latency.py:
+45-53) and it has no sanitizers beyond seeding (reference
+src/training/utils.py:24-29; SURVEY.md sec 5 rows "Tracing / profiling"
+and "Race detection / sanitizers"). TPU-native replacement:
+
+- **Trace capture**: ``ProfileWindow`` wraps ``jax.profiler.start_trace``
+  / ``stop_trace`` around a configured step range, dumping an xplane
+  trace viewable in TensorBoard/XProf/Perfetto. Config-gated::
+
+      logging:
+        profile:
+          trace_dir: logs/trace      # where the xplane dump goes
+          start_step: 10             # first profiled step
+          num_steps: 3               # how many steps to capture
+
+- **Step annotations**: every trainer step runs under
+  ``jax.profiler.StepTraceAnnotation`` so traces segment per-step.
+
+- **Live profiler server**: ``hardware.profiler_port: 9999`` starts
+  ``jax.profiler.start_server`` for on-demand capture from TensorBoard
+  while a long run is in flight.
+
+- **Numerics debugging** (the JAX analog of a sanitizer pass):
+  ``hardware.debug_nans`` / ``hardware.debug_infs`` flip
+  ``jax.config.jax_debug_nans`` / ``jax_debug_infs`` — every jitted step
+  then re-runs op-by-op on a non-finite result and raises at the exact
+  primitive. ``hardware.log_compiles`` surfaces recompilation storms.
+  Data races are absent by construction (pure functional transforms),
+  so these flags are the whole sanitizer surface.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+import jax
+
+_SERVER = None  # keep a ref so the profiler server outlives the call
+
+
+def apply_debug_flags(hardware_cfg: Optional[Dict[str, Any]]) -> None:
+    """Apply numerics/compile debug toggles from the ``hardware:`` block.
+
+    Idempotent and cheap; called by the Trainer before the first compile so
+    the flags affect the jitted step. Unknown keys are ignored (GPU-era
+    keys like ``deepspeed_config`` pass through harmlessly, SURVEY.md
+    sec 7 "tolerating the GPU-era keys").
+    """
+    cfg = hardware_cfg or {}
+    if "debug_nans" in cfg:
+        jax.config.update("jax_debug_nans", bool(cfg["debug_nans"]))
+    if "debug_infs" in cfg:
+        jax.config.update("jax_debug_infs", bool(cfg["debug_infs"]))
+    if "log_compiles" in cfg:
+        jax.config.update("jax_log_compiles", bool(cfg["log_compiles"]))
+    port = cfg.get("profiler_port")
+    if port:
+        global _SERVER
+        if _SERVER is None:
+            _SERVER = jax.profiler.start_server(int(port))
+
+
+class ProfileWindow:
+    """Capture a jax.profiler trace over steps [start_step, start_step+num).
+
+    Driven by the trainer loop: call ``on_step(step)`` before each step and
+    ``close()`` when the loop ends (also stops a window that was cut short
+    by max_steps). Only process 0 captures — one host's trace is
+    representative under SPMD and multi-host writers would race on the
+    same directory.
+    """
+
+    def __init__(self, profile_cfg: Optional[Dict[str, Any]]):
+        cfg = profile_cfg or {}
+        self.trace_dir = cfg.get("trace_dir")
+        self.start_step = int(cfg.get("start_step", 1))
+        self.num_steps = int(cfg.get("num_steps", 3))
+        self.enabled = bool(self.trace_dir) and jax.process_index() == 0
+        self._active = False
+        self._done = False
+        self._captured = 0
+
+    def on_step(self, step: int) -> None:
+        """Call before dispatching ``step``. `>=` (not `==`) so a run
+        resumed past start_step still captures a window. Callers
+        synchronize on each step's outputs (the trainer's ``float(loss)``)
+        before the next ``on_step``, so captured steps are fully on-device
+        by the time the window closes."""
+        if not self.enabled or self._done:
+            return
+        if self._active:
+            self._captured += 1
+            if self._captured >= self.num_steps:
+                self._stop()
+        elif step >= self.start_step:
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+
+    def close(self) -> None:
+        if self._active:
+            self._stop()
+
+    def _stop(self) -> None:
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+
+
+def step_annotation(step: int):
+    """Per-step trace annotation; no-op cost when no trace is active."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region for traces (host-side; device ops inside still fuse)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -135,9 +135,10 @@ def test_sft_trainer_lora_loss_falls(mesh8):
 
 
 def test_resume_skips_merged_final_artifact(mesh8, tmp_path):
-    """After a LoRA run writes its merged `final` export (params-only),
-    `latest` names it — resume must fall back to the newest adapter step
-    checkpoint instead of crashing on the mismatched tree."""
+    """After a LoRA run writes its merged export (params-only, tag
+    `merged`), `latest` names it — resume must fall back to the newest
+    adapter training checkpoint instead of crashing on the mismatched
+    tree."""
     from dla_tpu.training.model_io import (
         load_causal_lm, save_merged_lora_final)
     from dla_tpu.training.train_sft import build_trainer
@@ -166,7 +167,7 @@ def test_resume_skips_merged_final_artifact(mesh8, tmp_path):
         for i in range(2):
             trainer.step_on_batch(batch, jax.random.fold_in(rng, i))
         trainer.save()                       # adapter step checkpoint
-        save_merged_lora_final(trainer, bundle, trainer.frozen)  # latest->final
+        save_merged_lora_final(trainer, bundle, trainer.frozen)  # latest->merged
 
         trainer2, _ = build_trainer(config, mesh8, rng)
         aux = trainer2.try_resume()
@@ -174,3 +175,52 @@ def test_resume_skips_merged_final_artifact(mesh8, tmp_path):
         # and the merged artifact chains: a fresh model loads from `latest`
         merged = load_causal_lm(str(tmp_path), {}, rng)
         assert merged.config.lora_r == 0
+
+
+def test_lora_run_without_step_checkpoints_still_resumable(mesh8, tmp_path):
+    """save_every_steps=0 run: the only full training state is `final`
+    (adapters+opt_state). The merged export must not clobber it, and
+    resume must find it through the `latest` -> merged indirection."""
+    from dla_tpu.training.model_io import save_merged_lora_final
+    from dla_tpu.training.train_sft import build_trainer
+
+    config = {
+        "experiment_name": "lora_final_only",
+        "model": {"model_name_or_path": "tiny", "tokenizer": "byte",
+                  "lora": {"enabled": True, "r": 2, "alpha": 4}},
+        "optimization": {"total_batch_size": 4, "micro_batch_size": 1,
+                         "learning_rate": 1e-3, "max_train_steps": 2,
+                         "lr_scheduler": "constant", "max_grad_norm": 1.0},
+        "logging": {"output_dir": str(tmp_path), "log_dir": None},
+        "hardware": {"gradient_accumulation_steps": 1},
+    }
+    rng = jax.random.key(0)
+    rs = np.random.RandomState(0)
+    with jax.sharding.set_mesh(mesh8):
+        trainer, bundle = build_trainer(config, mesh8, rng)
+        batch = {
+            "input_ids": rs.randint(
+                1, bundle.config.vocab_size, (4, 16)).astype(np.int32),
+            "attention_mask": np.ones((4, 16), np.int32),
+            "labels": rs.randint(
+                1, bundle.config.vocab_size, (4, 16)).astype(np.int32),
+        }
+        trainer.step_on_batch(batch, rng)
+        trainer.save(tag="final")            # end-of-fit training state
+        save_merged_lora_final(trainer, bundle, trainer.frozen)
+
+        trainer2, _ = build_trainer(config, mesh8, rng)
+        aux = trainer2.try_resume()
+        assert aux is not None and trainer2.step == 1
+
+
+def test_unwired_trainers_reject_lora_config():
+    from dla_tpu.training.model_io import load_causal_lm, require_no_lora
+
+    bundle = load_causal_lm(
+        "tiny", {"tokenizer": "byte", "lora": {"enabled": True, "r": 4}},
+        jax.random.key(0))
+    with pytest.raises(ValueError, match="DPO trainer does not support"):
+        require_no_lora(bundle, "DPO")
+    plain = load_causal_lm("tiny", {"tokenizer": "byte"}, jax.random.key(0))
+    require_no_lora(plain, "DPO")  # no-op without adapters

@@ -1,0 +1,86 @@
+"""Profiling / numerics-debug subsystem (SURVEY.md sec 5 rows
+"Tracing / profiling" and "Race detection / sanitizers")."""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dla_tpu.utils.profiling import (
+    ProfileWindow,
+    annotate,
+    apply_debug_flags,
+    step_annotation,
+)
+
+
+def test_profile_window_captures_trace(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    window = ProfileWindow(
+        {"trace_dir": trace_dir, "start_step": 2, "num_steps": 2})
+    assert window.enabled
+    x = jax.numpy.ones((8, 8))
+    fn = jax.jit(lambda a: a @ a)
+    for step in range(6):
+        window.on_step(step)
+        with step_annotation(step):
+            fn(x).block_until_ready()
+    window.close()
+    # an xplane dump must exist under trace_dir
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found += [f for f in files if f.endswith(".xplane.pb")]
+    assert found, f"no xplane trace written under {trace_dir}"
+
+
+def test_profile_window_disabled_without_dir():
+    window = ProfileWindow(None)
+    assert not window.enabled
+    window.on_step(1)  # all no-ops
+    window.close()
+
+
+def test_profile_window_cut_short_stops_cleanly(tmp_path):
+    window = ProfileWindow(
+        {"trace_dir": str(tmp_path / "t"), "start_step": 0, "num_steps": 100})
+    window.on_step(0)
+    assert window._active
+    window.close()  # loop ended mid-window; must stop the trace
+    assert not window._active
+
+
+def test_profile_window_fires_when_resumed_past_start(tmp_path):
+    # a run resumed at step 500 with start_step 10 must still capture
+    trace_dir = str(tmp_path / "resumed")
+    window = ProfileWindow(
+        {"trace_dir": trace_dir, "start_step": 10, "num_steps": 1})
+    x = jax.numpy.ones((4, 4))
+    for step in range(500, 504):
+        window.on_step(step)
+        jax.jit(lambda a: a + 1)(x).block_until_ready()
+    window.close()
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found += [f for f in files if f.endswith(".xplane.pb")]
+    assert found, "resumed run never opened its profile window"
+
+
+def test_annotate_is_usable_outside_trace():
+    with annotate("region"):
+        jax.numpy.zeros((2,)).block_until_ready()
+
+
+def test_debug_nans_flag_catches_nan():
+    apply_debug_flags({"debug_nans": True})
+    try:
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: x / 0.0)(np.float32(0.0)).block_until_ready()
+    finally:
+        apply_debug_flags({"debug_nans": False})
+    # off again: same op runs silently
+    jax.jit(lambda x: x / 0.0)(np.float32(0.0)).block_until_ready()
+
+
+def test_apply_debug_flags_ignores_gpu_era_keys():
+    apply_debug_flags({"deepspeed_config": "config/deepspeed_zero3.json",
+                       "mixed_precision": "bf16", "num_processes": 8})
