@@ -17,39 +17,71 @@ import numpy as np
 from dla_tpu.data.datasets import IGNORE_INDEX
 
 
+def pack_first_fit_python(lengths: np.ndarray, max_length: int,
+                          close_margin: int):
+    """Reference implementation of greedy first-fit placement. Returns
+    (row_assignment per example, n_rows). The native packer
+    (dla_tpu/native) must match this bit-for-bit — tests enforce it."""
+    assign = np.empty(len(lengths), np.int32)
+    row_len: List[int] = []
+    open_rows: List[int] = []
+    for i, raw in enumerate(lengths):
+        n = min(int(raw), max_length)
+        placed = False
+        for r in open_rows:
+            if row_len[r] + n <= max_length:
+                row_len[r] += n
+                assign[i] = r
+                placed = True
+                break
+        if not placed:
+            row_len.append(n)
+            open_rows.append(len(row_len) - 1)
+            assign[i] = len(row_len) - 1
+        open_rows = [r for r in open_rows
+                     if row_len[r] + close_margin <= max_length]
+    return assign, len(row_len)
+
+
 class PackedInstructionDataset:
     """Greedy first-fit packing of tokenized instruction examples into rows
     of exactly ``max_length`` tokens. Presents the same dataset protocol
     (__len__/__getitem__/collate) as InstructionDataset, so it is a drop-in
     for the trainer's iterator."""
 
+    CLOSE_MARGIN = 8  # close rows that cannot take even a tiny example
+
     def __init__(self, base, max_length: int):
         """``base``: an InstructionDataset (or anything yielding dicts with
         input_ids/attention_mask/labels 1-D arrays)."""
         self.max_length = max_length
         self.pad_token_id = base.tokenizer.pad_token_id
-        self.rows: List[List[Dict[str, np.ndarray]]] = []
-        open_rows: List[int] = []   # indices into self.rows still open
-        lengths: List[int] = []
+        examples: List[Dict[str, np.ndarray]] = []
         for i in range(len(base)):
             ex = base[i]
-            n = int(ex["input_ids"].shape[0])
-            if n > max_length:
+            if int(ex["input_ids"].shape[0]) > max_length:
                 ex = {k: v[:max_length] for k, v in ex.items()}
-                n = max_length
-            placed = False
-            for open_i in open_rows:
-                if lengths[open_i] + n <= max_length:
-                    self.rows[open_i].append(ex)
-                    lengths[open_i] += n
-                    placed = True
-                    break
-            if not placed:
-                self.rows.append([ex])
-                lengths.append(n)
-                open_rows.append(len(self.rows) - 1)
-            # close rows that cannot take even a tiny example
-            open_rows = [r for r in open_rows if lengths[r] + 8 <= max_length]
+            examples.append(ex)
+        lengths = np.asarray(
+            [int(ex["input_ids"].shape[0]) for ex in examples], np.int32)
+        assign, n_rows = self._place(lengths)
+        self.rows = [[] for _ in range(n_rows)]
+        for ex, r in zip(examples, assign):
+            self.rows[int(r)].append(ex)
+
+    def _place(self, lengths: np.ndarray):
+        """Row assignment per example: native C++ first-fit when built
+        (dla_tpu/native/src/dla_data.cpp dla_pack_ffd — placement is
+        bit-identical), else the pure-Python loop."""
+        try:
+            from dla_tpu import native
+            out = native.pack_ffd(lengths, self.max_length, self.CLOSE_MARGIN)
+            if out is not None:
+                return out
+        except Exception:  # noqa: BLE001 — fall through to Python packer
+            pass
+        return pack_first_fit_python(
+            lengths, self.max_length, self.CLOSE_MARGIN)
 
     def __len__(self) -> int:
         return len(self.rows)

@@ -1,0 +1,104 @@
+"""ctypes bindings for the native data-plane library.
+
+First-party C++ host-runtime kernels (src/dla_data.cpp): mmap JSONL line
+indexing and first-fit sequence packing. The reference gets its native
+data path from torch/HF internals; here it is owned code with a pure-
+Python fallback, so every consumer calls through these wrappers and works
+identically with or without a toolchain:
+
+    from dla_tpu import native
+    if native.available(): native.jsonl_index(path) / native.pack_ffd(...)
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dla_tpu.native.build import ensure_built
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = ensure_built()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+            lib.dla_jsonl_count.argtypes = [ctypes.c_char_p]
+            lib.dla_jsonl_count.restype = ctypes.c_int64
+            lib.dla_jsonl_offsets.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+            ]
+            lib.dla_jsonl_offsets.restype = ctypes.c_int64
+            lib.dla_pack_ffd.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.dla_pack_ffd.restype = ctypes.c_int64
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def jsonl_index(path) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """[start, end) byte offsets of each non-empty line, or None when the
+    native library is unavailable / the file is unreadable."""
+    lib = _load()
+    if lib is None:
+        return None
+    raw = str(Path(path)).encode()
+    n = lib.dla_jsonl_count(raw)
+    if n < 0:
+        return None
+    starts = np.empty(n, np.int64)
+    ends = np.empty(n, np.int64)
+    if n:
+        got = lib.dla_jsonl_offsets(
+            raw,
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n)
+        if got != n:
+            return None
+    return starts, ends
+
+
+def pack_ffd(lengths: np.ndarray, max_length: int,
+             close_margin: int = 8) -> Optional[Tuple[np.ndarray, int]]:
+    """First-fit packing of ``lengths`` into rows of ``max_length``.
+    Returns (row_assignment[i] per example, n_rows), or None when the
+    native library is unavailable. Placement is bit-identical to the
+    Python packer in dla_tpu/data/packing.py."""
+    lib = _load()
+    if lib is None:
+        return None
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    assign = np.empty(lengths.shape[0], np.int32)
+    n_rows = lib.dla_pack_ffd(
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        lengths.shape[0], int(max_length), int(close_margin),
+        assign.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if n_rows < 0:
+        return None
+    return assign, int(n_rows)

@@ -1,0 +1,52 @@
+"""Lazy build of the native data-plane library (g++ -O3 -shared -fPIC).
+
+Compiles dla_tpu/native/src/dla_data.cpp into _lib/libdla_data.so on
+first use and caches it; recompiles when the source is newer than the
+binary. Never raises: any failure (no toolchain, read-only tree) returns
+None and callers fall back to pure Python. Set DLA_NATIVE=0 to disable.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+_HERE = Path(__file__).resolve().parent
+SRC = _HERE / "src" / "dla_data.cpp"
+LIB_DIR = _HERE / "_lib"
+LIB = LIB_DIR / "libdla_data.so"
+
+
+def ensure_built(quiet: bool = True) -> Optional[Path]:
+    if os.environ.get("DLA_NATIVE", "1") == "0":
+        return None
+    try:
+        if LIB.exists():
+            # a prebuilt binary without the source tree is still usable
+            if not SRC.exists() or LIB.stat().st_mtime >= SRC.stat().st_mtime:
+                return LIB
+        if not SRC.exists():
+            return None
+        LIB_DIR.mkdir(parents=True, exist_ok=True)
+        tmp = LIB_DIR / f".libdla_data.{os.getpid()}.so"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               str(SRC), "-o", str(tmp)]
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        if res.returncode != 0:
+            if not quiet:
+                print(f"[dla_tpu] native build failed:\n"
+                      f"{res.stderr.decode(errors='replace')}")
+            tmp.unlink(missing_ok=True)
+            return None
+        tmp.rename(LIB)  # atomic: concurrent builders race benignly
+        return LIB
+    except Exception as exc:  # noqa: BLE001 — fallback must never raise
+        if not quiet:
+            print(f"[dla_tpu] native build unavailable: {exc}")
+        return None
+
+
+if __name__ == "__main__":
+    path = ensure_built(quiet=False)
+    print(path if path else "native build unavailable; Python fallback in use")

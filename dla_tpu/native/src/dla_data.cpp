@@ -1,0 +1,152 @@
+// Native data-plane kernels for dla_tpu.
+//
+// The reference's data path rides torch DataLoader / HF datasets (C++
+// inside those libraries; reference src/data/datasets.py is the thin
+// Python layer on top). Here the host-side hot loops are first-party
+// C++ behind ctypes (dla_tpu/native/__init__.py), with pure-Python
+// fallbacks when the toolchain is unavailable:
+//
+//   dla_jsonl_index   mmap a JSONL corpus and emit [start, end) byte
+//                     offsets per non-empty line. Enables O(1) random
+//                     access and per-host sharded reads (each host seeks
+//                     only its own lines) without a Python scan pass.
+//   dla_pack_ffd      greedy first-fit sequence packing over example
+//                     lengths — bit-identical placement to the Python
+//                     packer (dla_tpu/data/packing.py), so either side
+//                     can be used interchangeably.
+//
+// Build: g++ -O3 -shared -fPIC (driven by dla_tpu/native/build.py).
+// Plain C ABI so ctypes needs no glue code.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+// Line semantics must track the Python fallback (dla_tpu/data/jsonl.py):
+// Python text mode universal newlines treat '\n' and '\r' as terminators
+// ('\r\n' yields an empty fragment that blank-line skipping drops), and
+// str.strip() on ASCII JSONL content strips isspace(). Exotic unicode
+// whitespace (U+00A0 etc.) can still differ — the Python wrapper guards
+// with a parse-failure fallback.
+static inline bool is_newline(char c) { return c == '\n' || c == '\r'; }
+static inline bool is_space(char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
+         c == '\r';
+}
+
+extern "C" {
+
+// Count non-empty (after whitespace strip) lines in a JSONL file.
+// Returns -1 on IO error.
+int64_t dla_jsonl_count(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { ::close(fd); return -1; }
+  if (st.st_size == 0) { ::close(fd); return 0; }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) return -1;
+  const char* p = static_cast<const char*>(base);
+  const int64_t n = st.st_size;
+  int64_t count = 0;
+  int64_t line_start = 0;
+  for (int64_t i = 0; i <= n; ++i) {
+    if (i == n || is_newline(p[i])) {
+      int64_t s = line_start, e = i;
+      while (s < e && is_space(p[s])) ++s;
+      while (e > s && is_space(p[e - 1])) --e;
+      if (e > s) ++count;
+      line_start = i + 1;
+    }
+  }
+  munmap(base, st.st_size);
+  return count;
+}
+
+// Fill starts/ends (each of capacity `cap`) with the byte ranges of the
+// first `cap` non-empty lines (whitespace-stripped). Returns the number
+// written, or -1 on IO error. Call dla_jsonl_count first to size buffers.
+int64_t dla_jsonl_offsets(const char* path, int64_t* starts, int64_t* ends,
+                          int64_t cap) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { ::close(fd); return -1; }
+  if (st.st_size == 0) { ::close(fd); return 0; }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) return -1;
+  const char* p = static_cast<const char*>(base);
+  const int64_t n = st.st_size;
+  int64_t count = 0;
+  int64_t line_start = 0;
+  for (int64_t i = 0; i <= n && count < cap; ++i) {
+    if (i == n || is_newline(p[i])) {
+      int64_t s = line_start, e = i;
+      while (s < e && is_space(p[s])) ++s;
+      while (e > s && is_space(p[e - 1])) --e;
+      if (e > s) {
+        starts[count] = s;
+        ends[count] = e;
+        ++count;
+      }
+      line_start = i + 1;
+    }
+  }
+  munmap(base, st.st_size);
+  return count;
+}
+
+// Greedy first-fit packing, semantics identical to
+// PackedInstructionDataset (dla_tpu/data/packing.py):
+//   - examples are visited in order; lengths > max_length are treated as
+//     max_length (the Python side truncates the arrays)
+//   - an example goes to the FIRST open row it fits in, else opens a row
+//   - after each placement, rows with free space < close_margin close
+// row_assign[i] receives the row index of example i. Returns the number
+// of rows, or -1 on bad arguments.
+int64_t dla_pack_ffd(const int32_t* lengths, int64_t n, int32_t max_length,
+                     int32_t close_margin, int32_t* row_assign) {
+  if (n < 0 || max_length <= 0) return -1;
+  std::vector<int32_t> row_len;     // total tokens per row
+  std::vector<int32_t> open_rows;   // still-open rows, insertion order
+  row_len.reserve(1024);
+  open_rows.reserve(64);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t len = lengths[i];
+    if (len > max_length) len = max_length;
+    if (len < 0) return -1;
+    bool placed = false;
+    for (size_t k = 0; k < open_rows.size(); ++k) {
+      int32_t r = open_rows[k];
+      if (row_len[r] + len <= max_length) {
+        row_len[r] += len;
+        row_assign[i] = r;
+        placed = true;
+        break;
+      }
+    }
+    if (!placed) {
+      row_len.push_back(len);
+      open_rows.push_back(static_cast<int32_t>(row_len.size()) - 1);
+      row_assign[i] = static_cast<int32_t>(row_len.size()) - 1;
+    }
+    // close rows that cannot take even a close_margin-sized example
+    size_t w = 0;
+    for (size_t k = 0; k < open_rows.size(); ++k) {
+      int32_t r = open_rows[k];
+      if (row_len[r] + close_margin <= max_length) open_rows[w++] = r;
+    }
+    open_rows.resize(w);
+  }
+  return static_cast<int64_t>(row_len.size());
+}
+
+}  // extern "C"

@@ -28,7 +28,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "fsdp", "model", "sequence")
+AXES = ("data", "fsdp", "model", "sequence", "expert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +39,11 @@ class MeshConfig:
     fsdp: int = -1
     model: int = 1
     sequence: int = 1
+    # reserved for expert parallelism (MoE). The reference is dense-only
+    # (SURVEY.md sec 2.3 EP row: "reserve an expert axis, don't
+    # implement"); the axis exists so configs and partition specs have a
+    # stable name the day MoE layers land, but nothing shards over it yet.
+    expert: int = 1
 
     @classmethod
     def from_dict(cls, cfg: Optional[Dict[str, Any]]) -> "MeshConfig":
@@ -48,11 +53,13 @@ class MeshConfig:
             fsdp=int(cfg.get("fsdp", -1)),
             model=int(cfg.get("model", 1)),
             sequence=int(cfg.get("sequence", 1)),
+            expert=int(cfg.get("expert", 1)),
         )
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
         sizes = {"data": self.data, "fsdp": self.fsdp,
-                 "model": self.model, "sequence": self.sequence}
+                 "model": self.model, "sequence": self.sequence,
+                 "expert": self.expert}
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"At most one mesh axis may be -1, got {wild}")
@@ -75,8 +82,8 @@ def build_mesh(
 ) -> Mesh:
     """Build a Mesh over the given (default: all) devices.
 
-    Axis order is (data, fsdp, model, sequence): the innermost axes (model,
-    sequence) get adjacent devices, which on real TPU topologies keeps
+    Axis order is (data, fsdp, model, sequence, expert): the innermost
+    axes (model, sequence) get adjacent devices, which on real TPU topologies keeps
     TP/CP collectives on the shortest ICI paths, while data/fsdp span the
     outer (possibly DCN) dimensions.
     """

@@ -49,6 +49,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--top_p", type=float, default=0.9)
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    # partition the prompt file across independent rollout jobs: shard k
+    # of n parses and generates only records k::n (native byte-range
+    # reads, dla_tpu/data/jsonl.py) and should write a per-shard
+    # --output_path
+    p.add_argument("--shard_index", type=int, default=0)
+    p.add_argument("--shard_count", type=int, default=1)
     return p.parse_args(argv)
 
 
@@ -71,11 +77,15 @@ def main(argv=None) -> None:
              **model_cfg}, jax.random.fold_in(rng, 1))
         score_fn = jax.jit(rm_bundle.model.apply)
 
-    records = read_jsonl(args.prompts_path)
+    records = read_jsonl(args.prompts_path, shard_index=args.shard_index,
+                         shard_count=args.shard_count)
     prompts = [r["prompt"] for r in records if r.get("prompt")]
     if args.limit:
         prompts = prompts[: args.limit]
-    log_rank_zero(f"[dla_tpu] generating rollouts for {len(prompts)} prompts")
+    shard = (f" (shard {args.shard_index}/{args.shard_count})"
+             if args.shard_count > 1 else "")
+    log_rank_zero(
+        f"[dla_tpu] generating rollouts for {len(prompts)} prompts{shard}")
 
     # truncate a possibly pre-existing output
     open(args.output_path, "w").close()

@@ -1,0 +1,127 @@
+"""Native data-plane library: build, bindings, and bit-parity with the
+pure-Python fallbacks (SURVEY.md sec 2.2: first-party native host
+runtime replacing the torch/HF-internal data path)."""
+import json
+
+import numpy as np
+import pytest
+
+from dla_tpu import native
+from dla_tpu.data.jsonl import read_jsonl, write_jsonl
+from dla_tpu.data.packing import pack_first_fit_python
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+
+RECORDS = [
+    {"prompt": "hello", "response": "world"},
+    {"prompt": "unicode é中文 😀", "response": "ok"},
+    {"prompt": "esc \"quotes\" and \\ backslash\nnewline", "response": "x"},
+    {"prompt": "last", "chosen": "a", "rejected": "b", "reward": -1.5},
+]
+
+
+def _write_messy(path):
+    # hand-written file with blank lines, stray whitespace, no trailing \n
+    lines = [json.dumps(r, ensure_ascii=False) for r in RECORDS]
+    raw = ("\n\n  \n" + lines[0] + "\n" + "  " + lines[1] + "  \r\n" +
+           lines[2] + "\n\t\n" + lines[3])
+    path.write_bytes(raw.encode("utf-8"))
+
+
+def test_jsonl_index_matches_python_line_scan(tmp_path):
+    p = tmp_path / "messy.jsonl"
+    _write_messy(p)
+    starts, ends = native.jsonl_index(p)
+    assert len(starts) == len(RECORDS)
+    data = p.read_bytes()
+    parsed = [json.loads(data[s:e]) for s, e in zip(starts, ends)]
+    assert parsed == RECORDS
+
+
+def test_read_jsonl_native_vs_fallback(tmp_path, monkeypatch):
+    p = tmp_path / "data.jsonl"
+    _write_messy(p)
+    assert read_jsonl(p) == RECORDS
+    # sharded reads take the native byte-range path; parity vs fallback
+    native_shard = read_jsonl(p, shard_index=1, shard_count=2)
+    monkeypatch.setattr("dla_tpu.data.jsonl._native_index", lambda _p: None)
+    python_shard = read_jsonl(p, shard_index=1, shard_count=2)
+    assert native_shard == python_shard == RECORDS[1::2]
+
+
+def test_read_jsonl_shards_partition_the_file(tmp_path):
+    p = tmp_path / "big.jsonl"
+    recs = [{"i": i} for i in range(103)]
+    write_jsonl(p, recs)
+    shards = [read_jsonl(p, shard_index=k, shard_count=4) for k in range(4)]
+    assert sum(len(s) for s in shards) == len(recs)
+    merged = sorted((r["i"] for s in shards for r in s))
+    assert merged == list(range(103))
+    # deterministic striding: shard k holds records k::4
+    assert [r["i"] for r in shards[1]] == list(range(1, 103, 4))
+
+
+def test_cr_and_crlf_line_endings_match_python(tmp_path, monkeypatch):
+    # Python text mode treats \r and \r\n as line terminators (universal
+    # newlines); the C scanner must agree so shard striding is identical
+    p = tmp_path / "cr.jsonl"
+    lines = [json.dumps(r) for r in RECORDS]
+    p.write_bytes((lines[0] + "\r" + lines[1] + "\r\n" + lines[2] +
+                   "\n\x0c\n" + lines[3]).encode())
+    starts, ends = native.jsonl_index(p)
+    data = p.read_bytes()
+    parsed = [json.loads(data[s:e]) for s, e in zip(starts, ends)]
+    native_shard = read_jsonl(p, shard_index=0, shard_count=2)
+    monkeypatch.setattr("dla_tpu.data.jsonl._native_index", lambda _p: None)
+    python_shard = read_jsonl(p, shard_index=0, shard_count=2)
+    assert parsed == RECORDS
+    assert native_shard == python_shard == RECORDS[0::2]
+
+
+def test_empty_and_missing_files(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert read_jsonl(empty) == []
+    assert native.jsonl_index(empty)[0].shape == (0,)
+    assert native.jsonl_index(tmp_path / "nope.jsonl") is None
+
+
+def test_pack_ffd_parity_random():
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        n = int(rng.integers(1, 400))
+        max_len = int(rng.integers(16, 512))
+        lengths = rng.integers(1, max_len * 2, size=n).astype(np.int32)
+        got = native.pack_ffd(lengths, max_len)
+        assert got is not None
+        assign_c, rows_c = got
+        assign_py, rows_py = pack_first_fit_python(lengths, max_len, 8)
+        assert rows_c == rows_py, f"trial {trial}"
+        np.testing.assert_array_equal(assign_c, assign_py)
+        # validity: no row overflows max_len
+        totals = np.zeros(rows_c, np.int64)
+        np.add.at(totals, assign_c, np.minimum(lengths, max_len))
+        assert totals.max(initial=0) <= max_len
+
+
+def test_packed_dataset_uses_native_and_matches_python(tmp_path, monkeypatch):
+    from dla_tpu.data.loaders import build_instruction_dataset
+    from dla_tpu.data.packing import PackedInstructionDataset
+
+    p = tmp_path / "sft.jsonl"
+    write_jsonl(p, [{"prompt": f"q{i}" * (1 + i % 7),
+                     "response": f"a{i}" * (1 + i % 5)} for i in range(40)])
+    from dla_tpu.data.tokenizers import ByteTokenizer
+    cfg = {"source": "local", "train_path": str(p), "max_seq_length": 48}
+    base = build_instruction_dataset(cfg, ByteTokenizer(), split="train")
+    packed_native = PackedInstructionDataset(base, 48)
+    monkeypatch.setattr(
+        "dla_tpu.native.pack_ffd", lambda *a, **k: None)
+    packed_py = PackedInstructionDataset(base, 48)
+    assert len(packed_native) == len(packed_py)
+    for i in range(len(packed_py)):
+        a, b = packed_native[i], packed_py[i]
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
