@@ -24,6 +24,15 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     max_seq_length: int = 2048
+    # architecture family:
+    #   "llama"  pre-RMSNorm sequential block, gated-SiLU MLP, full RoPE
+    #            (llama-2/-3, mistral)
+    #   "phi"    parallel residual block (shared input LayerNorm feeding
+    #            both attention and MLP), biased projections, GELU MLP,
+    #            partial RoPE (phi-2 / phi-1.5)
+    arch: str = "llama"
+    # fraction of head_dim that rotates (phi-2: 0.4); 1.0 = full RoPE
+    rotary_pct: float = 1.0
     # numerics
     dtype: str = "bfloat16"             # activation dtype
     param_dtype: str = "float32"        # master param dtype
@@ -50,6 +59,12 @@ class ModelConfig:
     @property
     def head_dim_(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def rotary_dim_(self) -> int:
+        """Rotated slice of each head; even, as rotate_half requires."""
+        rd = int(self.head_dim_ * self.rotary_pct)
+        return rd - (rd % 2)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ModelConfig":
@@ -100,11 +115,13 @@ register_model("llama2-70b", ModelConfig(
 register_model("mistral-7b", ModelConfig(
     vocab_size=32000, hidden_size=4096, intermediate_size=14336,
     num_layers=32, num_heads=32, num_kv_heads=8, max_seq_length=8192))
-# phi-2-class small student (2.7B, dense MHA, tied embeddings like phi-2)
+# phi-2 (2.7B): true architecture — parallel residual block, partial
+# rotary (0.4), LayerNorm, biased projections, GELU MLP (HF
+# microsoft/phi-2 config.json values; weight import in models/hf_import)
 register_model("phi-2", ModelConfig(
     vocab_size=51200, hidden_size=2560, intermediate_size=10240,
-    num_layers=32, num_heads=32, num_kv_heads=32, tie_embeddings=True,
-    max_seq_length=2048))
+    num_layers=32, num_heads=32, num_kv_heads=32, max_seq_length=2048,
+    arch="phi", rotary_pct=0.4, rms_norm_eps=1e-5))
 # tiny models for tests / smoke runs
 register_model("tiny", ModelConfig(
     vocab_size=512, hidden_size=64, intermediate_size=192,

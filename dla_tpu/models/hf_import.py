@@ -23,7 +23,9 @@ from dla_tpu.models.config import ModelConfig
 
 
 def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfig:
-    """Map a Llama/Mistral-style HF config.json to ModelConfig."""
+    """Map a Llama/Mistral- or Phi-style HF config.json to ModelConfig."""
+    if str(hf_cfg.get("model_type", "")).lower() == "phi":
+        return _phi_config(hf_cfg, overrides)
     n_heads = int(hf_cfg["num_attention_heads"])
     fields = dict(
         vocab_size=int(hf_cfg["vocab_size"]),
@@ -37,6 +39,28 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
         rms_norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", False)),
         max_seq_length=int(hf_cfg.get("max_position_embeddings", 4096)),
+    )
+    fields.update(overrides)
+    return ModelConfig(**fields)
+
+
+def _phi_config(hf_cfg: Dict[str, Any], overrides) -> ModelConfig:
+    """microsoft/phi-2-style config.json: parallel block, partial rotary,
+    LayerNorm (layer_norm_eps, not rms_norm_eps), biased projections."""
+    n_heads = int(hf_cfg["num_attention_heads"])
+    fields = dict(
+        vocab_size=int(hf_cfg["vocab_size"]),
+        hidden_size=int(hf_cfg["hidden_size"]),
+        intermediate_size=int(hf_cfg["intermediate_size"]),
+        num_layers=int(hf_cfg["num_hidden_layers"]),
+        num_heads=n_heads,
+        num_kv_heads=int(hf_cfg.get("num_key_value_heads") or n_heads),
+        rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+        rms_norm_eps=float(hf_cfg.get("layer_norm_eps", 1e-5)),
+        tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", False)),
+        max_seq_length=int(hf_cfg.get("max_position_embeddings", 2048)),
+        arch="phi",
+        rotary_pct=float(hf_cfg.get("partial_rotary_factor", 0.5)),
     )
     fields.update(overrides)
     return ModelConfig(**fields)
@@ -92,6 +116,9 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
     def linear(name: str) -> np.ndarray:
         return take(name).T.astype(pdtype)  # [out,in] -> [in,out]
 
+    if cfg.arch == "phi":
+        return _import_phi(sd, cfg, pdtype, take, linear)
+
     L = cfg.num_layers
     stacked: Dict[str, list] = {k: [] for k in (
         "attn_norm", "wq", "wk", "wv", "wo",
@@ -119,4 +146,42 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
             params["lm_head"] = np.asarray(sd["lm_head.weight"]).T.astype(pdtype)
         else:
             params["lm_head"] = params["embed"]["embedding"].T.copy()
+    return params
+
+
+def _import_phi(sd, cfg: ModelConfig, pdtype, take, linear
+                ) -> Dict[str, Any]:
+    """Phi weight layout (HF PhiForCausalLM): shared input_layernorm
+    (weight+bias), q/k/v_proj + dense with biases, mlp.fc1/fc2 with
+    biases, final_layernorm, biased lm_head."""
+    L = cfg.num_layers
+    names = {
+        "ln": "input_layernorm.weight", "ln_bias": "input_layernorm.bias",
+        "wq": "self_attn.q_proj.weight", "wq_bias": "self_attn.q_proj.bias",
+        "wk": "self_attn.k_proj.weight", "wk_bias": "self_attn.k_proj.bias",
+        "wv": "self_attn.v_proj.weight", "wv_bias": "self_attn.v_proj.bias",
+        "wo": "self_attn.dense.weight", "wo_bias": "self_attn.dense.bias",
+        "fc1": "mlp.fc1.weight", "fc1_bias": "mlp.fc1.bias",
+        "fc2": "mlp.fc2.weight", "fc2_bias": "mlp.fc2.bias",
+    }
+    matrices = ("wq", "wk", "wv", "wo", "fc1", "fc2")
+    stacked: Dict[str, list] = {k: [] for k in names}
+    for i in range(L):
+        p = f"layers.{i}."
+        for ours, theirs in names.items():
+            stacked[ours].append(
+                linear(p + theirs) if ours in matrices
+                else take(p + theirs).astype(pdtype))
+    params: Dict[str, Any] = {
+        "embed": {"embedding": take("embed_tokens.weight").astype(pdtype)},
+        "layers": {k: np.stack(v) for k, v in stacked.items()},
+        "final_norm": take("final_layernorm.weight").astype(pdtype),
+        "final_norm_bias": take("final_layernorm.bias").astype(pdtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = np.asarray(sd["lm_head.weight"]).T.astype(pdtype)
+        bias = sd.get("lm_head.bias")
+        params["lm_head_bias"] = (
+            np.asarray(bias).astype(pdtype) if bias is not None
+            else np.zeros((cfg.vocab_size,), pdtype))
     return params

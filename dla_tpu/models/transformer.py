@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from dla_tpu.models.config import ModelConfig
 from dla_tpu.ops.attention import causal_attention
-from dla_tpu.ops.norms import rms_norm
+from dla_tpu.ops.norms import layer_norm, rms_norm
 from dla_tpu.ops.rotary import apply_rotary, rotary_angles
 
 Params = Dict[str, Any]
@@ -86,6 +86,36 @@ class Transformer:
                     ).astype(self.pdtype)
 
         L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+        if cfg.arch == "phi":
+            # parallel-residual block: one shared input LayerNorm, biased
+            # projections, non-gated GELU MLP (fc1/fc2)
+            params = {
+                "embed": {"embedding": mat(keys[0], (cfg.vocab_size, D), std)},
+                "layers": {
+                    "ln": jnp.ones((L, D), self.pdtype),
+                    "ln_bias": jnp.zeros((L, D), self.pdtype),
+                    "wq": mat(keys[1], (L, D, qdim), std),
+                    "wq_bias": jnp.zeros((L, qdim), self.pdtype),
+                    "wk": mat(keys[2], (L, D, kvdim), std),
+                    "wk_bias": jnp.zeros((L, kvdim), self.pdtype),
+                    "wv": mat(keys[3], (L, D, kvdim), std),
+                    "wv_bias": jnp.zeros((L, kvdim), self.pdtype),
+                    "wo": mat(keys[4], (L, qdim, D), out_std),
+                    "wo_bias": jnp.zeros((L, D), self.pdtype),
+                    "fc1": mat(keys[5], (L, D, F), std),
+                    "fc1_bias": jnp.zeros((L, F), self.pdtype),
+                    "fc2": mat(keys[6], (L, F, D), out_std),
+                    "fc2_bias": jnp.zeros((L, D), self.pdtype),
+                },
+                "final_norm": jnp.ones((D,), self.pdtype),
+                "final_norm_bias": jnp.zeros((D,), self.pdtype),
+            }
+            if not cfg.tie_embeddings:
+                params["lm_head"] = mat(
+                    jax.random.fold_in(rng, 99), (D, cfg.vocab_size), std)
+                params["lm_head_bias"] = jnp.zeros(
+                    (cfg.vocab_size,), self.pdtype)
+            return params
         params: Params = {
             "embed": {"embedding": mat(keys[0], (cfg.vocab_size, D), std)},
             "layers": {
@@ -113,6 +143,7 @@ class Transformer:
         "wq": ("hidden", "q"), "wk": ("hidden", "kv"), "wv": ("hidden", "kv"),
         "wo": ("q", "hidden"), "w_gate": ("hidden", "ffn"),
         "w_up": ("hidden", "ffn"), "w_down": ("ffn", "hidden"),
+        "fc1": ("hidden", "ffn"), "fc2": ("ffn", "hidden"),  # phi MLP
     }
 
     def _lora_dims(self):
@@ -149,6 +180,8 @@ class Transformer:
             "w_gate": P(None, "fsdp", "model"),
             "w_up": P(None, "fsdp", "model"),
             "w_down": P(None, "model", "fsdp"),
+            "fc1": P(None, "fsdp", "model"),     # phi MLP
+            "fc2": P(None, "model", "fsdp"),
         }
         layers: Params = {}
         for t in self.cfg.lora_targets:
@@ -201,6 +234,31 @@ class Transformer:
         fsdp shards the embedding/hidden dim; model shards heads / MLP
         hidden / vocab (megatron). Stacked layer leaves lead with None.
         """
+        if self.cfg.arch == "phi":
+            specs = {
+                "embed": {"embedding": P("model", "fsdp")},
+                "layers": {
+                    "ln": P(None, None), "ln_bias": P(None, None),
+                    "wq": P(None, "fsdp", "model"),
+                    "wq_bias": P(None, "model"),
+                    "wk": P(None, "fsdp", "model"),
+                    "wk_bias": P(None, "model"),
+                    "wv": P(None, "fsdp", "model"),
+                    "wv_bias": P(None, "model"),
+                    "wo": P(None, "model", "fsdp"),
+                    "wo_bias": P(None, None),
+                    "fc1": P(None, "fsdp", "model"),
+                    "fc1_bias": P(None, "model"),
+                    "fc2": P(None, "model", "fsdp"),
+                    "fc2_bias": P(None, None),
+                },
+                "final_norm": P(None),
+                "final_norm_bias": P(None),
+            }
+            if not self.cfg.tie_embeddings:
+                specs["lm_head"] = P("fsdp", "model")
+                specs["lm_head_bias"] = P("model")
+            return specs
         specs: Params = {
             "embed": {"embedding": P("model", "fsdp")},
             "layers": {
@@ -236,31 +294,47 @@ class Transformer:
         for cache writes. ``layer`` may carry LoRA leaves (merged upstream)."""
         cfg = self.cfg
         dh = cfg.head_dim_
+        rd = cfg.rotary_dim_
         b, t, d = x.shape
 
         def cast(w):
             return w.astype(self.adtype)
 
         def proj(name, inp):
-            return self._lora_proj(layer, name, inp, inp @ cast(layer[name]),
-                                   dropout_key)
+            out = inp @ cast(layer[name])
+            bias = layer.get(f"{name}_bias")
+            if bias is not None:
+                out = out + cast(bias)
+            return self._lora_proj(layer, name, inp, out, dropout_key)
 
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        if cfg.arch == "phi":
+            h = layer_norm(x, layer["ln"], layer["ln_bias"],
+                           cfg.rms_norm_eps)
+        else:
+            h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         q = proj("wq", h).reshape(b, t, cfg.num_heads, dh)
         k = proj("wk", h).reshape(b, t, cfg.num_kv_heads, dh)
         v = proj("wv", h).reshape(b, t, cfg.num_kv_heads, dh)
         q = _constrain(q, P(("data", "fsdp"), "sequence", "model", None))
         k = _constrain(k, P(("data", "fsdp"), "sequence", "model", None))
-        q = apply_rotary(q, cos, sin)
-        k = apply_rotary(k, cos, sin)
+        q = apply_rotary(q, cos, sin, rotary_dim=rd)
+        k = apply_rotary(k, cos, sin, rotary_dim=rd)
         new_kv = (k, v)
         if kv_override is not None:
             k, v = kv_override
         attn = self._attention(q, k, v, kv_segment_mask,
                                q_positions, kv_positions, allow_flash, cp)
         attn = attn.reshape(b, t, cfg.num_heads * dh)
-        x = x + _constrain(proj("wo", attn), ACT_SPEC)
 
+        if cfg.arch == "phi":
+            # parallel residual: attention and MLP both read the shared h
+            attn_out = _constrain(proj("wo", attn), ACT_SPEC)
+            ff = _constrain(jax.nn.gelu(proj("fc1", h), approximate=True),
+                            P(("data", "fsdp"), "sequence", "model"))
+            mlp_out = _constrain(proj("fc2", ff), ACT_SPEC)
+            return x + attn_out + mlp_out, new_kv
+
+        x = x + _constrain(proj("wo", attn), ACT_SPEC)
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         gate = jax.nn.silu(proj("w_gate", h))
         up = proj("w_up", h)
@@ -375,7 +449,7 @@ class Transformer:
         x = jnp.take(params["embed"]["embedding"], input_ids, axis=0
                      ).astype(self.adtype)
         x = _constrain(x, ACT_SPEC)
-        cos, sin = rotary_angles(positions, cfg.head_dim_, cfg.rope_theta)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
 
         allow_flash = segment_ids is None and not gapped_mask and cp is None
 
@@ -403,7 +477,14 @@ class Transformer:
             layers = (layers, keys)
 
         x, _ = jax.lax.scan(self._maybe_remat(body), x, layers)
-        return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        return self._final_norm(params, x)
+
+    def _final_norm(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        if self.cfg.arch == "phi":
+            return layer_norm(x, params["final_norm"],
+                              params["final_norm_bias"],
+                              self.cfg.rms_norm_eps)
+        return rms_norm(x, params["final_norm"], self.cfg.rms_norm_eps)
 
     def unembed(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
         """[..., D] -> [..., V] logits (activation dtype; cast at the loss)."""
@@ -411,7 +492,11 @@ class Transformer:
             w = params["embed"]["embedding"].astype(self.adtype).T
         else:
             w = params["lm_head"].astype(self.adtype)
-        return hidden @ w
+        logits = hidden @ w
+        bias = params.get("lm_head_bias")
+        if bias is not None:
+            logits = logits + bias.astype(self.adtype)
+        return logits
 
     def apply(self, params: Params, input_ids: jnp.ndarray,
               attention_mask: Optional[jnp.ndarray] = None,
@@ -467,7 +552,7 @@ class Transformer:
             attention_mask[:, None, :].astype(bool), (b, t, t))
         x = jnp.take(params["embed"]["embedding"], input_ids, axis=0
                      ).astype(self.adtype)
-        cos, sin = rotary_angles(positions, cfg.head_dim_, cfg.rope_theta)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
 
         def body(carry, layer):
             h, kv = self._block(layer, carry, cos, sin, kv_mask,
@@ -475,7 +560,7 @@ class Transformer:
             return h, kv
 
         x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
-        h = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        h = self._final_norm(params, x)
 
         lengths = attention_mask.astype(jnp.int32).sum(axis=1)
         last_idx = jnp.maximum(lengths - 1, 0)
@@ -510,7 +595,7 @@ class Transformer:
         positions = write_idx[:, None]                     # [B, 1]
         x = jnp.take(params["embed"]["embedding"], tokens[:, None], axis=0
                      ).astype(self.adtype)
-        cos, sin = rotary_angles(positions, cfg.head_dim_, cfg.rope_theta)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
 
         # Physical write slot: prompts are right-padded to a uniform width T,
         # so every row writes decode step s at the same column T + s. Rotary
@@ -524,17 +609,27 @@ class Transformer:
         def body2(carry, xs):
             layer, k_cache, v_cache = xs
             h_in = carry
-            hn = rms_norm(h_in, layer["attn_norm"], cfg.rms_norm_eps)
             dh = cfg.head_dim_
+            rd = cfg.rotary_dim_
 
             def cast(w):
                 return w.astype(self.adtype)
 
-            q = (hn @ cast(layer["wq"])).reshape(b, 1, cfg.num_heads, dh)
-            k = (hn @ cast(layer["wk"])).reshape(b, 1, cfg.num_kv_heads, dh)
-            v = (hn @ cast(layer["wv"])).reshape(b, 1, cfg.num_kv_heads, dh)
-            q = apply_rotary(q, cos, sin)
-            k = apply_rotary(k, cos, sin)
+            def proj(name, inp):
+                out = inp @ cast(layer[name])
+                bias = layer.get(f"{name}_bias")
+                return out if bias is None else out + cast(bias)
+
+            if cfg.arch == "phi":
+                hn = layer_norm(h_in, layer["ln"], layer["ln_bias"],
+                                cfg.rms_norm_eps)
+            else:
+                hn = rms_norm(h_in, layer["attn_norm"], cfg.rms_norm_eps)
+            q = proj("wq", hn).reshape(b, 1, cfg.num_heads, dh)
+            k = proj("wk", hn).reshape(b, 1, cfg.num_kv_heads, dh)
+            v = proj("wv", hn).reshape(b, 1, cfg.num_kv_heads, dh)
+            q = apply_rotary(q, cos, sin, rotary_dim=rd)
+            k = apply_rotary(k, cos, sin, rotary_dim=rd)
             k_cache = jax.lax.dynamic_update_slice_in_dim(
                 k_cache, k, col, axis=1)
             v_cache = jax.lax.dynamic_update_slice_in_dim(
@@ -544,10 +639,14 @@ class Transformer:
                 kv_segment_mask=kv_mask_next[:, None, :],
                 q_positions=positions, kv_positions=kv_pos_next)
             attn = attn.reshape(b, 1, cfg.num_heads * dh)
-            x1 = h_in + attn @ cast(layer["wo"])
+            if cfg.arch == "phi":
+                ff = jax.nn.gelu(proj("fc1", hn), approximate=True)
+                x2 = h_in + proj("wo", attn) + proj("fc2", ff)
+                return x2, (k_cache, v_cache)
+            x1 = h_in + proj("wo", attn)
             hn2 = rms_norm(x1, layer["mlp_norm"], cfg.rms_norm_eps)
-            ff = jax.nn.silu(hn2 @ cast(layer["w_gate"])) * (hn2 @ cast(layer["w_up"]))
-            x2 = x1 + ff @ cast(layer["w_down"])
+            ff = jax.nn.silu(proj("w_gate", hn2)) * proj("w_up", hn2)
+            x2 = x1 + proj("w_down", ff)
             return x2, (k_cache, v_cache)
 
         # validity/positions after writing this token
@@ -558,7 +657,7 @@ class Transformer:
 
         x, (k_all, v_all) = jax.lax.scan(
             body2, x, (params["layers"], cache["k"], cache["v"]))
-        h = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        h = self._final_norm(params, x)
         logits = self.unembed(params, h[:, 0])
 
         new_cache = {

@@ -12,3 +12,16 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndar
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jnp.reciprocal(jnp.sqrt(var + eps))
     return (normed * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-5) -> jnp.ndarray:
+    """Full LayerNorm (mean-centered, affine with bias) — the phi-family
+    norm; llama-family models use rms_norm above."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    normed = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(orig_dtype)

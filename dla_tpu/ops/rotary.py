@@ -19,16 +19,27 @@ def rotary_angles(positions: jnp.ndarray, head_dim: int,
     return jnp.cos(ang), jnp.sin(ang)
 
 
-def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
-    """x [B, T, H, D] with (cos, sin) [B, T, D/2] (or broadcastable).
+def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+                 rotary_dim: int = 0) -> jnp.ndarray:
+    """x [B, T, H, D] with (cos, sin) [B, T, rd/2] (or broadcastable).
 
     Uses the split-halves convention (rotate_half), matching LLaMA /
     HF transformers so imported weights are numerically compatible.
+
+    ``rotary_dim``: rotate only the first rd dims, pass the rest through —
+    partial RoPE, the phi-family convention (HF partial_rotary_factor;
+    cos/sin must then be built with rotary_angles(positions, rd, theta)).
+    0 means full rotation.
     """
-    d_half = x.shape[-1] // 2
-    x1, x2 = x[..., :d_half], x[..., d_half:]
-    cos = cos[..., None, :].astype(x.dtype)  # [B, T, 1, D/2]
+    d = x.shape[-1]
+    rd = rotary_dim or d
+    rot, rest = x[..., :rd], x[..., rd:]
+    d_half = rd // 2
+    x1, x2 = rot[..., :d_half], rot[..., d_half:]
+    cos = cos[..., None, :].astype(x.dtype)  # [B, T, 1, rd/2]
     sin = sin[..., None, :].astype(x.dtype)
     out1 = x1 * cos - x2 * sin
     out2 = x2 * cos + x1 * sin
-    return jnp.concatenate([out1, out2], axis=-1)
+    if rd == d:
+        return jnp.concatenate([out1, out2], axis=-1)
+    return jnp.concatenate([out1, out2, rest], axis=-1)
