@@ -33,6 +33,9 @@ class ModelConfig:
     arch: str = "llama"
     # fraction of head_dim that rotates (phi-2: 0.4); 1.0 = full RoPE
     rotary_pct: float = 1.0
+    # biases on the q/k/v projections within the llama block layout —
+    # the qwen2 family (phi carries biases on every projection already)
+    attention_bias: bool = False
     # numerics
     dtype: str = "bfloat16"             # activation dtype
     param_dtype: str = "float32"        # master param dtype
@@ -64,7 +67,12 @@ class ModelConfig:
     def rotary_dim_(self) -> int:
         """Rotated slice of each head; even, as rotate_half requires."""
         rd = int(self.head_dim_ * self.rotary_pct)
-        return rd - (rd % 2)
+        rd -= rd % 2
+        if rd <= 0:
+            raise ValueError(
+                f"rotary_pct {self.rotary_pct} rotates {rd} of "
+                f"{self.head_dim_} head dims; needs at least 2")
+        return rd
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ModelConfig":
@@ -115,6 +123,10 @@ register_model("llama2-70b", ModelConfig(
 register_model("mistral-7b", ModelConfig(
     vocab_size=32000, hidden_size=4096, intermediate_size=14336,
     num_layers=32, num_heads=32, num_kv_heads=8, max_seq_length=8192))
+register_model("qwen2-7b", ModelConfig(
+    vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+    num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1e6,
+    rms_norm_eps=1e-6, max_seq_length=32768, attention_bias=True))
 # phi-2 (2.7B): true architecture — parallel residual block, partial
 # rotary (0.4), LayerNorm, biased projections, GELU MLP (HF
 # microsoft/phi-2 config.json values; weight import in models/hf_import)
@@ -137,4 +149,5 @@ register_model("meta-llama/Llama-2-7b-hf", _REGISTRY["llama2-7b"])
 register_model("meta-llama/Llama-2-13b-hf", _REGISTRY["llama2-13b"])
 register_model("meta-llama/Llama-2-70b-hf", _REGISTRY["llama2-70b"])
 register_model("mistralai/Mistral-7B-v0.1", _REGISTRY["mistral-7b"])
+register_model("Qwen/Qwen2-7B", _REGISTRY["qwen2-7b"])
 register_model("microsoft/phi-2", _REGISTRY["phi-2"])

@@ -23,8 +23,10 @@ from dla_tpu.models.config import ModelConfig
 
 
 def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfig:
-    """Map a Llama/Mistral- or Phi-style HF config.json to ModelConfig."""
-    if str(hf_cfg.get("model_type", "")).lower() == "phi":
+    """Map a Llama/Mistral/Qwen2- or Phi-style HF config.json to
+    ModelConfig."""
+    model_type = str(hf_cfg.get("model_type", "")).lower()
+    if model_type == "phi":
         return _phi_config(hf_cfg, overrides)
     n_heads = int(hf_cfg["num_attention_heads"])
     fields = dict(
@@ -39,6 +41,10 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
         rms_norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", False)),
         max_seq_length=int(hf_cfg.get("max_position_embeddings", 4096)),
+        # qwen2 carries q/k/v biases; llama configs may also set
+        # attention_bias explicitly
+        attention_bias=bool(hf_cfg.get("attention_bias",
+                                       model_type == "qwen2")),
     )
     fields.update(overrides)
     return ModelConfig(**fields)
@@ -123,12 +129,22 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
     stacked: Dict[str, list] = {k: [] for k in (
         "attn_norm", "wq", "wk", "wv", "wo",
         "mlp_norm", "w_gate", "w_up", "w_down")}
+    if cfg.attention_bias:
+        for k in ("wq_bias", "wk_bias", "wv_bias"):
+            stacked[k] = []
     for i in range(L):
         p = f"layers.{i}."
         stacked["attn_norm"].append(take(p + "input_layernorm.weight").astype(pdtype))
         stacked["wq"].append(linear(p + "self_attn.q_proj.weight"))
         stacked["wk"].append(linear(p + "self_attn.k_proj.weight"))
         stacked["wv"].append(linear(p + "self_attn.v_proj.weight"))
+        if cfg.attention_bias:
+            stacked["wq_bias"].append(
+                take(p + "self_attn.q_proj.bias").astype(pdtype))
+            stacked["wk_bias"].append(
+                take(p + "self_attn.k_proj.bias").astype(pdtype))
+            stacked["wv_bias"].append(
+                take(p + "self_attn.v_proj.bias").astype(pdtype))
         stacked["wo"].append(linear(p + "self_attn.o_proj.weight"))
         stacked["mlp_norm"].append(
             take(p + "post_attention_layernorm.weight").astype(pdtype))

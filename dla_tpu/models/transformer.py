@@ -131,6 +131,10 @@ class Transformer:
             },
             "final_norm": jnp.ones((D,), self.pdtype),
         }
+        if cfg.attention_bias:  # qwen2-style q/k/v biases
+            params["layers"]["wq_bias"] = jnp.zeros((L, qdim), self.pdtype)
+            params["layers"]["wk_bias"] = jnp.zeros((L, kvdim), self.pdtype)
+            params["layers"]["wv_bias"] = jnp.zeros((L, kvdim), self.pdtype)
         if not cfg.tie_embeddings:
             params["lm_head"] = mat(
                 jax.random.fold_in(rng, 99), (D, cfg.vocab_size), std)
@@ -274,6 +278,10 @@ class Transformer:
             },
             "final_norm": P(None),
         }
+        if self.cfg.attention_bias:
+            specs["layers"]["wq_bias"] = P(None, "model")
+            specs["layers"]["wk_bias"] = P(None, "model")
+            specs["layers"]["wv_bias"] = P(None, "model")
         if not self.cfg.tie_embeddings:
             specs["lm_head"] = P("fsdp", "model")
         return specs

@@ -32,6 +32,9 @@ def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
     0 means full rotation.
     """
     d = x.shape[-1]
+    if rotary_dim < 0 or rotary_dim > d:
+        raise ValueError(f"rotary_dim {rotary_dim} out of range for head "
+                         f"dim {d}")
     rd = rotary_dim or d
     rot, rest = x[..., :rd], x[..., rd:]
     d_half = rd // 2
