@@ -1,0 +1,82 @@
+"""Force the virtual-CPU host platform in an axon-tunnel environment.
+
+Single home for the environment dance used by tests/conftest.py,
+__graft_entry__.py, and bench.py. The ambient environment registers a
+TPU-tunnel PJRT plugin ("axon") via a sitecustomize hook whenever
+``PALLAS_AXON_POOL_IPS`` is set, with ``JAX_PLATFORMS=axon`` exported —
+and the hook overrides platform selection through ``jax.config``, so env
+vars alone do not stick. Backend init through the tunnel can HANG (not
+just raise), so anything that wants the virtual CPU mesh must force it
+*before* first device use, or scrub the plugin out of a child process's
+environment entirely.
+
+Stdlib-only at module level (jax is imported lazily inside functions),
+so this is importable before jax in conftest-style preambles.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+
+def prepend_pythonpath(env: dict, root: str) -> dict:
+    env["PYTHONPATH"] = (
+        root + os.pathsep + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+    return env
+
+
+def set_cpu_env(n_devices: Optional[int] = None,
+                env: Optional[dict] = None) -> dict:
+    """Set JAX_PLATFORMS=cpu (+ host device count) on ``env`` (default:
+    os.environ). An existing device-count flag with a DIFFERENT value is
+    replaced, not kept — otherwise a caller needing 8 devices inherits an
+    ambient count of 4 forever. Returns the mapping for chaining."""
+    env = os.environ if env is None else env
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = env.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags)
+        else:
+            flags = (flags + " " + want).strip()
+        env["XLA_FLAGS"] = flags
+    return env
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> bool:
+    """conftest-style in-process forcing: env + jax.config, before any
+    backend initializes. Returns True when the live backend is CPU with
+    at least ``n_devices`` devices (or just CPU when n_devices is None);
+    False means a backend with the wrong platform/count already exists
+    and the caller should re-exec in a scrubbed child process."""
+    set_cpu_env(n_devices)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        devices = jax.devices()
+    except Exception:
+        return False
+    if devices[0].platform != "cpu":
+        return False
+    return n_devices is None or len(devices) >= n_devices
+
+
+def scrubbed_cpu_env(n_devices: Optional[int] = None,
+                     repo_root: Optional[str] = None) -> dict:
+    """Child-process env with the axon plugin disarmed and CPU forced.
+    Without PALLAS_AXON_POOL_IPS the sitecustomize hook is a no-op, so
+    the child never registers the tunnel plugin at all — it cannot hang
+    in plugin init before user code runs."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    set_cpu_env(n_devices, env)
+    if repo_root:
+        prepend_pythonpath(env, repo_root)
+    return env

@@ -16,15 +16,27 @@ baseline per-chip token rate on *this* chip class is
 
 i.e. vs_baseline >= 1.0 means this framework beats 0.8x the H100 baseline
 after normalizing for per-chip peak FLOPs.
+
+Robustness contract: the bench PREFERS the real accelerator, falls back
+to forced CPU when no accelerator comes up, and emits its JSON line with
+exit code 0 on EVERY path. Backend init through the TPU tunnel has been
+observed to *hang* (not raise) — so the parent process NEVER initializes
+jax itself: it orchestrates two bounded subprocesses (accelerator
+attempt, then forced-CPU fallback) and relays whichever JSON line
+arrives first. Timeouts: DLA_BENCH_ACCEL_TIMEOUT (default 900s) /
+DLA_BENCH_CPU_TIMEOUT (default 600s).
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 PEAK_BF16_FLOPS = {
     # per-chip peak bf16 FLOP/s by device kind (substring match)
@@ -44,17 +56,40 @@ def peak_flops(device) -> float:
 
 
 def count_params(params) -> int:
+    import jax
     return int(sum(np.prod(l.shape) for l in jax.tree.leaves(params)))
 
 
-def main() -> None:
-    on_accel = jax.devices()[0].platform != "cpu"
+def _try_devices(retries: int = 2, delay_s: float = 5.0):
+    """Initialize the jax backend, retrying transient failures (the TPU
+    tunnel can return UNAVAILABLE on first contact). Returns the device
+    list or None if no backend ever comes up. May HANG on a wedged
+    tunnel — which is why this only ever runs inside a child process
+    whose lifetime the parent bounds."""
+    import jax
+    last = None
+    for attempt in range(retries):
+        try:
+            return jax.devices()
+        except Exception as e:  # backend init failed; retry
+            last = e
+            print(f"[bench] backend init attempt {attempt + 1}/{retries} "
+                  f"failed: {type(e).__name__}: {e}", file=sys.stderr)
+            time.sleep(delay_s)
+    print(f"[bench] no accelerator backend: {last}", file=sys.stderr)
+    return None
+
+
+def run_bench() -> dict:
+    """The measurement itself. Assumes a live jax backend."""
+    import jax
     from dla_tpu.models.config import ModelConfig
     from dla_tpu.models.transformer import Transformer
     from dla_tpu.ops.losses import cross_entropy_loss
     from dla_tpu.parallel.mesh import MeshConfig, build_mesh
     from dla_tpu.training.trainer import Trainer
 
+    on_accel = jax.devices()[0].platform != "cpu"
     if on_accel:
         # ~460M-param Llama-style model: big enough to exercise the MXU,
         # small enough that params + fp32 Adam state fit one v5e chip.
@@ -71,10 +106,15 @@ def main() -> None:
             param_dtype="float32")
         micro, seq, steps, warmup = 2, 256, 4, 1
 
+    print(f"[bench] devices up: {jax.devices()[0].device_kind} "
+          f"x{jax.device_count()}", file=sys.stderr)
     mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
     model = Transformer(cfg)
     params = model.init(jax.random.key(0))
+    jax.block_until_ready(params)
     n_params = count_params(params)
+    print(f"[bench] params initialized: {n_params / 1e6:.0f}M",
+          file=sys.stderr)
 
     def loss_fn(p, frozen, batch, rng):
         del frozen, rng
@@ -106,8 +146,11 @@ def main() -> None:
             "labels": rs.randint(1, cfg.vocab_size, (local_bs, seq)
                                  ).astype(np.int32),
         }
+        t_c = time.perf_counter()
         for i in range(warmup):
             trainer.step_on_batch(batch, jax.random.key(i))
+        print(f"[bench] warmup ({warmup} steps incl. compile): "
+              f"{time.perf_counter() - t_c:.1f}s", file=sys.stderr)
         t0 = time.perf_counter()
         for i in range(steps):
             trainer.step_on_batch(batch, jax.random.key(100 + i))
@@ -118,13 +161,106 @@ def main() -> None:
     tok_s_chip = tokens / dt / n_chips
     mfu = tok_s_chip * 6 * n_params / peak_flops(jax.devices()[0])
     vs_baseline = mfu / BASELINE_MFU
-    print(json.dumps({
+    return {
         "metric": "sft_tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(vs_baseline, 4),
-    }))
+    }
+
+
+def _child_env(mode: str) -> dict:
+    from _cpuhost import prepend_pythonpath, scrubbed_cpu_env
+    if mode == "cpu":
+        env = scrubbed_cpu_env(repo_root=_REPO_ROOT)
+    else:
+        env = prepend_pythonpath(dict(os.environ), _REPO_ROOT)
+    env["DLA_BENCH_PLATFORM"] = mode
+    return env
+
+
+def _extract_json_line(text: str) -> dict | None:
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if parsed.get("metric"):
+                return parsed
+    return None
+
+
+def _relay_child(mode: str, timeout_s: float) -> dict | None:
+    """Run the bench in a bounded subprocess; return its JSON line."""
+    stdout, stderr, rc = "", "", None
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], cwd=_REPO_ROOT,
+            env=_child_env(mode), capture_output=True, text=True,
+            timeout=timeout_s)
+        stdout, stderr, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout or b"") if isinstance(e.stdout, str) else \
+            (e.stdout or b"").decode("utf-8", "replace")
+        stderr = (e.stderr or b"") if isinstance(e.stderr, str) else \
+            (e.stderr or b"").decode("utf-8", "replace")
+        print(f"[bench] {mode} child timed out after {timeout_s:.0f}s",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] {mode} child failed to launch: {e}", file=sys.stderr)
+        return None
+    sys.stderr.write(stderr or "")
+    result = _extract_json_line(stdout)
+    if result is None:
+        print(f"[bench] {mode} child emitted no JSON line (rc={rc})",
+              file=sys.stderr)
+    return result
+
+
+def main() -> int:
+    mode = os.environ.get("DLA_BENCH_PLATFORM")
+    if mode == "cpu":
+        # CPU child: force the platform before backend init, run, emit.
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_bench()))
+        return 0
+    if mode == "accel":
+        # Accelerator child: may hang in tunnel init — parent bounds us.
+        if _try_devices() is None:
+            return 1
+        print(json.dumps(run_bench()))
+        return 0
+
+    # Parent orchestrator: NEVER initializes jax (backend init can hang);
+    # every jax touch happens in a time-bounded child.
+    accel_t = float(os.environ.get("DLA_BENCH_ACCEL_TIMEOUT", "900"))
+    cpu_t = float(os.environ.get("DLA_BENCH_CPU_TIMEOUT", "600"))
+    result = _relay_child("accel", accel_t)
+    if result is None:
+        result = _relay_child("cpu", cpu_t)
+    if result is None:  # last resort: the line must still be emitted
+        result = {
+            "metric": "sft_tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tok/s/chip", "vs_baseline": 0.0,
+            "error": "no jax backend available (accelerator and forced-CPU "
+                     "fallback both failed)",
+        }
+    print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception as e:  # absolute backstop: never exit without the line
+        print(json.dumps({
+            "metric": "sft_tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tok/s/chip", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(0)

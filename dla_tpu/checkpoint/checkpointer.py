@@ -8,30 +8,37 @@ first-class:
 - step-tagged directories ``step_000123/`` + a ``latest`` pointer file
 - atomic writes (tmp dir + rename)
 - keep-last-N retention
-- restore onto an arbitrary mesh/sharding (cross-topology reshard: leaves
-  are stored as whole logical arrays; ``jax.make_array_from_callback``
-  reads just the slice each device needs via np.load mmap)
-- multi-host: partially-addressable leaves are allgathered across hosts
-  and process 0 writes whole logical arrays. This is simple and correct
-  but serializes I/O through host 0 and materializes full arrays in host
-  RAM — per-host shard files (no gather) are planned once the multi-host
-  path is exercised on real pods.
+- restore onto an arbitrary mesh/sharding (cross-topology reshard:
+  ``jax.make_array_from_callback`` reads just the slice each device
+  needs, assembled from shard files via np.load mmap)
+- **per-host shard I/O**: sharded leaves are written one file per
+  distinct index region, each host writing only the regions it owns
+  (``replica_id == 0`` rule). Nothing is gathered through host 0 and no
+  host ever materializes a full logical array — a 70B param+opt-state
+  tree streams out as ~per-device-sized files in parallel across hosts.
+  Replicated/small leaves are written whole by process 0. Multi-host
+  save assumes the checkpoint dir is on a filesystem all hosts share
+  (GCS/NFS — the standard pod setup).
 
-Format: one ``.npy`` per pytree leaf (path-encoded filename) + an
-``index.json`` with tree structure, dtypes, shapes, and auxiliary
-JSON-serializable state (step, data-iterator position, RNG key data).
+Format (index.json): ``format: 2``. Whole leaves carry
+``{file, shape, dtype}``; sharded leaves carry
+``{shape, dtype, shards: [{file, index: [[start, stop], ...]}]}``.
+Format-1 checkpoints (whole-file only) load unchanged.
 """
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
 import shutil
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+
+from dla_tpu.parallel.dist import barrier as _barrier
 
 SEP = "."
 
@@ -41,6 +48,7 @@ def _as_logical(arr: np.ndarray, dtype_str: str) -> np.ndarray:
     |V2); view back to the logical dtype recorded in the index."""
     if arr.dtype.kind == "V":
         import ml_dtypes  # ships with jax; registers bfloat16/fp8 dtypes
+
         return arr.view(np.dtype(dtype_str))
     return arr
 
@@ -65,6 +73,94 @@ def _leaf_filename(path: str) -> str:
     return re.sub(r"[^A-Za-z0-9_.\-]", "_", path) + ".npy"
 
 
+def _shard_filename(path: str, starts: Sequence[int]) -> str:
+    stem = re.sub(r"[^A-Za-z0-9_.\-]", "_", path)
+    suffix = "_".join(str(s) for s in starts) or "scalar"
+    return f"{stem}-shard{suffix}.npy"
+
+
+def _normalize_index(idx, shape) -> Tuple[Tuple[int, int], ...]:
+    """Device index (tuple of slices) -> ((start, stop), ...) per dim."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+class _ShardReader:
+    """Assemble arbitrary slices of a logical array from its shard files.
+
+    Files are opened with mmap, so reading a cross-topology slice touches
+    only the bytes that overlap it."""
+
+    def __init__(self, ckpt_dir: Path, meta: Dict[str, Any]):
+        self.dir = ckpt_dir
+        self.shape = tuple(meta["shape"])
+        self.dtype = np.dtype(meta["dtype"])
+        self.shards = meta["shards"]
+        self._by_region = {
+            tuple(tuple(se) for se in sh["index"]): sh["file"]
+            for sh in self.shards}
+
+    @classmethod
+    def from_meta(cls, ckpt_dir: Path, meta: Dict[str, Any]) -> "_ShardReader":
+        """Reader for either index format: a format-1 whole-file leaf is
+        exactly the one-shard case."""
+        if "shards" in meta:
+            return cls(ckpt_dir, meta)
+        whole = dict(meta)
+        whole["shards"] = [{
+            "file": meta["file"],
+            "index": [[0, d] for d in meta["shape"]],
+        }]
+        return cls(ckpt_dir, whole)
+
+    def _load(self, fname: str) -> np.ndarray:
+        return _as_logical(
+            np.load(self.dir / fname, mmap_mode="r"), str(self.dtype))
+
+    def read(self, idx) -> np.ndarray:
+        """idx: tuple of slices into the global shape."""
+        region = _normalize_index(idx, self.shape)
+        exact = self._by_region.get(region)
+        if exact is not None:  # fast path: slice == one shard file
+            return np.asarray(self._load(exact))
+        out_shape = tuple(stop - start for start, stop in region)
+        out = np.empty(out_shape, self.dtype)
+        filled = 0
+        for sh in self.shards:
+            sh_region = [tuple(se) for se in sh["index"]]
+            dst, src = [], []
+            empty = False
+            for (want_s, want_e), (have_s, have_e) in zip(region, sh_region):
+                lo, hi = max(want_s, have_s), min(want_e, have_e)
+                if lo >= hi:
+                    empty = True
+                    break
+                dst.append(slice(lo - want_s, hi - want_s))
+                src.append(slice(lo - have_s, hi - have_s))
+            if empty:
+                continue
+            arr = self._load(sh["file"])
+            out[tuple(dst)] = arr[tuple(src)]
+            filled += math.prod(s.stop - s.start for s in dst)
+        if filled < math.prod(out_shape):
+            raise ValueError(
+                f"shard files do not cover requested region {region} "
+                f"of shape {self.shape}")
+        return out
+
+    def full(self) -> np.ndarray:
+        return self.read(tuple(slice(0, d) for d in self.shape))
+
+
+def _is_prng_key(x: Any) -> bool:
+    return hasattr(x, "dtype") and jax.dtypes.issubdtype(
+        getattr(x, "dtype", None), jax.dtypes.prng_key)
+
+
 class Checkpointer:
     def __init__(self, output_dir: str, keep_last_n: int = 3):
         self.dir = Path(output_dir)
@@ -77,26 +173,21 @@ class Checkpointer:
              tag: Optional[str] = None) -> Path:
         tag = tag or f"step_{step:08d}"
         final = self.dir / tag
-        tmp = self.dir / f".tmp_{tag}_{jax.process_index()}"
+        tmp = self.dir / f".tmp_{tag}"
         if self.is_main:
+            if tmp.exists():
+                shutil.rmtree(tmp)
             tmp.mkdir(parents=True, exist_ok=True)
+        _barrier(f"ckpt_mkdir_{tag}")
 
         leaves = _flatten_with_paths(tree)
-        index = {"format": 1, "step": int(step), "aux": aux or {},
+        index = {"format": 2, "step": int(step), "aux": aux or {},
                  "leaves": {}}
         for path, leaf in leaves:
             if leaf is None:
                 continue
-            # All hosts participate (partially-addressable arrays gather via
-            # a collective); only process 0 writes.
-            np_arr = self._to_numpy(leaf)
-            index["leaves"][path] = {
-                "file": _leaf_filename(path),
-                "shape": list(np_arr.shape),
-                "dtype": str(np_arr.dtype),
-            }
-            if self.is_main:
-                np.save(tmp / _leaf_filename(path), np_arr)
+            index["leaves"][path] = self._save_leaf(tmp, path, leaf)
+        _barrier(f"ckpt_written_{tag}")
         if self.is_main:
             with (tmp / "index.json").open("w") as fh:
                 json.dump(index, fh)
@@ -105,19 +196,45 @@ class Checkpointer:
             tmp.rename(final)
             self._write_latest(tag)
             self._retain()
+        _barrier(f"ckpt_final_{tag}")
         return final
 
-    @staticmethod
-    def _to_numpy(arr: Any) -> np.ndarray:
-        if isinstance(arr, (np.ndarray, np.generic, int, float)):
-            return np.asarray(arr)
-        if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
-            from jax.experimental import multihost_utils
-            arr = multihost_utils.process_allgather(arr)
-        if hasattr(arr, "dtype") and jax.dtypes.issubdtype(
-                arr.dtype, jax.dtypes.prng_key):
-            arr = jax.random.key_data(arr)
-        return np.asarray(arr)
+    def _save_leaf(self, tmp: Path, path: str, leaf: Any) -> Dict[str, Any]:
+        """Write one leaf; return its index entry. Sharded jax.Arrays are
+        written one file per distinct index region, this process writing
+        only regions whose replica-0 copy it holds — across all hosts every
+        region is written exactly once, with no gather anywhere."""
+        if _is_prng_key(leaf):
+            leaf = jax.random.key_data(leaf)
+        # The shard path handles every case np.asarray cannot: sharded
+        # arrays AND any multi-host array this process cannot fully
+        # address (even a replicated or single-remote-device one — the
+        # replica-0 owner writes its one region, others skip).
+        if isinstance(leaf, jax.Array) and (
+                not leaf.is_fully_addressable
+                or (len(leaf.devices()) > 1
+                    and not leaf.is_fully_replicated)):
+            shape, dtype = leaf.shape, str(leaf.dtype)
+            regions: Dict[Tuple, str] = {}
+            for dev, idx in leaf.sharding.devices_indices_map(shape).items():
+                region = _normalize_index(idx, shape)
+                regions.setdefault(region, _shard_filename(
+                    path, [s for s, _ in region]))
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                region = _normalize_index(shard.index, shape)
+                np.save(tmp / regions[region], np.asarray(shard.data))
+            return {"shape": list(shape), "dtype": dtype,
+                    "shards": [{"file": fname,
+                                "index": [list(se) for se in region]}
+                               for region, fname in sorted(regions.items())]}
+        # replicated / host / scalar leaf: process 0 writes it whole
+        np_arr = np.asarray(leaf)
+        if self.is_main:
+            np.save(tmp / _leaf_filename(path), np_arr)
+        return {"file": _leaf_filename(path),
+                "shape": list(np_arr.shape), "dtype": str(np_arr.dtype)}
 
     def _write_latest(self, tag: str) -> None:
         with (self.dir / "latest").open("w") as fh:
@@ -168,17 +285,15 @@ class Checkpointer:
             meta = index["leaves"].get(path)
             if meta is None:
                 raise KeyError(f"Checkpoint {ckpt} missing leaf '{path}'")
-            fname = ckpt / meta["file"]
-            arr = _as_logical(np.load(fname, mmap_mode="r"), meta["dtype"])
-            is_key = hasattr(tmpl_leaf, "dtype") and jax.dtypes.issubdtype(
-                getattr(tmpl_leaf, "dtype", None), jax.dtypes.prng_key)
+            is_key = _is_prng_key(tmpl_leaf)
             sharding = shard_by_path.get(path)
+            reader = _ShardReader.from_meta(ckpt, meta)
             if sharding is not None and not is_key:
                 out = jax.make_array_from_callback(
                     tuple(meta["shape"]), sharding,
-                    lambda idx, a=arr: np.asarray(a[idx]))
+                    lambda idx, r=reader: r.read(idx))
             else:
-                out = jax.device_put(np.asarray(arr))
+                out = jax.device_put(reader.full())
                 if is_key:
                     out = jax.random.wrap_key_data(out)
             restored[path] = out
@@ -210,8 +325,7 @@ def load_tree_numpy(ckpt_dir, prefix: Optional[str] = None
         keys = rel.split(SEP)
         for k in keys[:-1]:
             node = node.setdefault(k, {})
-        node[keys[-1]] = _as_logical(
-            np.load(ckpt / meta["file"]), meta["dtype"])
+        node[keys[-1]] = _ShardReader.from_meta(ckpt, meta).full()
     return tree, index.get("aux", {})
 
 

@@ -19,6 +19,7 @@ from dla_tpu.data.loaders import (
 )
 from dla_tpu.data.iterator import ShardedBatchIterator
 from dla_tpu.data.packing import PackedInstructionDataset
+from dla_tpu.data.prefetch import PrefetchIterator
 
 __all__ = [
     "append_jsonl", "iter_jsonl", "read_jsonl", "write_jsonl",
@@ -28,5 +29,5 @@ __all__ = [
     "pad_batch", "build_instruction_dataset", "build_preference_dataset",
     "build_teacher_dataset", "load_instruction_records",
     "load_preference_records", "load_prompt_records",
-    "ShardedBatchIterator", "PackedInstructionDataset",
+    "ShardedBatchIterator", "PackedInstructionDataset", "PrefetchIterator",
 ]

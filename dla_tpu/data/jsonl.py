@@ -28,11 +28,25 @@ def read_jsonl(path: PathLike, shard_index: int = 0,
     Sharded reads use the native index (parse cost ~1/shard_count: only
     the owned byte ranges are decoded, via mmap — no whole-file heap
     copy). Full reads stay on Python line iteration — measured faster
-    than index+slice for shard_count == 1. If a native-sliced record
-    fails to parse (pathological whitespace the C scanner and Python
-    str.strip() disagree on), the whole read falls back to the Python
-    path so both sides always return identical results.
+    than index+slice for shard_count == 1.
+
+    Native/Python agreement is validated before the index is trusted:
+    the C scanner treats only ASCII whitespace as blank while Python
+    ``str.strip()`` drops Unicode whitespace (U+00A0 etc.), so the native
+    record set is always a superset of Python's — divergence happens
+    exactly when some native record decodes to all-whitespace. Each
+    record's byte range is checked for a printable-ASCII byte (O(1) for
+    real JSON, which starts with ``{``); only byte ranges with none are
+    decoded and stripped. Any divergent record (or per-record parse
+    failure) drops the whole read to the Python path, so all shards of a
+    fan-out see one consistent striding.
     """
+    if shard_count < 1:
+        raise ValueError(f"shard_count must be >= 1, got {shard_count}")
+    if not 0 <= shard_index < shard_count:
+        raise ValueError(
+            f"shard_index must be in [0, {shard_count}), got {shard_index}"
+            " — a misconfigured fan-out would silently produce nothing")
     if shard_count > 1:
         index = _native_index(path)
         if index is not None:
@@ -42,10 +56,11 @@ def read_jsonl(path: PathLike, shard_index: int = 0,
                 with Path(path).open("rb") as fh:
                     with _mmap.mmap(fh.fileno(), 0,
                                     access=_mmap.ACCESS_READ) as mm:
-                        return [json.loads(mm[s:e])
-                                for s, e in zip(
-                                    starts[shard_index::shard_count],
-                                    ends[shard_index::shard_count])]
+                        if _native_records_match_python(mm, starts, ends):
+                            return [json.loads(mm[s:e])
+                                    for s, e in zip(
+                                        starts[shard_index::shard_count],
+                                        ends[shard_index::shard_count])]
             except (ValueError, OSError):
                 pass  # empty file / parse disagreement -> Python path
     out: List[Dict[str, Any]] = []
@@ -58,6 +73,31 @@ def read_jsonl(path: PathLike, shard_index: int = 0,
                     out.append(json.loads(line))
                 pos += 1
     return out
+
+
+def _native_records_match_python(mm, starts, ends) -> bool:
+    """True iff every native record is also a record to Python (non-empty
+    after *Unicode* strip). A record containing any printable-ASCII byte
+    (0x21-0x7E) cannot strip to empty — real JSON starts with '{', so the
+    common case is a one-byte check; only exotic all-non-ASCII ranges pay
+    a decode."""
+    for s, e in zip(starts, ends):
+        # index reads (mm[j] is an int) — no per-record bytes copy; real
+        # JSON hits a printable byte at position 0
+        printable = False
+        for j in range(s, e):
+            if 0x21 <= mm[j] <= 0x7E:
+                printable = True
+                break
+        if printable:
+            continue
+        try:
+            decoded = mm[s:e].decode("utf-8")
+        except UnicodeDecodeError:
+            return False
+        if not decoded.strip():
+            return False  # C counted it; Python would drop it
+    return True
 
 
 def _native_index(path: PathLike) -> Optional[tuple]:

@@ -51,23 +51,44 @@ class PackedInstructionDataset:
 
     CLOSE_MARGIN = 8  # close rows that cannot take even a tiny example
 
-    def __init__(self, base, max_length: int):
+    def __init__(self, base, max_length: int, lazy: bool = True):
         """``base``: an InstructionDataset (or anything yielding dicts with
-        input_ids/attention_mask/labels 1-D arrays)."""
+        input_ids/attention_mask/labels 1-D arrays).
+
+        ``lazy`` (default): __init__ makes one lengths-only pass (token
+        arrays are discarded immediately, O(n_examples) memory instead of
+        O(corpus tokens)) and rows re-tokenize their examples on access —
+        with the trainer's background prefetch that work overlaps the
+        device step. ``lazy=False`` keeps every tokenized example in
+        memory (fastest per-epoch for small corpora/tests).
+        """
         self.max_length = max_length
         self.pad_token_id = base.tokenizer.pad_token_id
-        examples: List[Dict[str, np.ndarray]] = []
+        self.base = base
+        self.lazy = lazy
+        self._examples: List[Dict[str, np.ndarray]] = []
+        lengths_l: List[int] = []
         for i in range(len(base)):
             ex = base[i]
-            if int(ex["input_ids"].shape[0]) > max_length:
-                ex = {k: v[:max_length] for k, v in ex.items()}
-            examples.append(ex)
-        lengths = np.asarray(
-            [int(ex["input_ids"].shape[0]) for ex in examples], np.int32)
-        assign, n_rows = self._place(lengths)
-        self.rows = [[] for _ in range(n_rows)]
-        for ex, r in zip(examples, assign):
-            self.rows[int(r)].append(ex)
+            lengths_l.append(min(int(ex["input_ids"].shape[0]), max_length))
+            if not lazy:
+                if int(ex["input_ids"].shape[0]) > max_length:
+                    ex = {k: v[:max_length] for k, v in ex.items()}
+                self._examples.append(ex)
+        self.lengths = np.asarray(lengths_l, np.int32)
+        assign, n_rows = self._place(self.lengths)
+        # rows hold example *indices*; lazy mode fetches from base on demand
+        self.rows: List[List[int]] = [[] for _ in range(n_rows)]
+        for i, r in enumerate(assign):
+            self.rows[int(r)].append(i)
+
+    def _example(self, i: int) -> Dict[str, np.ndarray]:
+        if not self.lazy:
+            return self._examples[i]
+        ex = self.base[i]
+        if int(ex["input_ids"].shape[0]) > self.max_length:
+            ex = {k: v[: self.max_length] for k, v in ex.items()}
+        return ex
 
     def _place(self, lengths: np.ndarray):
         """Row assignment per example: native C++ first-fit when built
@@ -87,7 +108,7 @@ class PackedInstructionDataset:
         return len(self.rows)
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
-        segs = self.rows[idx]
+        segs = [self._example(i) for i in self.rows[idx]]
         L = self.max_length
         input_ids = np.full(L, self.pad_token_id, np.int32)
         labels = np.full(L, IGNORE_INDEX, np.int32)
@@ -117,6 +138,4 @@ class PackedInstructionDataset:
     def packing_efficiency(self) -> float:
         """Fraction of token slots holding real tokens (1.0 = perfect)."""
         total = len(self.rows) * self.max_length
-        used = sum(sum(int(e["input_ids"].shape[0]) for e in row)
-                   for row in self.rows)
-        return used / max(total, 1)
+        return int(self.lengths.sum()) / max(total, 1)

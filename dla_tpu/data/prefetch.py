@@ -1,0 +1,128 @@
+"""Background batch prefetch: overlap host-side data work with device steps.
+
+The reference gets pipeline overlap from torch ``DataLoader`` workers
+(``num_workers``, config/sft_config.yaml:14, loaders built in
+src/training/train_sft.py); the TPU-native equivalent is this bounded
+producer/consumer: a daemon thread pulls batches from the source iterator
+(tokenization, packing, collation — all host work) while the device runs
+step N, so batch N+1 is ready the moment the step completes and the chip
+never idles waiting on the host.
+
+Resume correctness: the worker runs ahead of consumption, so the source
+iterator's own position includes batches still sitting in the queue.
+Each queue item therefore carries the source state *after producing that
+batch*, and ``state_dict()`` returns the state of the last batch the
+consumer actually received — checkpoints never skip queued-but-unseen
+batches.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+_SENTINEL = object()
+
+
+class _WorkerError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class PrefetchIterator:
+    """Wrap a (resumable) batch iterator with an N-deep prefetch queue."""
+
+    def __init__(self, source: Any, prefetch: int = 2):
+        if prefetch < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {prefetch}")
+        self.source = source
+        self._q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._finished = False  # worker exhausted the source or errored
+        self._last_state: Dict = self._source_state()
+        self.produced = 0  # batches the worker has finished (for tests)
+
+    # ---------------------------------------------------------------- state
+
+    def _source_state(self) -> Dict:
+        if hasattr(self.source, "state_dict"):
+            return dict(self.source.state_dict())
+        return {}
+
+    def state_dict(self) -> Dict:
+        """Position of the last *consumed* batch (not the read-ahead)."""
+        return dict(self._last_state)
+
+    def load_state_dict(self, state: Dict) -> None:
+        if self._thread is not None:
+            raise RuntimeError(
+                "load_state_dict after iteration started; create a fresh "
+                "PrefetchIterator to seek")
+        if hasattr(self.source, "load_state_dict"):
+            self.source.load_state_dict(state)
+        self._last_state = self._source_state()
+
+    # ------------------------------------------------------------- iterate
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self) -> None:
+        try:
+            for batch in iter(self.source):
+                if not self._put((batch, self._source_state())):
+                    return
+                self.produced += 1
+            self._put((_SENTINEL, None))
+        except BaseException as exc:  # noqa: BLE001 — relayed to consumer
+            self._put((_WorkerError(exc), None))
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise RuntimeError("PrefetchIterator used after close()")
+        if self._finished:
+            # worker already exhausted the source or died: never block on
+            # the empty queue of a dead producer
+            raise StopIteration
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._worker, name="dla-prefetch", daemon=True)
+            self._thread.start()
+        item, state = self._q.get()
+        if item is _SENTINEL:
+            self._finished = True
+            raise StopIteration
+        if isinstance(item, _WorkerError):
+            self._finished = True
+            raise item.exc
+        self._last_state = state or {}
+        return item
+
+    # -------------------------------------------------------------- close
+
+    def close(self) -> None:
+        self._stop.set()
+        # unblock a worker stuck on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

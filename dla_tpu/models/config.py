@@ -126,7 +126,7 @@ register_model("mistral-7b", ModelConfig(
 register_model("qwen2-7b", ModelConfig(
     vocab_size=152064, hidden_size=3584, intermediate_size=18944,
     num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1e6,
-    rms_norm_eps=1e-6, max_seq_length=32768, attention_bias=True))
+    rms_norm_eps=1e-6, max_seq_length=131072, attention_bias=True))
 # phi-2 (2.7B): true architecture — parallel residual block, partial
 # rotary (0.4), LayerNorm, biased projections, GELU MLP (HF
 # microsoft/phi-2 config.json values; weight import in models/hf_import)

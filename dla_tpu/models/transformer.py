@@ -237,10 +237,18 @@ class Transformer:
 
         fsdp shards the embedding/hidden dim; model shards heads / MLP
         hidden / vocab (megatron). Stacked layer leaves lead with None.
+
+        The token-embedding table is deliberately NOT model-sharded: a
+        gather whose operand is sharded on the indexed (vocab) dim forces
+        the SPMD partitioner to rematerialize the full table on every
+        forward ("involuntary full rematerialization"), paying a
+        model-axis all-gather per step. P("fsdp", None) keeps the memory
+        win (ZeRO-3 shard over fsdp, gathered at use like every other
+        matrix) with zero TP-axis traffic on the embed path.
         """
         if self.cfg.arch == "phi":
             specs = {
-                "embed": {"embedding": P("model", "fsdp")},
+                "embed": {"embedding": P("fsdp", None)},
                 "layers": {
                     "ln": P(None, None), "ln_bias": P(None, None),
                     "wq": P(None, "fsdp", "model"),
@@ -264,7 +272,7 @@ class Transformer:
                 specs["lm_head_bias"] = P("model")
             return specs
         specs: Params = {
-            "embed": {"embedding": P("model", "fsdp")},
+            "embed": {"embedding": P("fsdp", None)},
             "layers": {
                 "attn_norm": P(None, None),
                 "wq": P(None, "fsdp", "model"),
@@ -549,14 +557,22 @@ class Transformer:
                 ) -> Tuple[jnp.ndarray, Params]:
         """Run the prompt through the model, writing the cache at [0, T).
 
-        Prompts are right-padded to T; pad positions are masked out of
-        attention and marked invalid in the cache. Returns (last-real-token
-        logits [B, V], cache).
+        Prompts are right-padded to T; pad positions are marked invalid in
+        the cache and the returned logits come from the last *real* token.
+        Returns (last-real-token logits [B, V], cache).
+
+        When the flash backend is on and T tiles its blocks, prefill runs
+        the blockwise kernel with NO [B, T, T] mask materialization —
+        right padding makes the causal structure sufficient: every pad key
+        sits above the causal diagonal of every real query, and pad-query
+        rows are garbage nothing consumes (VERDICT round-1 item 6; the 32k
+        long-context rollout path stays O(T) HBM like training).
         """
         cfg = self.cfg
         b, t = input_ids.shape
         positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
-        kv_mask = jnp.broadcast_to(
+        flash_ok = cfg.attention == "flash" and _flash_tileable(t)
+        kv_mask = None if flash_ok else jnp.broadcast_to(
             attention_mask[:, None, :].astype(bool), (b, t, t))
         x = jnp.take(params["embed"]["embedding"], input_ids, axis=0
                      ).astype(self.adtype)
@@ -564,7 +580,7 @@ class Transformer:
 
         def body(carry, layer):
             h, kv = self._block(layer, carry, cos, sin, kv_mask,
-                                positions, positions)
+                                positions, positions, allow_flash=flash_ok)
             return h, kv
 
         x, (ks, vs) = jax.lax.scan(body, x, params["layers"])

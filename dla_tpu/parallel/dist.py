@@ -70,9 +70,8 @@ def log_main(*args: Any) -> None:
 
 def barrier(name: str = "barrier") -> None:
     """Cross-host barrier (reference: accelerator.wait_for_everyone,
-    train_rlhf.py:164). Implemented as a tiny global psum."""
+    train_rlhf.py:164)."""
     if jax.process_count() == 1:
         return
-    import jax.numpy as jnp
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(name)

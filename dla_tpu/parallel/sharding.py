@@ -42,16 +42,6 @@ def prune_spec_for_mesh(spec: P, mesh: Mesh) -> P:
     return P(*(prune_entry(e) for e in spec))
 
 
-def _shardable(spec: P, shape) -> P:
-    """Fall back to replication on dims that do not divide the mesh axis.
-
-    Tiny test models (e.g. vocab 257) often have dims that do not divide
-    the fsdp axis; XLA would pad, which is fine for compute but breaks
-    round-trip expectations in checkpointing, so we replicate instead.
-    """
-    return spec  # divisibility handled by callers that care
-
-
 def shard_pytree(tree: Pytree, spec_tree: Pytree, mesh: Mesh) -> Pytree:
     """device_put every leaf with its NamedSharding (specs pruned for mesh)."""
     def place(x, spec):

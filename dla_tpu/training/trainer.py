@@ -29,6 +29,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dla_tpu.checkpoint.checkpointer import Checkpointer
+from dla_tpu.data.prefetch import PrefetchIterator
 from dla_tpu.parallel.mesh import data_parallel_size
 from dla_tpu.parallel.sharding import (
     make_global_batch,
@@ -96,15 +97,18 @@ class Trainer:
             fs = sharding_tree(frozen_specs, mesh)
             self.frozen = jax.device_put(frozen, fs)
 
-        def opt_init(p):
-            return self.optimizer.init(p)
-
-        # GSPMD sharding propagation: jitting init with sharded params makes
-        # the Adam moments inherit the param shardings (= partitioned
-        # optimizer state, the ZeRO-3 analog) with no shape bookkeeping.
-        self.opt_state = jax.jit(opt_init)(self.params)
-        self.opt_state_shardings = jax.tree.map(
-            lambda x: x.sharding, self.opt_state)
+        # Partitioned optimizer state (the ZeRO-3 analog): the Adam moments
+        # must carry the SAME sharding as their parameters. Relying on
+        # jit output-sharding propagation is not safe — observed to give
+        # fully-replicated opt state (PartitionSpec()) — so the shardings
+        # are matched explicitly: every opt-state leaf whose path/shape
+        # mirrors a param gets that param's sharding; scalars (step
+        # counts) are replicated.
+        self.opt_state_shardings = _match_opt_shardings(
+            self.optimizer, self.params, self.param_shardings, mesh)
+        self.opt_state = jax.jit(
+            self.optimizer.init,
+            out_shardings=self.opt_state_shardings)(self.params)
 
         self.step = 0
         self._jit_train_step = None
@@ -262,6 +266,19 @@ class Trainer:
         running = RunningMean(100)
         timer = StepTimer()
 
+        # Background prefetch (data.prefetch, default 2; 0 disables):
+        # batch N+1 is tokenized/collated on a host thread while the device
+        # runs step N. The wrapper's state_dict tracks *consumed* batches,
+        # so it replaces any data_state callback that points at the raw
+        # iterator (whose position runs ahead by the queue depth).
+        prefetch_n = int(self.config.get("data", {}).get("prefetch", 2))
+        wrapper = None
+        if prefetch_n > 0 and not isinstance(train_iter, PrefetchIterator) \
+                and hasattr(train_iter, "state_dict"):
+            wrapper = PrefetchIterator(train_iter, prefetch_n)
+            train_iter = wrapper
+            data_state = wrapper.state_dict
+
         if resume:
             aux = self.try_resume()
             # restore data position so resume does not re-feed seen batches
@@ -306,6 +323,8 @@ class Trainer:
         finally:
             # a failed step must not lose an already-open trace window
             self.profile.close()
+            if wrapper is not None:
+                wrapper.close()
 
         self.save(data_state() if data_state else None, extra_aux, tag="final")
         self.logger.finish()
@@ -385,6 +404,40 @@ class Trainer:
         self.step = int(aux.get("step", 0))
         log_rank_zero(f"[dla_tpu] resumed from {tag} @ step {self.step}")
         return aux
+
+
+def _match_opt_shardings(optimizer, params: Pytree, param_shardings: Pytree,
+                         mesh) -> Pytree:
+    """Sharding pytree for ``optimizer.init(params)``: each opt-state leaf
+    whose key-path suffix and shape match a parameter inherits that
+    parameter's sharding (Adam mu/nu mirror the param tree with the param
+    path as suffix); everything else (step counters) is replicated."""
+    replicated = NamedSharding(mesh, P())
+    param_index: Dict[Tuple, Tuple] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        keys = tuple(_path_key(p) for p in path)
+        sh = param_shardings
+        for p in path:
+            sh = sh[p.key] if hasattr(p, "key") else sh[p.idx]
+        param_index[keys] = (tuple(leaf.shape), sh)
+
+    opt_shapes = jax.eval_shape(optimizer.init, params)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_shapes)
+    out = []
+    for path, leaf in flat:
+        keys = tuple(_path_key(p) for p in path)
+        chosen = replicated
+        for n in range(len(keys)):
+            hit = param_index.get(keys[n:])
+            if hit and hit[0] == tuple(leaf.shape):
+                chosen = hit[1]
+                break
+        out.append(chosen)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _path_key(p) -> Any:
+    return p.key if hasattr(p, "key") else getattr(p, "idx", str(p))
 
 
 def _count_tokens(np_batch: Dict[str, Any], mask_key: Optional[str]) -> int:

@@ -77,7 +77,9 @@ class ProfileWindow:
         cfg = profile_cfg or {}
         self.trace_dir = cfg.get("trace_dir")
         self.start_step = int(cfg.get("start_step", 1))
-        self.num_steps = int(cfg.get("num_steps", 3))
+        # a non-positive window (config typo) would otherwise trace the
+        # entire run: the stop check only fires after num_steps captures
+        self.num_steps = max(1, int(cfg.get("num_steps", 3)))
         self.enabled = bool(self.trace_dir) and jax.process_index() == 0
         self._active = False
         self._done = False

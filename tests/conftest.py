@@ -7,23 +7,20 @@ SURVEY.md sec 4's test strategy.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-# A TPU-tunnel PJRT plugin (e.g. platform "axon") may have been registered
-# by a sitecustomize hook at interpreter start, which sets jax_platforms
-# via jax.config — overriding the env var above. Force it back before any
-# backend initializes so the suite gets its 8-device virtual CPU mesh.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+# A TPU-tunnel PJRT plugin (e.g. platform "axon") may have been registered
+# by a sitecustomize hook at interpreter start, which sets jax_platforms
+# via jax.config — overriding plain env vars. _cpuhost forces the 8-device
+# virtual CPU platform back before any backend initializes.
+from _cpuhost import force_cpu_platform  # noqa: E402
+
+assert force_cpu_platform(8), (
+    "could not force an 8-device virtual CPU platform (a backend with the "
+    "wrong platform or device count already initialized in this process); "
+    "run pytest in a fresh interpreter")
 
 import pytest  # noqa: E402
 

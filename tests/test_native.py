@@ -80,6 +80,36 @@ def test_cr_and_crlf_line_endings_match_python(tmp_path, monkeypatch):
     assert native_shard == python_shard == RECORDS[0::2]
 
 
+def test_unicode_whitespace_line_keeps_shards_consistent(tmp_path,
+                                                         monkeypatch):
+    # A line of only non-ASCII Unicode whitespace (U+00A0): the C scanner
+    # counts it as a record, Python str.strip() drops it. The count
+    # cross-check must reject the native index so EVERY shard uses
+    # Python striding — not just the shard the bogus line lands in.
+    p = tmp_path / "nbsp.jsonl"
+    lines = [json.dumps(r) for r in RECORDS]
+    p.write_bytes((lines[0] + "\n  \n" + lines[1] + "\n"
+                   + lines[2] + "\n" + lines[3] + "\n").encode("utf-8"))
+    shards = [read_jsonl(p, shard_index=k, shard_count=2) for k in range(2)]
+    monkeypatch.setattr("dla_tpu.data.jsonl._native_index", lambda _p: None)
+    py_shards = [read_jsonl(p, shard_index=k, shard_count=2)
+                 for k in range(2)]
+    assert shards == py_shards
+    assert sorted((r.get("prompt") for s in shards for r in s)) == sorted(
+        r["prompt"] for r in RECORDS)
+
+
+def test_shard_index_out_of_range_raises(tmp_path):
+    p = tmp_path / "r.jsonl"
+    write_jsonl(p, RECORDS)
+    with pytest.raises(ValueError):
+        read_jsonl(p, shard_index=2, shard_count=2)
+    with pytest.raises(ValueError):
+        read_jsonl(p, shard_index=-1, shard_count=2)
+    with pytest.raises(ValueError):
+        read_jsonl(p, shard_index=0, shard_count=0)
+
+
 def test_empty_and_missing_files(tmp_path):
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")

@@ -1,0 +1,215 @@
+"""Async input pipeline: background prefetch + lazy packing (VERDICT
+round-1 item 5). The reference gets this from torch DataLoader workers
+(num_workers, reference config/sft_config.yaml:14); here it is a bounded
+producer/consumer thread plus length-only lazy packing."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dla_tpu.data.iterator import ShardedBatchIterator
+from dla_tpu.data.prefetch import PrefetchIterator
+
+
+class CountingDataset:
+    """Tiny dataset that records __getitem__ calls and can be slowed."""
+
+    def __init__(self, n=64, delay=0.0):
+        self.n = n
+        self.delay = delay
+        self.calls = 0
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        return {"x": np.full((4,), i, np.int32)}
+
+    def collate(self, examples):
+        return {"x": np.stack([e["x"] for e in examples])}
+
+
+def test_prefetch_produces_ahead_of_consumption():
+    """While the consumer holds batch N, the worker must already have
+    produced batches N+1..N+depth — the definition of overlap."""
+    ds = CountingDataset(64)
+    src = ShardedBatchIterator(ds, 4, seed=0)
+    pf = PrefetchIterator(src, prefetch=3)
+    try:
+        next(pf)  # starts the worker
+        deadline = time.monotonic() + 5.0
+        while pf.produced < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # 1 consumed + 3 queued
+        assert pf.produced >= 4, f"only {pf.produced} batches produced"
+    finally:
+        pf.close()
+
+
+def test_prefetch_overlaps_slow_dataset():
+    """With a slow producer and a slow consumer, total time must be close
+    to max(producer, consumer), not their sum."""
+    per_item = 0.01
+    batch = 4
+    steps = 8
+    ds = CountingDataset(64, delay=per_item)
+    pf = PrefetchIterator(ShardedBatchIterator(ds, batch, seed=0), prefetch=2)
+    step_time = per_item * batch  # consumer work == producer work per batch
+    try:
+        it = iter(pf)
+        next(it)  # warm the pipeline
+        t0 = time.monotonic()
+        for _ in range(steps):
+            time.sleep(step_time)  # simulated device step
+            next(it)
+        elapsed = time.monotonic() - t0
+    finally:
+        pf.close()
+    serial = 2 * step_time * steps  # no-overlap time: produce + consume
+    assert elapsed < serial * 0.8, (
+        f"prefetch gave no overlap: {elapsed:.3f}s vs serial {serial:.3f}s")
+
+
+def test_prefetch_state_tracks_consumed_not_produced():
+    """Checkpoint state must reflect the last batch the trainer saw, not
+    the read-ahead position — else resume skips queued batches."""
+    ds = CountingDataset(64)
+    src = ShardedBatchIterator(ds, 4, seed=3)
+    pf = PrefetchIterator(src, prefetch=4)
+    try:
+        got = [next(pf) for _ in range(3)]
+        deadline = time.monotonic() + 5.0
+        while pf.produced < 6 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert src.state_dict()["step_in_epoch"] > 3  # source ran ahead
+        state = pf.state_dict()
+        assert state["step_in_epoch"] == 3
+    finally:
+        pf.close()
+
+    # resuming from that state yields exactly the 4th batch of a cold run
+    cold = iter(ShardedBatchIterator(CountingDataset(64), 4, seed=3))
+    for _ in range(3):
+        next(cold)
+    want = next(cold)
+    resumed_src = ShardedBatchIterator(CountingDataset(64), 4, seed=3)
+    pf2 = PrefetchIterator(resumed_src, prefetch=4)
+    pf2.load_state_dict(state)
+    try:
+        got4 = next(pf2)
+    finally:
+        pf2.close()
+    np.testing.assert_array_equal(got4["x"], want["x"])
+    del got
+
+
+def test_prefetch_propagates_worker_errors():
+    class Boom:
+        def __iter__(self):
+            yield {"x": np.zeros(1)}
+            raise RuntimeError("worker died")
+
+    pf = PrefetchIterator(Boom(), prefetch=2)
+    try:
+        next(pf)
+        with pytest.raises(RuntimeError, match="worker died"):
+            next(pf)
+    finally:
+        pf.close()
+
+
+def test_prefetch_finite_source_stops():
+    class Finite:
+        def __iter__(self):
+            for i in range(3):
+                yield i
+
+    pf = PrefetchIterator(Finite(), prefetch=2)
+    try:
+        assert list(pf) == [0, 1, 2]
+    finally:
+        pf.close()
+
+
+def test_lazy_packing_matches_eager_and_defers_tokenization(tmp_path):
+    from dla_tpu.data.jsonl import write_jsonl
+    from dla_tpu.data.loaders import build_instruction_dataset
+    from dla_tpu.data.packing import PackedInstructionDataset
+    from dla_tpu.data.tokenizers import ByteTokenizer
+
+    p = tmp_path / "sft.jsonl"
+    write_jsonl(p, [{"prompt": f"q{i}" * (1 + i % 7),
+                     "response": f"a{i}" * (1 + i % 5)} for i in range(40)])
+    cfg = {"source": "local", "train_path": str(p), "max_seq_length": 48}
+    base = build_instruction_dataset(cfg, ByteTokenizer(), split="train")
+
+    eager = PackedInstructionDataset(base, 48, lazy=False)
+    lazy = PackedInstructionDataset(base, 48, lazy=True)
+    assert len(eager) == len(lazy)
+    assert eager.packing_efficiency() == lazy.packing_efficiency()
+    for i in range(len(eager)):
+        a, b = eager[i], lazy[i]
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
+    # lazy __init__ holds no tokenized corpus
+    assert lazy._examples == []
+
+
+def test_trainer_fit_uses_prefetch(tmp_path):
+    """End-to-end: Trainer.fit with data.prefetch wraps the iterator, the
+    run completes, and the checkpoint data_state matches consumed steps."""
+    import jax
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.ops.losses import cross_entropy_loss
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.training.trainer import Trainer
+
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
+    cfg = get_model_config("tiny")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+
+    def loss_fn(p, frozen, batch, rng):
+        del frozen, rng
+        logits = model.apply(p, batch["input_ids"],
+                             attention_mask=batch["attention_mask"])
+        loss, _ = cross_entropy_loss(logits, batch["labels"])
+        return loss, {}
+
+    class LMDataset(CountingDataset):
+        def __getitem__(self, i):
+            self.calls += 1
+            ids = np.full((8,), (i % 100) + 1, np.int32)
+            return {"input_ids": ids,
+                    "attention_mask": np.ones(8, np.int32),
+                    "labels": ids}
+
+        def collate(self, examples):
+            return {k: np.stack([e[k] for e in examples])
+                    for k in examples[0]}
+
+    config = {
+        "experiment_name": "pf_test",
+        "optimization": {"total_batch_size": 8, "micro_batch_size": 1,
+                         "learning_rate": 1e-3, "max_train_steps": 3,
+                         "lr_scheduler": "constant", "max_grad_norm": 1.0},
+        "data": {"prefetch": 2},
+        "logging": {"output_dir": str(tmp_path / "ck"), "log_dir": None},
+        "hardware": {"gradient_accumulation_steps": 1},
+    }
+    with jax.sharding.set_mesh(mesh):
+        trainer = Trainer(config=config, mesh=mesh, loss_fn=loss_fn,
+                          params=params,
+                          param_specs=model.partition_specs())
+        it = ShardedBatchIterator(LMDataset(64), 8, seed=0)
+        trainer.fit(it, rng=jax.random.key(1), data_state=it.state_dict)
+
+    from dla_tpu.checkpoint import load_tree_numpy
+    _, aux = load_tree_numpy(tmp_path / "ck")
+    assert aux["step"] == 3
+    assert aux["data_state"]["step_in_epoch"] == 3

@@ -1,0 +1,160 @@
+"""Sharding-layout regression tests (VERDICT round-1 items 3 and 7).
+
+1. The token-embedding table must not be model(TP)-sharded: a gather
+   whose operand is sharded on the indexed dim makes the SPMD partitioner
+   replicate the full table every forward ("involuntary full
+   rematerialization") — a silent model-axis all-gather tax per step.
+2. Inter-block activations must actually carry ACT_SPEC sharding under a
+   TP/CP mesh — the with_sharding_constraint calls are only useful if the
+   compiled program honors them; a wrong constraint would silently
+   degrade to replication.
+"""
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+import pytest
+
+from dla_tpu.models.config import get_model_config
+from dla_tpu.models.transformer import ACT_SPEC, Transformer
+from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+from dla_tpu.parallel.sharding import prune_spec_for_mesh, shard_pytree
+
+
+def test_embed_spec_has_no_model_axis():
+    """Guard: no partition spec on the embedding table mentions the TP axis."""
+    for preset in ("tiny", "tiny-gqa", "phi-2"):
+        model = Transformer(get_model_config(preset))
+        spec = model.partition_specs()["embed"]["embedding"]
+        flat = []
+        for entry in spec:
+            if isinstance(entry, (tuple, list)):
+                flat.extend(entry)
+            elif entry is not None:
+                flat.append(entry)
+        assert "model" not in flat, (
+            f"{preset}: embedding spec {spec} is TP-sharded; the gather "
+            "would force full-table rematerialization")
+
+
+def test_no_model_axis_allgather_of_embedding_table():
+    """On a pure data x TP mesh (fsdp=1) the embedding table must compile
+    with zero collectives: any all-gather materializing the full [V, D]
+    table is the involuntary-full-remat tax this layout exists to avoid."""
+    cfg = get_model_config("tiny")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    mesh = build_mesh(MeshConfig(data=4, fsdp=1, model=2, sequence=1))
+    sharded = shard_pytree(params, model.partition_specs(), mesh)
+    ids = jnp.ones((4, 16), jnp.int32)
+    with jax.sharding.set_mesh(mesh):
+        compiled = jax.jit(model.apply).lower(sharded, ids).compile()
+    hlo = compiled.as_text()
+    V, D = cfg.vocab_size, cfg.hidden_size
+    table_shape = rf"\[{V},{D}\]"
+    offenders = [ln for ln in hlo.splitlines()
+                 if "all-gather" in ln and re.search(table_shape, ln)]
+    assert not offenders, (
+        "embedding table is re-materialized by all-gather:\n"
+        + "\n".join(offenders[:3]))
+
+
+def test_interblock_activations_sharded_under_tp_cp(tiny_cfg):
+    """hidden_states under a TP x CP x batch mesh must come out sharded per
+    ACT_SPEC (batch over data+fsdp, sequence over the CP axis) — proves the
+    activation constraints are honored, not silently replicated."""
+    mesh = build_mesh(MeshConfig(data=2, fsdp=1, model=2, sequence=2))
+    model = Transformer(tiny_cfg)
+    params = model.init(jax.random.key(0))
+    sharded = shard_pytree(params, model.partition_specs(), mesh)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    with jax.sharding.set_mesh(mesh):
+        h = jax.jit(model.hidden_states)(sharded, ids)
+    h.block_until_ready()
+    expected = NamedSharding(mesh, prune_spec_for_mesh(ACT_SPEC, mesh))
+    assert h.sharding.is_equivalent_to(expected, h.ndim), (
+        f"inter-block activations carry {h.sharding.spec}, "
+        f"expected {expected.spec}")
+
+
+def test_interblock_activation_sharding_constraint_annotated(tiny_cfg):
+    """The pre-SPMD lowering must contain ACT_SPEC Sharding custom-calls on
+    [B, T, D] activations: deleting a with_sharding_constraint would pass
+    output-propagation tests by luck but fails this one."""
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, sequence=1))
+    model = Transformer(tiny_cfg)
+    params = model.init(jax.random.key(0))
+    sharded = shard_pytree(params, model.partition_specs(), mesh)
+    ids = jnp.ones((4, 16), jnp.int32)
+    with jax.sharding.set_mesh(mesh):
+        lowered = jax.jit(model.hidden_states).lower(sharded, ids)
+    txt = lowered.as_text()
+    d = tiny_cfg.hidden_size
+    # Shardy lowering: sdy.sharding_constraint <@mesh, [{"data","fsdp"},
+    # {"sequence"}, {}]> on a [B, T, D] tensor. (Pre-Shardy jax lowered the
+    # same thing as a @Sharding custom call; accept either.)
+    sdy = re.compile(
+        r'sdy\.sharding_constraint[^\n]*\[\{"data", "fsdp"\}, '
+        r'\{"sequence"\}, \{\}\][^\n]*tensor<4x16x%d' % d)
+    if "sdy.sharding_constraint" in txt:
+        assert sdy.search(txt), (
+            "no ACT_SPEC sharding_constraint on [B,T,D] activations in "
+            "the lowering")
+    else:
+        want = NamedSharding(mesh, prune_spec_for_mesh(ACT_SPEC, mesh))
+        hlo_sharding = str(want._to_xla_hlo_sharding(3))
+        assert "@Sharding" in txt and hlo_sharding in txt, (
+            f"no activation sharding annotation {hlo_sharding} in lowering")
+
+
+def test_optimizer_state_inherits_param_shardings(mesh8, tiny_cfg):
+    """Adam moments must be sharded exactly like their params (partitioned
+    optimizer state = the ZeRO-3 analog). jit output propagation does NOT
+    guarantee this (observed: fully-replicated opt state), so the Trainer
+    matches shardings explicitly — this pins it."""
+    import jax
+    from dla_tpu.training.trainer import Trainer
+    from dla_tpu.ops.losses import cross_entropy_loss
+
+    model = Transformer(tiny_cfg)
+    params = model.init(jax.random.key(0))
+
+    def loss_fn(p, frozen, batch, rng):
+        del frozen, rng
+        logits = model.apply(p, batch["input_ids"])
+        loss, _ = cross_entropy_loss(logits, batch["labels"])
+        return loss, {}
+
+    config = {
+        "experiment_name": "optshard",
+        "optimization": {"total_batch_size": 4, "micro_batch_size": 1,
+                         "learning_rate": 1e-3, "max_train_steps": 1,
+                         "lr_scheduler": "constant", "max_grad_norm": 1.0},
+        "logging": {"output_dir": "/tmp/optshard_ck", "log_dir": None},
+        "hardware": {"gradient_accumulation_steps": 1},
+    }
+    with jax.sharding.set_mesh(mesh8):
+        trainer = Trainer(config=config, mesh=mesh8, loss_fn=loss_fn,
+                          params=params,
+                          param_specs=model.partition_specs())
+        flat_p = {tuple(str(k) for k in path): leaf for path, leaf in
+                  jax.tree_util.tree_flatten_with_path(trainer.params)[0]}
+        checked = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                trainer.opt_state)[0]:
+            keys = tuple(str(k) for k in path)
+            for n in range(len(keys)):
+                p_leaf = flat_p.get(keys[n:])
+                if p_leaf is not None and p_leaf.shape == leaf.shape:
+                    assert leaf.sharding.is_equivalent_to(
+                        p_leaf.sharding, leaf.ndim), (
+                        f"opt leaf {keys} sharding {leaf.sharding} != "
+                        f"param {p_leaf.sharding}")
+                    checked += 1
+                    break
+        # every param has mu and nu moments
+        n_params = len(jax.tree.leaves(trainer.params))
+        assert checked >= 2 * n_params, (checked, n_params)
