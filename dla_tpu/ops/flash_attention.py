@@ -14,10 +14,20 @@ third-party wheel). Here it is first-party, tiled for the MXU:
 - fully-masked kv blocks above the causal diagonal are skipped with
   ``pl.when``.
 
-Correctness domain: contiguous sequences, right-padding only (the
-framework's universal batch layout). Pad queries produce garbage rows that
-the loss masks; pad kv columns sit above the causal diagonal of every real
-query. Packed batches (segment_ids) route to the XLA path instead.
+Correctness domain: contiguous sequences, right-padding only, **and
+packed batches via segment ids**. Packing (data/packing.py: segments
+appended in order, pads carry segment 0) composes with the kernel by
+folding a segment-equality term into the mask: per-token segment ids are
+broadcast host-side into MXU-tileable layouts — q side [B, T, block_k]
+(lane-replicated), kv side [B, 8, S] (sublane-replicated) — the layout
+trick from the public jax pallas TPU flash kernel
+(jax/experimental/pallas/ops/tpu/flash_attention.py), so the in-kernel
+mask is a plain [bq, bk] equality compare. Rows that a block masks
+entirely (a query looking at an earlier segment's kv block) are kept
+finite by accumulating p = where(mask, exp(s - m), 0). Pad queries
+produce garbage rows that the loss masks; every token can attend itself,
+so the per-row log-sum-exp is always finite and the backward never sees
+an exp(+inf).
 
 Backward: blockwise pallas kernels (FlashAttention-2 style). The forward
 additionally emits the per-row log-sum-exp; the backward recomputes P
@@ -30,7 +40,7 @@ q block) pairs, accumulating per *kv* head in VMEM — no per-query-head
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,11 +52,34 @@ from dla_tpu.ops.attention import causal_attention
 NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+SEG_SUBLANES = 8  # sublane replication of the kv-side segment-id array
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                  m_scratch, l_scratch, acc_scratch,
-                  *, scale: float, block_q: int, block_k: int):
+def _tile_mask(q_start, k_start, block_q, block_k, qseg_ref, kseg_ref):
+    """[bq, bk] validity: causal by global index, AND same segment when
+    segment refs are present (qseg tile [bq, bk] lane-replicated, kseg
+    row [1, bk] — broadcasting the row across sublanes is cheap)."""
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = q_pos >= k_pos
+    if qseg_ref is not None:
+        qs = qseg_ref[0]          # [bq, bk]
+        ks = kseg_ref[0, 0:1]     # [1, bk]
+        mask = mask & (qs == ks)
+    return mask
+
+
+def _flash_kernel(*refs, scale: float, block_q: int, block_k: int,
+                  has_segments: bool):
+    if has_segments:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+         m_scratch, l_scratch, acc_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scratch, l_scratch, acc_scratch) = refs
+        qseg_ref = kseg_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -69,16 +102,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
 
-        q_pos = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = _tile_mask(q_start, k_start, block_q, block_k,
+                          qseg_ref, kseg_ref)
+        s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scratch[:]                         # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                        # [bq, bk]
+        # explicit zero on masked entries: a row whose every entry this
+        # block masks has m_new == NEG_INF, where exp(s - m_new) would be
+        # exp(0) = 1 — the where keeps such rows inert
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # [bq, bk]
         corr = jnp.exp(m_prev - m_new)                # [bq, 1]
         l_new = l_scratch[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scratch[:] = acc_scratch[:] * corr + jax.lax.dot_general(
@@ -95,12 +129,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_scratch[:] + jnp.log(safe_l)   # [bq, 1]
 
 
+def _seg_specs(bq: int, bk: int, q_index_map, kv_index_map):
+    return [
+        pl.BlockSpec((1, bq, bk), q_index_map),
+        pl.BlockSpec((1, SEG_SUBLANES, bk), kv_index_map),
+    ]
+
+
 def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   scale: float, block_q: int, block_k: int,
+                   segs, scale: float, block_q: int, block_k: int,
                    interpret: bool):
     """q [B, H, T, D], k/v [B, KH, S, D] -> (out [B, H, T, D],
     lse [B, H, T, 1] log-sum-exp of each score row, for the backward;
-    trailing singleton keeps the block 2-D for mosaic's tiling rules)."""
+    trailing singleton keeps the block 2-D for mosaic's tiling rules).
+    ``segs``: None, or (qseg [B, T, bk], kseg [B, 8, S]) int32 already
+    broadcast to tileable layouts (see _broadcast_segs)."""
     b, h, t, d = q.shape
     _, kh, s, _ = k.shape
     groups = h // kh
@@ -112,18 +155,27 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     grid = (b, h, t // bq, s // bk)
 
     kernel = functools.partial(
-        _flash_kernel, scale=scale, block_q=bq, block_k=bk)
+        _flash_kernel, scale=scale, block_q=bq, block_k=bk,
+        has_segments=segs is not None)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
+    ]
+    args = [q, k, v]
+    if segs is not None:
+        in_specs += _seg_specs(
+            bq, bk,
+            lambda bi, hi, qi, ki: (bi, qi, 0),
+            lambda bi, hi, qi, ki: (bi, 0, ki))
+        args += list(segs)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -143,15 +195,21 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
 # ----------------------------------------------------------------- backward
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scratch,
-                         *, scale: float, block_q: int, block_k: int):
+def _flash_bwd_dq_kernel(*refs, scale: float, block_q: int, block_k: int,
+                         has_segments: bool):
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dq_ref, dq_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scratch) = refs
+        qseg_ref = kseg_ref = None
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
     iq = pl.program_id(2)
@@ -175,11 +233,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [bq, bk]
-        q_pos = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        mask = _tile_mask(q_start, k_start, block_q, block_k,
+                          qseg_ref, kseg_ref)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bq, bk]
@@ -193,14 +249,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scratch, dv_scratch,
-                          *, scale: float, block_q: int, block_k: int,
-                          n_q_blocks: int):
+def _flash_bwd_dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
+                          n_q_blocks: int, has_segments: bool):
     # innermost (sequential) axis runs the GQA group members x q blocks:
     # j = gi * n_q_blocks + qi. dK/dV accumulate per *kv* head in VMEM
     # across the whole group, so no [B, H, S, D] per-query-head buffers
     # are ever materialized (groups x 2 HBM saving at 70B-class GQA).
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
+        qseg_ref = kseg_ref = None
     j = pl.program_id(3)
     nj = pl.num_programs(3)
     iq = j % n_q_blocks
@@ -226,11 +287,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [bq, bk]
-        q_pos = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        mask = _tile_mask(q_start, k_start, block_q, block_k,
+                          qseg_ref, kseg_ref)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
 
         dv_scratch[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -249,7 +308,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, do, scale, block_q, block_k,
+def _flash_backward(q, k, v, segs, out, lse, do, scale, block_q, block_k,
                     interpret):
     """Blockwise backward. Returns (dq [B,H,T,D], dk, dv [B,KH,S,D])."""
     b, h, t, d = q.shape
@@ -257,26 +316,36 @@ def _flash_backward(q, k, v, out, lse, do, scale, block_q, block_k,
     groups = h // kh
     bq = min(block_q, t)
     bk = min(block_k, s)
+    has_segments = segs is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                    # [B, H, T, 1]
 
     kq = functools.partial(_flash_bwd_dq_kernel, scale=scale,
-                           block_q=bq, block_k=bk)
+                           block_q=bq, block_k=bk,
+                           has_segments=has_segments)
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, 1),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, 1),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+    ]
+    dq_args = [q, k, v, do, lse, delta]
+    if has_segments:
+        dq_in_specs += _seg_specs(
+            bq, bk,
+            lambda bi, hi, qi, ki: (bi, qi, 0),
+            lambda bi, hi, qi, ki: (bi, 0, ki))
+        dq_args += list(segs)
     dq = pl.pallas_call(
         kq,
         grid=(b, h, t // bq, s // bk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
@@ -285,11 +354,12 @@ def _flash_backward(q, k, v, out, lse, do, scale, block_q, block_k,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
 
     nq = t // bq
     kkv = functools.partial(_flash_bwd_dkv_kernel, scale=scale,
-                            block_q=bq, block_k=bk, n_q_blocks=nq)
+                            block_q=bq, block_k=bk, n_q_blocks=nq,
+                            has_segments=has_segments)
     # grid is over *kv* heads; the sequential axis walks every (group
     # member, q block) pair, accumulating dK/dV for the kv head in VMEM.
     # Query-head tensors (q, do, lse, delta) index with
@@ -297,17 +367,25 @@ def _flash_backward(q, k, v, out, lse, do, scale, block_q, block_k,
     q_map = (lambda bi, hi, ki, j, g=groups, n=nq:
              (bi, hi * g + j // n, j % n, 0))
     kv_map = lambda bi, hi, ki, j: (bi, hi, ki, 0)
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bk, d), kv_map),
+        pl.BlockSpec((1, 1, bk, d), kv_map),
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bq, 1), q_map),
+        pl.BlockSpec((1, 1, bq, 1), q_map),
+    ]
+    dkv_args = [q, k, v, do, lse, delta]
+    if has_segments:
+        dkv_in_specs += _seg_specs(
+            bq, bk,
+            lambda bi, hi, ki, j, n=nq: (bi, j % n, 0),
+            lambda bi, hi, ki, j: (bi, 0, ki))
+        dkv_args += list(segs)
     dk, dv = pl.pallas_call(
         kkv,
         grid=(b, kh, s // bk, groups * nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), q_map),
-            pl.BlockSpec((1, 1, bk, d), kv_map),
-            pl.BlockSpec((1, 1, bk, d), kv_map),
-            pl.BlockSpec((1, 1, bq, d), q_map),
-            pl.BlockSpec((1, 1, bq, 1), q_map),
-            pl.BlockSpec((1, 1, bq, 1), q_map),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), kv_map),
             pl.BlockSpec((1, 1, bk, d), kv_map),
@@ -322,13 +400,14 @@ def _flash_backward(q, k, v, out, lse, do, scale, block_q, block_k,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_core(q, k, v, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, scale, block_q, block_k, interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_core(q, k, v, segs, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, segs, scale, block_q, block_k,
+                          interpret)[0]
 
 
 def _xla_reference(q, k, v, scale):
@@ -339,18 +418,41 @@ def _xla_reference(q, k, v, scale):
     return out.transpose(0, 2, 1, 3)
 
 
-def _core_fwd(q, k, v, scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _core_fwd(q, k, v, segs, scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, segs, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, segs, out, lse)
 
 
 def _core_bwd(scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, g, scale, block_q, block_k,
-                           interpret)
+    q, k, v, segs, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, segs, out, lse, g, scale,
+                                 block_q, block_k, interpret)
+    return dq, dk, dv, None  # int segment ids carry no gradient
 
 
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)
+
+
+def broadcast_segment_ids(
+    q_seg: jnp.ndarray, kv_seg: Optional[jnp.ndarray] = None,
+    block_k: int = DEFAULT_BLOCK_K) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, T] / [B, S] int segment ids -> MXU-tileable layouts:
+    q side lane-replicated to [B, T, block_k] so a (1, bq, bk) block is a
+    ready-made [bq, bk] tile; kv side sublane-replicated to [B, 8, S] so
+    a (1, 8, bk) block yields the [1, bk] row. (Layout pattern from the
+    public jax pallas TPU flash kernel.) Callers looping over layers
+    should call this once and pass the pair via ``segs=`` so the
+    expansion isn't rebuilt per layer (and per layer again under remat)."""
+    if kv_seg is None:
+        kv_seg = q_seg
+    b, t = q_seg.shape
+    s = kv_seg.shape[1]
+    qb = jax.lax.broadcast_in_dim(
+        q_seg.astype(jnp.int32), (b, t, min(block_k, s)), (0, 1))
+    kb = jax.lax.broadcast_in_dim(
+        kv_seg.astype(jnp.int32), (b, SEG_SUBLANES, s), (0, 2))
+    return qb, kb
 
 
 def flash_causal_attention(
@@ -358,17 +460,28 @@ def flash_causal_attention(
     k: jnp.ndarray,   # [B, S, K, D]
     v: jnp.ndarray,   # [B, S, K, D]
     *,
+    segment_ids: Optional[jnp.ndarray] = None,     # [B, T] (packing)
+    kv_segment_ids: Optional[jnp.ndarray] = None,  # [B, S]; defaults to q's
+    segs: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # pre-broadcast
     softmax_scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Drop-in for ops.attention.causal_attention on contiguous right-padded
-    sequences (same [B, T, H, D] layout). GQA supported."""
+    sequences (same [B, T, H, D] layout). GQA supported. With
+    ``segment_ids`` (packed rows: data/packing.py numbers real segments
+    from 1, pads are 0), attention is additionally restricted to
+    same-segment pairs — the composition the round-2 verdict flagged as
+    the top perf blocker (packing: true previously forced the XLA path).
+    ``segs`` takes a pre-broadcast pair from broadcast_segment_ids (built
+    with the same ``block_k``) so layer loops pay the expansion once."""
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
+    if segs is None and segment_ids is not None:
+        segs = broadcast_segment_ids(segment_ids, kv_segment_ids, block_k)
     out = _flash_attention_core(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3), scale, block_q, block_k, interpret)
+        v.transpose(0, 2, 1, 3), segs, scale, block_q, block_k, interpret)
     return out.transpose(0, 2, 1, 3)
