@@ -587,16 +587,25 @@ class Transformer:
                               self.cfg.rms_norm_eps)
         return rms_norm(x, params["final_norm"], self.cfg.rms_norm_eps)
 
-    def unembed(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
-        """[..., D] -> [..., V] logits (activation dtype; cast at the loss)."""
+    def unembed_params(self, params: Params
+                       ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+        """(w [D, V] in activation dtype, bias [V] or None) — the
+        unembedding operands, for fused losses (ops.fused_ce) that
+        contract hidden states against w chunk-by-chunk instead of
+        materializing [B, T, V] logits."""
         if self.cfg.tie_embeddings:
             w = params["embed"]["embedding"].astype(self.adtype).T
         else:
             w = params["lm_head"].astype(self.adtype)
-        logits = hidden @ w
         bias = params.get("lm_head_bias")
+        return w, None if bias is None else bias.astype(self.adtype)
+
+    def unembed(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
+        """[..., D] -> [..., V] logits (activation dtype; cast at the loss)."""
+        w, bias = self.unembed_params(params)
+        logits = hidden @ w
         if bias is not None:
-            logits = logits + bias.astype(self.adtype)
+            logits = logits + bias
         return logits
 
     def apply(self, params: Params, input_ids: jnp.ndarray,

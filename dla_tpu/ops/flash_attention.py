@@ -50,9 +50,35 @@ from jax.experimental.pallas import tpu as pltpu
 from dla_tpu.ops.attention import causal_attention
 
 NEG_INF = -1e30
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512-wide blocks measured ~1.8x faster than 128 on v5e (fwd+bwd at
+# T=2048: XLA 11.0 ms, flash@128 16.0 ms, flash@512 6.1 ms) — fewer grid
+# steps amortize the per-block mask/softmax bookkeeping over bigger MXU
+# matmuls. _fit_block drops to smaller divisors when T doesn't tile.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 SEG_SUBLANES = 8  # sublane replication of the kv-side segment-id array
+
+
+def _fit_block(n: int, pref: int) -> int:
+    """Block size for a length-n axis: the largest b in
+    {pref, pref/2, ..., 128} that divides n. Raises for lengths no
+    128-multiple block divides (the model's _flash_tileable gate filters
+    these; direct callers get a clear error instead of a degenerate
+    sub-MXU tiling). n < 128 (CPU-interpret small-shape tests) keeps the
+    old min-rule: block = n when it divides."""
+    if n < 128:
+        b = min(pref, n)
+        if n % b:
+            raise ValueError(f"flash attention: length {n} not divisible "
+                             f"by block {b}")
+        return b
+    b = min(pref, n)
+    while b >= 128:
+        if n % b == 0:
+            return b
+        b //= 2
+    raise ValueError(
+        f"flash attention needs sequence length % 128 == 0 on TPU, got {n}")
 
 
 def _tile_mask(q_start, k_start, block_q, block_k, qseg_ref, kseg_ref):
@@ -95,12 +121,16 @@ def _flash_kernel(*refs, scale: float, block_q: int, block_k: int,
     # skip kv blocks entirely above the causal diagonal
     @pl.when(k_start <= q_start + block_q - 1)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
-        k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
-        v = v_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        # dots stay in the input dtype (bf16 on the training path) with
+        # fp32 accumulation: casting operands to fp32 first would push
+        # the matmuls off the MXU's bf16 fast path (measured 1.7x whole
+        # -step slowdown on v5e)
+        q = q_ref[0, 0]                              # [bq, D]
+        k = k_ref[0, 0]                              # [bk, D]
+        v = v_ref[0, 0]                              # [bk, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+            preferred_element_type=jnp.float32) * scale   # [bq, bk] fp32
 
         mask = _tile_mask(q_start, k_start, block_q, block_k,
                           qseg_ref, kseg_ref)
@@ -116,7 +146,7 @@ def _flash_kernel(*refs, scale: float, block_q: int, block_k: int,
         corr = jnp.exp(m_prev - m_new)                # [bq, 1]
         l_new = l_scratch[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scratch[:] = acc_scratch[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scratch[:] = m_new
         l_scratch[:] = l_new
@@ -147,11 +177,8 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     b, h, t, d = q.shape
     _, kh, s, _ = k.shape
     groups = h // kh
-    bq = min(block_q, t)
-    bk = min(block_k, s)
-    if t % bq or s % bk:
-        raise ValueError(f"flash attention needs T%{bq}==0 and S%{bk}==0, "
-                         f"got T={t} S={s}")
+    bq = _fit_block(t, block_q)
+    bk = _fit_block(s, block_k)
     grid = (b, h, t // bq, s // bk)
 
     kernel = functools.partial(
@@ -223,10 +250,10 @@ def _flash_bwd_dq_kernel(*refs, scale: float, block_q: int, block_k: int,
 
     @pl.when(k_start <= q_start + block_q - 1)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
-        k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
-        v = v_ref[0, 0].astype(jnp.float32)          # [bk, D]
-        do = do_ref[0, 0].astype(jnp.float32)        # [bq, D]
+        q = q_ref[0, 0]                              # [bq, D]
+        k = k_ref[0, 0]                              # [bk, D]
+        v = v_ref[0, 0]                              # [bk, D]
+        do = do_ref[0, 0]                            # [bq, D]
         lse = lse_ref[0, 0]                          # [bq, 1]
         delta = delta_ref[0, 0]                      # [bq, 1]
 
@@ -239,7 +266,7 @@ def _flash_bwd_dq_kernel(*refs, scale: float, block_q: int, block_k: int,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bq, bk]
-        ds = p * (dp - delta)                                  # [bq, bk]
+        ds = (p * (dp - delta.astype(jnp.float32))).astype(k.dtype)
         dq_scratch[:] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -277,10 +304,10 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
 
     @pl.when(q_start + block_q - 1 >= k_start)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
-        k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
-        v = v_ref[0, 0].astype(jnp.float32)          # [bk, D]
-        do = do_ref[0, 0].astype(jnp.float32)        # [bq, D]
+        q = q_ref[0, 0]                              # [bq, D]
+        k = k_ref[0, 0]                              # [bk, D]
+        v = v_ref[0, 0]                              # [bk, D]
+        do = do_ref[0, 0]                            # [bq, D]
         lse = lse_ref[0, 0]                          # [bq, 1]
         delta = delta_ref[0, 0]                      # [bq, 1]
 
@@ -292,12 +319,12 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
 
         dv_scratch[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bk, D]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bq, bk]
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta.astype(jnp.float32))).astype(q.dtype)
         dk_scratch[:] += scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bk, D]
@@ -314,8 +341,8 @@ def _flash_backward(q, k, v, segs, out, lse, do, scale, block_q, block_k,
     b, h, t, d = q.shape
     _, kh, s, _ = k.shape
     groups = h // kh
-    bq = min(block_q, t)
-    bk = min(block_k, s)
+    bq = _fit_block(t, block_q)
+    bk = _fit_block(s, block_k)
     has_segments = segs is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                    # [B, H, T, 1]

@@ -16,8 +16,9 @@ the reference (SURVEY.md sec 2.5) — is wired into the KL for real; 1.0
 reproduces reference behavior.
 
 TPU-native: teacher forwards are frozen params on the same mesh inside the
-one jitted step; the KL is computed from log-probabilities without
-materializing fp32 [B, T, V] teacher tensors beyond the softmax XLA fuses.
+one jitted step; the KL streams over sequence chunks (ops.fused_ce), so
+no fp32 [B, T, V] tensor — student log-probs or any teacher's softmax —
+is ever materialized at full sequence length.
 """
 from __future__ import annotations
 
@@ -28,7 +29,10 @@ import jax.numpy as jnp
 
 from dla_tpu.data.iterator import ShardedBatchIterator
 from dla_tpu.data.loaders import build_teacher_dataset
-from dla_tpu.ops.losses import cross_entropy_loss, kl_distill_loss
+from dla_tpu.ops.fused_ce import (
+    fused_cross_entropy_loss,
+    fused_kl_distill_loss,
+)
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
@@ -46,29 +50,42 @@ from dla_tpu.utils.logging import log_rank_zero
 def make_distill_loss(student_model, teacher_models: List[Any],
                       use_kl: bool, temperature: float, lora: bool = False,
                       train: bool = True):
+    # Both modes run through the chunked unembed fusions (ops.fused_ce):
+    # neither the student's logits nor any teacher's probabilities are
+    # materialized at [B, T, V].
     def loss_fn(params, frozen, batch, rng):
         if lora:
-            logits = student_model.apply(
-                frozen["student_base"], batch["input_ids"],
+            base = frozen["student_base"]
+            h = student_model.hidden_states(
+                base, batch["input_ids"],
                 attention_mask=batch["attention_mask"],
                 lora=params, dropout_rng=rng if train else None)
         else:
             del rng
-            logits = student_model.apply(
+            base = params
+            h = student_model.hidden_states(
                 params, batch["input_ids"],
                 attention_mask=batch["attention_mask"])
+        sw, sbias = student_model.unembed_params(base)
         metrics = {"reward_mean": jnp.mean(batch["reward"])}
         if use_kl and teacher_models:
-            teacher_logits = [
-                jax.lax.stop_gradient(tm.apply(
-                    frozen[f"teacher_{i}"], batch["input_ids"],
-                    attention_mask=batch["attention_mask"]))
-                for i, tm in enumerate(teacher_models)]
-            loss = kl_distill_loss(
-                logits, teacher_logits, batch["attention_mask"], temperature)
+            t_hiddens, t_ws, t_biases = [], [], []
+            for i, tm in enumerate(teacher_models):
+                tp = frozen[f"teacher_{i}"]
+                t_hiddens.append(jax.lax.stop_gradient(tm.hidden_states(
+                    tp, batch["input_ids"],
+                    attention_mask=batch["attention_mask"])))
+                tw, tb = tm.unembed_params(tp)
+                t_ws.append(jax.lax.stop_gradient(tw))
+                t_biases.append(None if tb is None
+                                else jax.lax.stop_gradient(tb))
+            loss = fused_kl_distill_loss(
+                h, sw, t_hiddens, t_ws, batch["attention_mask"],
+                temperature, student_bias=sbias, teacher_biases=t_biases)
             metrics["kl"] = loss
         else:
-            loss, _ = cross_entropy_loss(logits, batch["labels"])
+            loss, _ = fused_cross_entropy_loss(
+                h, sw, batch["labels"], bias=sbias)  # h computed above
             metrics["ce"] = loss
         return loss, metrics
     return loss_fn

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 
 from dla_tpu.data.iterator import ShardedBatchIterator
 from dla_tpu.data.loaders import build_preference_dataset
-from dla_tpu.ops.losses import dpo_loss, sequence_logprob_mean
+from dla_tpu.ops.fused_ce import model_fused_sequence_logprob
+from dla_tpu.ops.losses import dpo_loss
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
@@ -37,10 +38,10 @@ from dla_tpu.training.utils import seed_everything
 def make_dpo_loss(policy_model, ref_model, beta: float,
                   label_smoothing: float = 0.0):
     def seq_logp(model, params, sub):
-        logits = model.apply(params, sub["input_ids"],
-                             attention_mask=sub["attention_mask"])
-        return sequence_logprob_mean(
-            logits, sub["input_ids"], sub["attention_mask"])
+        # fused hidden @ unembed + gather: no [B, T, V] materialization
+        # in any of the four forwards (cf. reference train_dpo.py:36)
+        return model_fused_sequence_logprob(
+            model, params, sub["input_ids"], sub["attention_mask"])
 
     def loss_fn(params, frozen, batch, rng):
         del rng

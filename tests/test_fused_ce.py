@@ -1,0 +1,177 @@
+"""Fused unembed+logprob (ops.fused_ce) parity with the materializing
+path (ops.losses): values and gradients, CE and sequence-logp, chunk
+boundaries, bias, and IGNORE_INDEX masking."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dla_tpu.ops.fused_ce import (
+    fused_cross_entropy_loss,
+    fused_sequence_logprob_mean,
+    fused_token_logprobs,
+)
+from dla_tpu.ops.losses import (
+    cross_entropy_loss,
+    sequence_logprob_mean,
+    token_logprobs,
+)
+
+
+def _setup(b=2, t=12, d=16, v=97, seed=0):
+    rs = np.random.RandomState(seed)
+    hidden = jnp.asarray(rs.randn(b, t, d).astype(np.float32))
+    w = jnp.asarray(rs.randn(d, v).astype(np.float32) * 0.1)
+    targets = jnp.asarray(rs.randint(0, v, (b, t)), jnp.int32)
+    return hidden, w, targets
+
+
+@pytest.mark.parametrize("chunk", [4, 7, 1024])
+def test_token_logprobs_parity(chunk):
+    """Chunk sizes that divide, don't divide, and exceed B*T."""
+    hidden, w, targets = _setup()
+    got = fused_token_logprobs(hidden, w, targets, chunk=chunk)
+    want = token_logprobs(hidden @ w, targets)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_token_logprobs_bias():
+    hidden, w, targets = _setup(seed=1)
+    bias = jnp.asarray(np.random.RandomState(2).randn(w.shape[1]), jnp.float32)
+    got = fused_token_logprobs(hidden, w, targets, bias=bias, chunk=8)
+    want = token_logprobs(hidden @ w + bias, targets)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cross_entropy_parity_and_grads():
+    hidden, w, labels = _setup(seed=3)
+    labels = labels.at[0, :4].set(-100)  # prompt masking
+    labels = labels.at[1, 9:].set(-100)
+
+    def loss_fused(h, w):
+        return fused_cross_entropy_loss(h, w, labels, chunk=8)[0]
+
+    def loss_ref(h, w):
+        return cross_entropy_loss(h @ w, labels)[0]
+
+    lf = loss_fused(hidden, w)
+    lr = loss_ref(hidden, w)
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-6)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1))(hidden, w)
+    gr = jax.grad(loss_ref, argnums=(0, 1))(hidden, w)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_logprob_parity_and_grads():
+    hidden, w, ids = _setup(b=3, t=10, seed=4)
+    mask = jnp.asarray(
+        np.stack([[1] * 10, [1] * 7 + [0] * 3, [1] * 5 + [0] * 5]),
+        jnp.int32)
+
+    def f_fused(h):
+        return jnp.sum(fused_sequence_logprob_mean(h, w, ids, mask, chunk=8))
+
+    def f_ref(h):
+        return jnp.sum(sequence_logprob_mean(h @ w, ids, mask))
+
+    np.testing.assert_allclose(float(f_fused(hidden)), float(f_ref(hidden)),
+                               rtol=1e-6)
+    gf = jax.grad(f_fused)(hidden)
+    gr = jax.grad(f_ref)(hidden)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bias_grads():
+    hidden, w, labels = _setup(seed=5)
+    bias = jnp.asarray(np.random.RandomState(6).randn(w.shape[1]) * 0.1,
+                       jnp.float32)
+
+    def loss_fused(bb):
+        return fused_cross_entropy_loss(hidden, w, labels, bias=bb, chunk=8)[0]
+
+    def loss_ref(bb):
+        return cross_entropy_loss(hidden @ w + bb, labels)[0]
+
+    np.testing.assert_allclose(float(loss_fused(bias)), float(loss_ref(bias)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_fused)(bias)),
+        np.asarray(jax.grad(loss_ref)(bias)), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("temperature", [1.0, 2.0])
+def test_kl_distill_parity_and_grads(temperature):
+    """Chunked ensemble KL == naive kl_distill_loss (2 teachers with
+    different hidden sizes, shifted mask, temperature), incl. the grad
+    through the checkpointed chunk body. T=1024 per the round-2 verdict's
+    'done' criterion, chunk smaller so several chunks run."""
+    from dla_tpu.ops.fused_ce import fused_kl_distill_loss
+    from dla_tpu.ops.losses import kl_distill_loss
+
+    b, t, v = 2, 1024, 64
+    rs = np.random.RandomState(10)
+    hs = jnp.asarray(rs.randn(b, t, 12).astype(np.float32))
+    sw = jnp.asarray(rs.randn(12, v).astype(np.float32) * 0.1)
+    ht1 = jnp.asarray(rs.randn(b, t, 8).astype(np.float32))
+    tw1 = jnp.asarray(rs.randn(8, v).astype(np.float32) * 0.1)
+    ht2 = jnp.asarray(rs.randn(b, t, 20).astype(np.float32))
+    tw2 = jnp.asarray(rs.randn(20, v).astype(np.float32) * 0.1)
+    mask = jnp.asarray(
+        np.concatenate([np.ones((b, t - 100)), np.zeros((b, 100))], 1),
+        jnp.int32)
+
+    def fused(hs, sw):
+        return fused_kl_distill_loss(
+            hs, sw, [ht1, ht2], [tw1, tw2], mask, temperature, chunk=256)
+
+    def naive(hs, sw):
+        return kl_distill_loss(
+            hs @ sw, [ht1 @ tw1, ht2 @ tw2], mask, temperature)
+
+    np.testing.assert_allclose(float(fused(hs, sw)), float(naive(hs, sw)),
+                               rtol=1e-5)
+    gf = jax.grad(fused, argnums=(0, 1))(hs, sw)
+    gn = jax.grad(naive, argnums=(0, 1))(hs, sw)
+    for a, b_ in zip(gf, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_model_level_parity():
+    """hidden_states + fused CE == apply (logits) + materializing CE on a
+    real (tiny) model, including the tied-embedding transpose path."""
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+
+    for tie in (False, True):
+        cfg = get_model_config("tiny")
+        import dataclasses
+        cfg = dataclasses.replace(cfg, tie_embeddings=tie)
+        model = Transformer(cfg)
+        params = model.init(jax.random.key(0))
+        rs = np.random.RandomState(7)
+        ids = jnp.asarray(rs.randint(1, 100, (2, 16)), jnp.int32)
+        labels = jnp.where(ids % 5 == 0, -100, ids)
+
+        def fused(p):
+            h = model.hidden_states(p, ids)
+            w, bias = model.unembed_params(p)
+            return fused_cross_entropy_loss(h, w, labels, bias=bias,
+                                            chunk=8)[0]
+
+        def ref(p):
+            return cross_entropy_loss(model.apply(p, ids), labels)[0]
+
+        np.testing.assert_allclose(float(fused(params)), float(ref(params)),
+                                   rtol=1e-5)
+        gf = jax.grad(fused)(params)
+        gr = jax.grad(ref)(params)
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gr)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
