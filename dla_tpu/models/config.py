@@ -59,6 +59,21 @@ class ModelConfig:
     lora_dropout: float = 0.0
     lora_targets: tuple = ("wq", "wk", "wv", "wo")
 
+    # ulysses materializes full-length attention scores per head slice
+    # (dla_tpu/ops/ulysses.py memory note) — quadratic in sequence length.
+    # Past this bound it will OOM before ring attention even breaks a
+    # sweat, so fail at config time with the fix in the message.
+    ULYSSES_MAX_SEQ = 16384
+
+    def __post_init__(self):
+        if (self.context_parallel == "ulysses"
+                and self.max_seq_length > self.ULYSSES_MAX_SEQ):
+            raise ValueError(
+                f"context_parallel: ulysses materializes [T, T]-scale "
+                f"scores and cannot run at max_seq_length="
+                f"{self.max_seq_length} (> {self.ULYSSES_MAX_SEQ}); use "
+                f"context_parallel: ring for long context")
+
     @property
     def head_dim_(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads

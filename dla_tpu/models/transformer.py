@@ -460,7 +460,15 @@ class Transformer:
         if self.cfg.remat == "none":
             return fn
         if self.cfg.remat == "dots":
-            policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+            # matmul outputs + the flash kernel's (out, lse) residuals:
+            # saving the named flash outputs keeps the backward from
+            # replaying the pallas forward (measured ~25% of the step at
+            # T=2048); elementwise glue (norms, rotary, silu) is still
+            # recomputed, which is the cheap part
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse"))
             return jax.checkpoint(fn, policy=policy)
         return jax.checkpoint(fn)  # "full"
 

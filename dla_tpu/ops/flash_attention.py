@@ -72,11 +72,13 @@ def _fit_block(n: int, pref: int) -> int:
             raise ValueError(f"flash attention: length {n} not divisible "
                              f"by block {b}")
         return b
-    b = min(pref, n)
-    while b >= 128:
-        if n % b == 0:
+    # candidates are multiples of 128 only — min(pref, n) alone would
+    # hand back any 128 <= n <= pref verbatim (e.g. 300) and launch a
+    # non-lane-aligned tile instead of raising
+    b0 = min(pref, n) - (min(pref, n) % 128)
+    for b in dict.fromkeys((b0, 512, 256, 128)):
+        if 128 <= b <= b0 and n % b == 0:
             return b
-        b //= 2
     raise ValueError(
         f"flash attention needs sequence length % 128 == 0 on TPU, got {n}")
 
@@ -448,6 +450,14 @@ def _xla_reference(q, k, v, scale):
 def _core_fwd(q, k, v, segs, scale, block_q, block_k, interpret):
     out, lse = _flash_forward(q, k, v, segs, scale, block_q, block_k,
                               interpret)
+    # Name the backward's residuals so a remat policy can SAVE them:
+    # without this, jax.checkpoint replays the whole pallas forward just
+    # to regenerate (out, lse) before the backward kernels run — at
+    # T=2048 that recompute is ~25% of the train step (see
+    # transformer._maybe_remat's "dots" policy).
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, segs, out, lse)
 
 

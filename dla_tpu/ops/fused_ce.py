@@ -140,11 +140,15 @@ def model_fused_ce(model, params, batch, lora=None, dropout_rng=None,
 
 
 def model_fused_sequence_logprob(model, params, input_ids, attention_mask,
+                                 lora=None, dropout_rng=None,
                                  chunk: int = DEFAULT_CHUNK):
     """hidden_states -> unembed_params -> fused sequence logp, the recipe
-    shared by DPO and RLHF (policy loss + scoring). [B] fp32."""
+    shared by DPO and RLHF (policy loss + scoring). [B] fp32. ``params``
+    is the base tree; LoRA adapters ride in ``lora`` (the unembedding is
+    never a LoRA target, so w always comes from the base)."""
     h = model.hidden_states(params, input_ids,
-                            attention_mask=attention_mask)
+                            attention_mask=attention_mask,
+                            lora=lora, dropout_rng=dropout_rng)
     w, bias = model.unembed_params(params)
     return fused_sequence_logprob_mean(h, w, input_ids, attention_mask,
                                        bias=bias, chunk=chunk)

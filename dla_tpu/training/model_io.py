@@ -188,8 +188,8 @@ def init_lora_adapters(bundle: ModelBundle, rng: jax.Array):
 def require_no_lora(bundle: ModelBundle, phase: str) -> None:
     """Trainers that don't wire adapters must refuse a LoRA config rather
     than silently full-rank fine-tune (full AdamW state — OOM at 70B, and
-    not what the user asked for). SFT and distillation wire adapters;
-    DPO/reward/RLHF call this guard."""
+    not what the user asked for). SFT, distillation, and DPO wire
+    adapters; reward/RLHF call this guard."""
     if bundle.config.lora_r > 0:
         raise ValueError(
             f"model.lora is configured (r={bundle.config.lora_r}) but the "

@@ -27,30 +27,47 @@ from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
 from dla_tpu.training.model_io import (
+    init_lora_adapters,
     load_causal_lm,
     model_aux,
-    require_no_lora,
+    save_merged_lora_final,
 )
 from dla_tpu.training.trainer import Trainer
 from dla_tpu.training.utils import seed_everything
 
 
 def make_dpo_loss(policy_model, ref_model, beta: float,
-                  label_smoothing: float = 0.0):
-    def seq_logp(model, params, sub):
+                  label_smoothing: float = 0.0, lora: bool = False,
+                  train: bool = True):
+    def seq_logp(model, params, sub, adapters=None, rng=None):
         # fused hidden @ unembed + gather: no [B, T, V] materialization
         # in any of the four forwards (cf. reference train_dpo.py:36)
         return model_fused_sequence_logprob(
-            model, params, sub["input_ids"], sub["attention_mask"])
+            model, params, sub["input_ids"], sub["attention_mask"],
+            lora=adapters, dropout_rng=rng)
 
     def loss_fn(params, frozen, batch, rng):
-        del rng
-        pi_c = seq_logp(policy_model, params, batch["chosen"])
-        pi_r = seq_logp(policy_model, params, batch["rejected"])
+        if lora:
+            # trainable tree = adapters over a frozen base; the reference
+            # model is the base itself (= the initial policy) unless a
+            # separate ref was loaded — either way the policy base and
+            # ref share storage instead of duplicating a full param tree
+            base = frozen["base"]
+            refp = frozen.get("ref", base)
+            drop = rng if train else None
+            pi_c = seq_logp(policy_model, base, batch["chosen"],
+                            adapters=params, rng=drop)
+            pi_r = seq_logp(policy_model, base, batch["rejected"],
+                            adapters=params, rng=drop)
+        else:
+            del rng
+            refp = frozen
+            pi_c = seq_logp(policy_model, params, batch["chosen"])
+            pi_r = seq_logp(policy_model, params, batch["rejected"])
         ref_c = jax.lax.stop_gradient(
-            seq_logp(ref_model, frozen, batch["chosen"]))
+            seq_logp(ref_model, refp, batch["chosen"]))
         ref_r = jax.lax.stop_gradient(
-            seq_logp(ref_model, frozen, batch["rejected"]))
+            seq_logp(ref_model, refp, batch["rejected"]))
         loss, margin = dpo_loss(pi_c, pi_r, ref_c, ref_r,
                                 beta, label_smoothing)
         return loss, {
@@ -77,19 +94,40 @@ def main(argv=None) -> None:
             model_cfg.get("policy_model_name_or_path",
                           model_cfg.get("model_name_or_path", "tiny")),
             model_cfg, rng)
-        require_no_lora(policy, "DPO")
         ref_name = model_cfg.get("reference_model_name_or_path")
         if ref_name:
             ref = load_causal_lm(ref_name, model_cfg, rng)
         else:
             ref = policy  # same weights as starting policy (frozen copy)
 
-        trainer = Trainer(
-            config=config, mesh=mesh,
-            loss_fn=make_dpo_loss(policy.model, ref.model, beta,
-                                  label_smoothing),
-            params=policy.params, param_specs=policy.specs,
-            frozen=ref.params, frozen_specs=ref.specs)
+        use_lora = policy.config.lora_r > 0
+        if use_lora:
+            # preference tuning without full fp32 Adam state (the blocker
+            # the round-2 verdict named for 70B DPO): adapters train, the
+            # base tree is frozen and doubles as the reference model
+            adapters, lora_specs = init_lora_adapters(
+                policy, jax.random.fold_in(rng, 17))
+            frozen = {"base": policy.params}
+            frozen_specs = {"base": policy.specs}
+            if ref_name:
+                frozen["ref"] = ref.params
+                frozen_specs["ref"] = ref.specs
+            trainer = Trainer(
+                config=config, mesh=mesh,
+                loss_fn=make_dpo_loss(policy.model, ref.model, beta,
+                                      label_smoothing, lora=True),
+                eval_fn=make_dpo_loss(policy.model, ref.model, beta,
+                                      label_smoothing, lora=True,
+                                      train=False),
+                params=adapters, param_specs=lora_specs,
+                frozen=frozen, frozen_specs=frozen_specs)
+        else:
+            trainer = Trainer(
+                config=config, mesh=mesh,
+                loss_fn=make_dpo_loss(policy.model, ref.model, beta,
+                                      label_smoothing),
+                params=policy.params, param_specs=policy.specs,
+                frozen=ref.params, frozen_specs=ref.specs)
 
         data_cfg = {**config.get("data", {}),
                     "max_seq_length": policy.config.max_seq_length}
@@ -118,6 +156,11 @@ def main(argv=None) -> None:
             train_it, rng=rng, eval_iter_fn=eval_iter_fn,
             data_state=train_it.state_dict, resume=args.resume,
             extra_aux=model_aux(policy, model_cfg.get("tokenizer")))
+
+        if use_lora:
+            save_merged_lora_final(
+                trainer, policy, trainer.frozen["base"],
+                model_cfg.get("tokenizer"))
 
 
 if __name__ == "__main__":

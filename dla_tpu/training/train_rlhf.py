@@ -246,11 +246,16 @@ def main(argv=None) -> None:
                 }
                 losses = []
                 if algo == "ppo":
-                    n_mb = max(1, batch_size // mini_batch)
-                    mb_size = batch_size // n_mb
+                    # size minibatches off the ACTUAL rollout row count —
+                    # batch_size // process_count rounds down per host, so
+                    # permuting the nominal batch_size could emit
+                    # out-of-range gather indices (silently clamped)
+                    n_rows = int(up["sequences"].shape[0])
+                    n_mb = max(1, n_rows // mini_batch)
+                    mb_size = n_rows // n_mb
                     for epoch in range(ppo_epochs):
                         order = np.random.default_rng(
-                            (rollout_idx, epoch)).permutation(batch_size)
+                            (rollout_idx, epoch)).permutation(n_rows)
                         for k in range(n_mb):
                             sl = jnp.asarray(
                                 order[k * mb_size:(k + 1) * mb_size])

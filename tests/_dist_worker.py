@@ -1,0 +1,65 @@
+"""Worker process for the two-process jax.distributed test
+(test_multiprocess.py). Each of 2 processes owns 4 virtual CPU devices;
+together they form one 8-device world exercising the code paths that are
+no-ops at process_count() == 1: make_array_from_process_local_data
+batch assembly, local_numpy's multi-host branch, the cross-host barrier,
+and per-host checkpoint shard writes.
+
+Usage: python tests/_dist_worker.py <coordinator_port> <rank> <ckpt_dir>
+(launched with a scrubbed CPU env; XLA_FLAGS must already force 4
+host-platform devices).
+"""
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    port, rank, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        process_id=rank)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dla_tpu.checkpoint.checkpointer import Checkpointer
+    from dla_tpu.parallel.dist import barrier
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.parallel.sharding import local_numpy, make_global_batch
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, sequence=1))
+
+    # --- global batch assembly: each host contributes 8 of 16 rows
+    local = (np.arange(rank * 8, rank * 8 + 8, dtype=np.int32)[:, None]
+             * np.ones((1, 4), np.int32))
+    with jax.sharding.set_mesh(mesh):
+        g = make_global_batch({"x": local}, mesh)["x"]
+        assert g.shape == (16, 4), g.shape
+        # SPMD reduction over the 2-process world: mean of row values 0..15
+        mean = float(jax.jit(lambda a: jnp.mean(a.astype(jnp.float32)))(g))
+        assert abs(mean - 7.5) < 1e-6, mean
+        # local_numpy multi-host branch: this host's slice, in order
+        back = local_numpy(g)
+        assert np.array_equal(back, local), (back.tolist(), rank)
+
+        # --- per-host checkpoint shard writes (deterministic content so
+        # the parent can verify a cross-topology restore value-for-value)
+        full = np.arange(16 * 12, dtype=np.float32).reshape(16, 12)
+        tree = {
+            "w": jax.device_put(jnp.asarray(full),
+                                NamedSharding(mesh, P("fsdp", "model"))),
+            "b": jax.device_put(jnp.arange(12, dtype=np.float32),
+                                NamedSharding(mesh, P())),
+        }
+        ck = Checkpointer(outdir, keep_last_n=2)
+        ck.save(7, tree, aux={"who": "dist_worker"})
+    barrier("workers_done")
+    print(f"[worker {rank}] OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -220,7 +220,56 @@ def test_unwired_trainers_reject_lora_config():
     bundle = load_causal_lm(
         "tiny", {"tokenizer": "byte", "lora": {"enabled": True, "r": 4}},
         jax.random.key(0))
-    with pytest.raises(ValueError, match="DPO trainer does not support"):
-        require_no_lora(bundle, "DPO")
+    with pytest.raises(ValueError, match="RLHF trainer does not support"):
+        require_no_lora(bundle, "RLHF")
     plain = load_causal_lm("tiny", {"tokenizer": "byte"}, jax.random.key(0))
-    require_no_lora(plain, "DPO")  # no-op without adapters
+    require_no_lora(plain, "RLHF")  # no-op without adapters
+
+
+def test_dpo_trainer_lora_loss_falls(mesh8):
+    """DPO with adapters as the trainable tree: the frozen base doubles
+    as the reference model (no duplicated ref weights), preference loss
+    falls, and ref logps stay pinned to the base (round-2 verdict next
+    -step 8 — unblocks 70B preference tuning without full Adam state)."""
+    from dla_tpu.training.model_io import init_lora_adapters, load_causal_lm
+    from dla_tpu.training.train_dpo import make_dpo_loss
+    from dla_tpu.training.trainer import Trainer
+
+    policy = load_causal_lm(
+        "tiny", {"tokenizer": "byte",
+                 "lora": {"enabled": True, "r": 4, "alpha": 8}},
+        jax.random.key(0))
+    adapters, lora_specs = init_lora_adapters(policy, jax.random.key(17))
+    config = {
+        "experiment_name": "lora_dpo_test",
+        "optimization": {"total_batch_size": 8, "micro_batch_size": 2,
+                         "learning_rate": 1e-2, "max_train_steps": 40,
+                         "lr_scheduler": "constant", "max_grad_norm": 1.0},
+        "logging": {"output_dir": "/tmp/lora_dpo_test", "log_dir": None},
+        "hardware": {"gradient_accumulation_steps": 1},
+    }
+    with jax.sharding.set_mesh(mesh8):
+        trainer = Trainer(
+            config=config, mesh=mesh8,
+            loss_fn=make_dpo_loss(policy.model, policy.model, beta=0.1,
+                                  lora=True),
+            params=adapters, param_specs=lora_specs,
+            frozen={"base": policy.params},
+            frozen_specs={"base": policy.specs})
+        rs = np.random.RandomState(1)
+
+        def sub(seed):
+            r = np.random.RandomState(seed)
+            return {"input_ids": r.randint(1, 100, (8, 16)).astype(np.int32),
+                    "attention_mask": np.ones((8, 16), np.int32)}
+
+        batch = {"chosen": sub(1), "rejected": sub(2)}
+        losses = []
+        for i in range(40):
+            loss, metrics = trainer.step_on_batch(
+                batch, jax.random.fold_in(jax.random.key(0), i))
+            losses.append(loss)
+        # rank-4 adapters on a 2-layer model: expect a clear monotone-ish
+        # drop from the 0.6931 start, not a collapse
+        assert losses[-1] < losses[0] - 0.03, (losses[0], losses[-1])
+        assert metrics["preference_rate"] > 0.9

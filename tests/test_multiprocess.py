@@ -1,0 +1,105 @@
+"""Two-process jax.distributed CPU test (round-2 verdict next-step 7):
+exercises the code paths that silently no-op at process_count() == 1 —
+make_array_from_process_local_data, local_numpy's multi-host branch, the
+cross-host barrier, and per-host checkpoint shard writes — then restores
+the 2-host checkpoint in THIS single process onto a different topology
+(the bug class that only appears at process_count > 1 and eats 70B runs).
+"""
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def two_host_checkpoint(tmp_path_factory):
+    """Run the 2-process worker world to completion; yield its ckpt dir."""
+    sys.path.insert(0, str(REPO_ROOT))
+    from _cpuhost import scrubbed_cpu_env
+
+    outdir = tmp_path_factory.mktemp("dist_ckpt")
+    port = _free_port()
+    env = scrubbed_cpu_env(4, str(REPO_ROOT))  # 4 virtual devices per proc
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(REPO_ROOT / "tests" / "_dist_worker.py"),
+             str(port), str(rank), str(outdir)],
+            env=env, cwd=str(REPO_ROOT),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out")
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {rank} failed:\n{out}"
+        assert f"[worker {rank}] OK" in out
+    return outdir
+
+
+def test_two_process_world_and_shard_writes(two_host_checkpoint):
+    """Both workers passed their in-world asserts (global mean over the
+    2-host batch, local_numpy slices); the checkpoint they wrote must be
+    sharded — one file per index region, no gather through host 0."""
+    ckpt = two_host_checkpoint / "step_00000007"
+    index = json.loads((ckpt / "index.json").read_text())
+    w_meta = index["leaves"]["w"]
+    assert "shards" in w_meta, "w should be written as per-region shards"
+    # fsdp=2 x model=2 -> 4 distinct index regions
+    assert len(w_meta["shards"]) == 4, w_meta["shards"]
+    for sh in w_meta["shards"]:
+        assert (ckpt / sh["file"]).is_file(), sh
+    # replicated leaf: multi-host arrays aren't fully addressable, so it
+    # goes through the shard path as ONE whole-array region written by
+    # its replica-0 owner (no duplicate writes from the other host)
+    b_meta = index["leaves"]["b"]
+    assert len(b_meta["shards"]) == 1, b_meta
+    assert b_meta["shards"][0]["index"] == [[0, 12]]
+    assert (two_host_checkpoint / "latest").read_text().strip() == \
+        "step_00000007"
+
+
+def test_cross_topology_restore_from_two_hosts(two_host_checkpoint):
+    """Restore the 2-process checkpoint in this single process onto a
+    different mesh layout; values must round-trip exactly."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dla_tpu.checkpoint.checkpointer import Checkpointer
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    # different topology than the writers': all 8 devices on fsdp
+    mesh = build_mesh(MeshConfig(data=1, fsdp=8, model=1, sequence=1))
+    template = {"w": jnp.zeros((16, 12), jnp.float32),
+                "b": jnp.zeros((12,), jnp.float32)}
+    shardings = {"w": NamedSharding(mesh, P("fsdp", None)),
+                 "b": NamedSharding(mesh, P())}
+    ck = Checkpointer(str(two_host_checkpoint))
+    tree, aux = ck.restore(template, shardings=shardings)
+    assert aux["who"] == "dist_worker"
+    want = np.arange(16 * 12, dtype=np.float32).reshape(16, 12)
+    np.testing.assert_array_equal(np.asarray(tree["w"]), want)
+    np.testing.assert_array_equal(np.asarray(tree["b"]),
+                                  np.arange(12, dtype=np.float32))
+    assert tree["w"].sharding.spec == P("fsdp", None)
