@@ -171,6 +171,147 @@ def run_bench() -> dict:
     }
 
 
+def run_ppo_bench() -> dict:
+    """PPO rollout+update throughput, samples/sec — the second north-star
+    metric BASELINE.json names ('PPO rollout+update samples/sec @7B'),
+    measured at bench scale: policy + frozen ref + reward model colocated
+    on the chip, jitted scan-decode rollout, on-device reinforce update.
+    Reported per chip (the v5e-256 number is this x utilization scaling,
+    not measured here)."""
+    import jax
+    import jax.numpy as jnp
+    from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.reward import RewardModel
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.parallel.sharding import sharding_tree
+    from dla_tpu.training.train_rlhf import (
+        make_policy_gradient_loss,
+        make_score_fn,
+    )
+    from dla_tpu.training.trainer import Trainer
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    if on_accel:
+        cfg = ModelConfig(
+            vocab_size=32000, hidden_size=768, intermediate_size=2048,
+            num_layers=12, num_heads=12, num_kv_heads=12,
+            max_seq_length=512, remat="dots", attention="flash")
+        batch, prompt_w, new_tokens, rollouts, warmup = 32, 128, 128, 3, 1
+    else:
+        cfg = ModelConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=192,
+            num_layers=2, num_heads=4, num_kv_heads=4,
+            max_seq_length=128, remat="none", dtype="float32",
+            param_dtype="float32")
+        batch, prompt_w, new_tokens, rollouts, warmup = 4, 16, 16, 2, 1
+
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
+    policy = Transformer(cfg)
+    ref = Transformer(cfg)
+    rm = RewardModel(cfg)
+    with jax.sharding.set_mesh(mesh):
+        params = policy.init(jax.random.key(0))
+        ref_params = jax.device_put(
+            ref.init(jax.random.key(1)),
+            sharding_tree(ref.partition_specs(), mesh))
+        rm_params = jax.device_put(
+            rm.init(jax.random.key(2)),
+            sharding_tree(rm.partition_specs(), mesh))
+        config = {
+            "experiment_name": "bench_ppo",
+            "optimization": {
+                "total_batch_size": batch, "micro_batch_size": batch,
+                "learning_rate": 1e-6, "max_train_steps": rollouts + warmup,
+                "lr_scheduler": "constant", "max_grad_norm": 1.0,
+            },
+            "logging": {"output_dir": "/tmp/dla_bench_ppo", "log_dir": None},
+            "hardware": {"gradient_accumulation_steps": 1},
+        }
+        trainer = Trainer(
+            config=config, mesh=mesh,
+            loss_fn=make_policy_gradient_loss(policy, "reinforce", 0.2),
+            params=params, param_specs=policy.partition_specs())
+        gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=True,
+                               temperature=1.0, top_p=1.0,
+                               eos_token_id=-1, pad_token_id=0)
+        generate_fn = jax.jit(build_generate_fn(policy, gen))
+        score_fn = make_score_fn(policy, ref, rm)
+
+        rs = np.random.RandomState(0)
+        ids = rs.randint(1, cfg.vocab_size, (batch, prompt_w)).astype(np.int32)
+        mask = np.ones((batch, prompt_w), np.int32)
+        ids_d = jax.device_put(jnp.asarray(ids))
+        mask_d = jax.device_put(jnp.asarray(mask))
+
+        def one_rollout(i):
+            out = generate_fn(trainer.params, ids_d, mask_d,
+                              jax.random.key(i))
+            scores = score_fn(trainer.params, ref_params, rm_params,
+                              out["sequences"], out["sequence_mask"],
+                              jnp.float32(0.1))
+            up = {"sequences": out["sequences"],
+                  "sequence_mask": out["sequence_mask"],
+                  "advantages": scores["advantages"],
+                  "behavior_logp": scores["behavior_logp"]}
+            trainer.step_on_device_batch(up, jax.random.key(100 + i))
+
+        for i in range(warmup):
+            one_rollout(i)
+        t0 = time.perf_counter()
+        for i in range(rollouts):
+            one_rollout(10 + i)
+        dt = time.perf_counter() - t0
+
+    samples_s = batch * rollouts / dt
+    return {
+        "metric": "ppo_rollout_update_samples_per_sec_per_chip",
+        "value": round(samples_s / jax.device_count(), 3),
+        "unit": "samples/s/chip",
+        "detail": {"batch": batch, "prompt_len": prompt_w,
+                   "new_tokens": new_tokens,
+                   "params_m": round(count_params(params) / 1e6)},
+    }
+
+
+def run_decode_bench() -> dict:
+    """Autoregressive decode ms/token through the KV-cache engine (the
+    PPO rollout hot path; reference only measured forward passes,
+    src/eval/eval_latency.py:22-63)."""
+    import jax
+    from dla_tpu.eval.eval_latency import measure_decode
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    if on_accel:
+        cfg = ModelConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=24, num_heads=16, num_kv_heads=16,
+            max_seq_length=2048, attention="flash", remat="none")
+        b, prompt, new = 8, 128, 256
+    else:
+        cfg = ModelConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=192,
+            num_layers=2, num_heads=4, num_kv_heads=4,
+            max_seq_length=128, remat="none", dtype="float32",
+            param_dtype="float32")
+        b, prompt, new = 2, 16, 16
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    row = measure_decode(model, params, b, prompt, new)
+    return {
+        "metric": "decode_ms_per_token",
+        "value": round(row["ms_per_token"], 3),
+        "unit": "ms/token",
+        "detail": {"batch": b, "prompt_len": prompt, "new_tokens": new,
+                   "decode_tok_s_chip": round(
+                       row["decode_tokens_per_second_per_chip"], 1),
+                   "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def _child_env(mode: str) -> dict:
     from _cpuhost import prepend_pythonpath, scrubbed_cpu_env
     if mode == "cpu":
@@ -221,23 +362,45 @@ def _relay_child(mode: str, timeout_s: float) -> dict | None:
     return result
 
 
+def _emit_and_maybe_extra() -> None:
+    """Child-side: print the headline SFT line; with DLA_BENCH_EXTRA set,
+    also measure PPO rollout+update and decode, appending everything to
+    BENCH_extra.json (the BASELINE.md evidence artifact)."""
+    headline = run_bench()
+    print(json.dumps(headline))
+    if not os.environ.get("DLA_BENCH_EXTRA"):
+        return
+    extra = [headline]
+    for fn in (run_ppo_bench, run_decode_bench):
+        try:
+            res = fn()
+        except Exception as e:  # noqa: BLE001 — extras must not kill the line
+            res = {"metric": fn.__name__, "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(res), file=sys.stderr)
+        extra.append(res)
+    with open(os.path.join(_REPO_ROOT, "BENCH_extra.json"), "w") as fh:
+        json.dump(extra, fh, indent=1)
+
+
 def main() -> int:
     mode = os.environ.get("DLA_BENCH_PLATFORM")
     if mode == "cpu":
         # CPU child: force the platform before backend init, run, emit.
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
-        print(json.dumps(run_bench()))
+        _emit_and_maybe_extra()
         return 0
     if mode == "accel":
         # Accelerator child: may hang in tunnel init — parent bounds us.
         if _try_devices() is None:
             return 1
-        print(json.dumps(run_bench()))
+        _emit_and_maybe_extra()
         return 0
 
     # Parent orchestrator: NEVER initializes jax (backend init can hang);
     # every jax touch happens in a time-bounded child.
+    if "--extra" in sys.argv:
+        os.environ["DLA_BENCH_EXTRA"] = "1"
     accel_t = float(os.environ.get("DLA_BENCH_ACCEL_TIMEOUT", "900"))
     cpu_t = float(os.environ.get("DLA_BENCH_CPU_TIMEOUT", "600"))
     result = _relay_child("accel", accel_t)

@@ -51,11 +51,34 @@ class RewardModel:
         specs["reward_head"] = {"w": P("fsdp", None), "b": P(None)}
         return specs
 
+    def init_lora(self, rng: jax.Array) -> Params:
+        """Backbone adapters only; the scalar head trains full-rank (it is
+        1 column — adapters would be pure overhead). The trainer composes
+        {"lora": this, "reward_head": head} as its trainable tree."""
+        return self.backbone.init_lora(rng)
+
+    def lora_partition_specs(self) -> Params:
+        return self.backbone.lora_partition_specs()
+
+    def merge_lora(self, base_params: Params, trainable: Params) -> Params:
+        """Fold trainable {"lora": adapters, "reward_head": head} into the
+        frozen backbone -> a standalone plain reward-model tree (for the
+        `merged` export RLHF chains from)."""
+        merged = self.backbone.merge_lora(base_params, trainable["lora"])
+        merged["reward_head"] = trainable["reward_head"]
+        return merged
+
     def apply(self, params: Params, input_ids: jnp.ndarray,
               attention_mask: jnp.ndarray,
-              dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
-        """[B, T] -> [B] scalar rewards (fp32)."""
-        h = self.backbone.hidden_states(params, input_ids, attention_mask)
+              dropout_rng: Optional[jax.Array] = None,
+              lora: Optional[Params] = None) -> jnp.ndarray:
+        """[B, T] -> [B] scalar rewards (fp32). ``dropout_rng`` drives
+        both the pooled-feature dropout and (split) LoRA dropout."""
+        lora_rng = None
+        if dropout_rng is not None and lora is not None:
+            dropout_rng, lora_rng = jax.random.split(dropout_rng)
+        h = self.backbone.hidden_states(params, input_ids, attention_mask,
+                                        lora=lora, dropout_rng=lora_rng)
         mask = attention_mask.astype(jnp.float32)
         if self.pooling == "last_token":
             idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)

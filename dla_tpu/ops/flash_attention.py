@@ -60,12 +60,12 @@ SEG_SUBLANES = 8  # sublane replication of the kv-side segment-id array
 
 
 def _fit_block(n: int, pref: int) -> int:
-    """Block size for a length-n axis: the largest b in
-    {pref, pref/2, ..., 128} that divides n. Raises for lengths no
-    128-multiple block divides (the model's _flash_tileable gate filters
-    these; direct callers get a clear error instead of a degenerate
-    sub-MXU tiling). n < 128 (CPU-interpret small-shape tests) keeps the
-    old min-rule: block = n when it divides."""
+    """Block size for a length-n axis: the largest 128-multiple
+    b <= min(pref, n) that divides n. Raises for lengths no 128-multiple
+    block divides (the model's _flash_tileable gate filters these; direct
+    callers get a clear error instead of a degenerate sub-MXU tiling).
+    n < 128 (CPU-interpret small-shape tests) keeps the old min-rule:
+    block = n when it divides."""
     if n < 128:
         b = min(pref, n)
         if n % b:
@@ -76,8 +76,8 @@ def _fit_block(n: int, pref: int) -> int:
     # hand back any 128 <= n <= pref verbatim (e.g. 300) and launch a
     # non-lane-aligned tile instead of raising
     b0 = min(pref, n) - (min(pref, n) % 128)
-    for b in dict.fromkeys((b0, 512, 256, 128)):
-        if 128 <= b <= b0 and n % b == 0:
+    for b in range(b0, 127, -128):
+        if n % b == 0:
             return b
     raise ValueError(
         f"flash attention needs sequence length % 128 == 0 on TPU, got {n}")

@@ -146,11 +146,37 @@ def build_reward_model(model_cfg: Dict[str, Any], rng: jax.Array) -> ModelBundle
     return ModelBundle(rm, params, rm.partition_specs(), tok, cfg)
 
 
+def _try_hub_snapshot(repo_id: str) -> Optional[Path]:
+    """Optional hub fetch (reference parity: base_model.py:30-35 loads any
+    hub id via from_pretrained). Opt-in via DLA_HF_HUB_DOWNLOAD=1 because
+    the primary deployment is zero-egress — without the flag, hub-looking
+    names fall through to the preset registry (random init) exactly as
+    before. With it, weights download once into the HF cache and import
+    through the same local-dir path."""
+    import os
+    if "/" not in repo_id or not os.environ.get("DLA_HF_HUB_DOWNLOAD"):
+        return None
+    try:
+        from huggingface_hub import snapshot_download
+        return Path(snapshot_download(
+            repo_id,
+            allow_patterns=["*.safetensors", "*.json", "*.model",
+                            "tokenizer*"]))
+    except Exception as e:  # noqa: BLE001 — fall back to preset init, loudly
+        from dla_tpu.utils.logging import log_rank_zero
+        log_rank_zero(f"[dla_tpu] hub fetch of '{repo_id}' failed "
+                      f"({type(e).__name__}: {e}); using preset init")
+        return None
+
+
 def _try_hf_dir(name_or_path: str, overrides: Dict[str, Any]):
-    """(ModelConfig, params) from a local HF weight directory, else None."""
+    """(ModelConfig, params) from a local HF weight directory (or an
+    opt-in hub snapshot, see _try_hub_snapshot), else None."""
     p = Path(name_or_path)
     if not p.is_dir():
-        return None
+        p = _try_hub_snapshot(name_or_path)
+        if p is None:
+            return None
     from dla_tpu.models.hf_import import (
         hf_config_to_model_config,
         import_hf_weights,
@@ -183,19 +209,6 @@ def init_lora_adapters(bundle: ModelBundle, rng: jax.Array):
         f"[dla_tpu] LoRA r={bundle.config.lora_r}: "
         f"{n_adapt:,} trainable / {n_base:,} frozen params")
     return adapters, bundle.model.lora_partition_specs()
-
-
-def require_no_lora(bundle: ModelBundle, phase: str) -> None:
-    """Trainers that don't wire adapters must refuse a LoRA config rather
-    than silently full-rank fine-tune (full AdamW state — OOM at 70B, and
-    not what the user asked for). SFT, distillation, and DPO wire
-    adapters; reward/RLHF call this guard."""
-    if bundle.config.lora_r > 0:
-        raise ValueError(
-            f"model.lora is configured (r={bundle.config.lora_r}) but the "
-            f"{phase} trainer does not support LoRA adapters yet; train "
-            "adapters in SFT/distill, chain the merged checkpoint, or drop "
-            "the model.lora block")
 
 
 def save_merged_lora_final(trainer, bundle: ModelBundle, base_params,

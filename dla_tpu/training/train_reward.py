@@ -26,22 +26,32 @@ from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
 from dla_tpu.training.model_io import (
     build_reward_model,
+    init_lora_adapters,
     model_aux,
-    require_no_lora,
+    save_merged_lora_final,
 )
 from dla_tpu.training.trainer import Trainer
 
 
-def make_reward_loss(model):
+def make_reward_loss(model, lora: bool = False):
     def loss_fn(params, frozen, batch, rng):
-        del frozen
+        if lora:
+            # trainable = backbone adapters + the (tiny, full-rank)
+            # scalar head; the frozen backbone rides in `frozen`
+            full = {**frozen, "reward_head": params["reward_head"]}
+            adapters = params["lora"]
+        else:
+            del frozen
+            full, adapters = params, None
         drng = jax.random.split(rng, 2)
         chosen = model.apply(
-            params, batch["chosen"]["input_ids"],
-            batch["chosen"]["attention_mask"], dropout_rng=drng[0])
+            full, batch["chosen"]["input_ids"],
+            batch["chosen"]["attention_mask"], dropout_rng=drng[0],
+            lora=adapters)
         rejected = model.apply(
-            params, batch["rejected"]["input_ids"],
-            batch["rejected"]["attention_mask"], dropout_rng=drng[1])
+            full, batch["rejected"]["input_ids"],
+            batch["rejected"]["attention_mask"], dropout_rng=drng[1],
+            lora=adapters)
         loss = pairwise_reward_loss(chosen, rejected)
         acc = jnp.mean((chosen > rejected).astype(jnp.float32))
         return loss, {"acc": acc,
@@ -49,13 +59,21 @@ def make_reward_loss(model):
     return loss_fn
 
 
-def make_reward_eval(model):
+def make_reward_eval(model, lora: bool = False):
     def eval_fn(params, frozen, batch, rng):
-        del frozen, rng
-        chosen = model.apply(params, batch["chosen"]["input_ids"],
-                             batch["chosen"]["attention_mask"])
-        rejected = model.apply(params, batch["rejected"]["input_ids"],
-                               batch["rejected"]["attention_mask"])
+        del rng
+        if lora:
+            full = {**frozen, "reward_head": params["reward_head"]}
+            adapters = params["lora"]
+        else:
+            del frozen
+            full, adapters = params, None
+        chosen = model.apply(full, batch["chosen"]["input_ids"],
+                             batch["chosen"]["attention_mask"],
+                             lora=adapters)
+        rejected = model.apply(full, batch["rejected"]["input_ids"],
+                               batch["rejected"]["attention_mask"],
+                               lora=adapters)
         loss = pairwise_reward_loss(chosen, rejected)
         acc = jnp.mean((chosen > rejected).astype(jnp.float32))
         return loss, {"acc": acc}
@@ -72,12 +90,27 @@ def main(argv=None) -> None:
 
     with jax.sharding.set_mesh(mesh):
         bundle = build_reward_model(config.get("model", {}), rng)
-        require_no_lora(bundle, "reward")
-        trainer = Trainer(
-            config=config, mesh=mesh,
-            loss_fn=make_reward_loss(bundle.model),
-            eval_fn=make_reward_eval(bundle.model),
-            params=bundle.params, param_specs=bundle.specs)
+        use_lora = bundle.config.lora_r > 0
+        if use_lora:
+            # adapters + scalar head train; backbone stays frozen (no
+            # full Adam state at 7B+ backbone scale)
+            head = bundle.params.pop("reward_head")
+            head_spec = bundle.specs.pop("reward_head")
+            adapters, lora_specs = init_lora_adapters(
+                bundle, jax.random.fold_in(rng, 17))
+            trainer = Trainer(
+                config=config, mesh=mesh,
+                loss_fn=make_reward_loss(bundle.model, lora=True),
+                eval_fn=make_reward_eval(bundle.model, lora=True),
+                params={"lora": adapters, "reward_head": head},
+                param_specs={"lora": lora_specs, "reward_head": head_spec},
+                frozen=bundle.params, frozen_specs=bundle.specs)
+        else:
+            trainer = Trainer(
+                config=config, mesh=mesh,
+                loss_fn=make_reward_loss(bundle.model),
+                eval_fn=make_reward_eval(bundle.model),
+                params=bundle.params, param_specs=bundle.specs)
 
         data_cfg = {**config.get("data", {}),
                     "max_seq_length": bundle.config.max_seq_length}
@@ -107,6 +140,11 @@ def main(argv=None) -> None:
             data_state=train_it.state_dict, resume=args.resume,
             extra_aux=model_aux(bundle,
                                 config.get("model", {}).get("tokenizer")))
+
+        if use_lora:
+            save_merged_lora_final(
+                trainer, bundle, trainer.frozen,
+                config.get("model", {}).get("tokenizer"))
 
 
 if __name__ == "__main__":

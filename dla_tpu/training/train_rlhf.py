@@ -53,9 +53,10 @@ from dla_tpu.parallel.sharding import make_global_batch
 from dla_tpu.training.config import config_from_args, make_arg_parser
 from dla_tpu.training.model_io import (
     build_reward_model,
+    init_lora_adapters,
     load_causal_lm,
     model_aux,
-    require_no_lora,
+    save_merged_lora_final,
 )
 from dla_tpu.training.trainer import Trainer
 from dla_tpu.training.utils import seed_everything
@@ -64,14 +65,23 @@ from dla_tpu.utils.logging import log_rank_zero
 PROMPT_TEMPLATE = "{prompt}\n\n"
 
 
-def make_policy_gradient_loss(policy_model, algo: str, clip_ratio: float):
+def make_policy_gradient_loss(policy_model, algo: str, clip_ratio: float,
+                              lora: bool = False):
     def loss_fn(params, frozen, batch, rng):
-        del frozen, rng
+        del rng
         # chunked unembed fusion — no [B, T, V] logits in the policy
         # update or the scoring forwards
-        logp = model_fused_sequence_logprob(
-            policy_model, params,
-            batch["sequences"], batch["sequence_mask"])
+        if lora:
+            # trainable tree = adapters; the frozen base carries the
+            # policy weights (rollouts decode over a merged copy)
+            logp = model_fused_sequence_logprob(
+                policy_model, frozen["base"],
+                batch["sequences"], batch["sequence_mask"], lora=params)
+        else:
+            del frozen
+            logp = model_fused_sequence_logprob(
+                policy_model, params,
+                batch["sequences"], batch["sequence_mask"])
         if algo == "ppo":
             loss, clip_frac = ppo_clip_loss(
                 logp, batch["behavior_logp"], batch["advantages"], clip_ratio)
@@ -133,11 +143,15 @@ def main(argv=None) -> None:
     with jax.sharding.set_mesh(mesh):
         policy = load_causal_lm(
             model_cfg.get("policy_model_name_or_path", "tiny"), model_cfg, rng)
-        require_no_lora(policy, "RLHF")
-        ref = load_causal_lm(
-            model_cfg.get("reference_model_name_or_path",
-                          model_cfg.get("policy_model_name_or_path", "tiny")),
-            model_cfg, jax.random.fold_in(rng, 1))
+        use_lora = policy.config.lora_r > 0
+        ref_name = model_cfg.get("reference_model_name_or_path")
+        if use_lora and not ref_name:
+            ref = policy  # ref == frozen base; no second tree materialized
+        else:
+            ref = load_causal_lm(
+                ref_name or model_cfg.get("policy_model_name_or_path",
+                                          "tiny"),
+                model_cfg, jax.random.fold_in(rng, 1))
         rm_cfg = {**config.get("reward_model", {})}
         rm_cfg.setdefault("base_model_name_or_path", rm_cfg.pop("path", None))
         rm_cfg.setdefault("tokenizer", model_cfg.get("tokenizer"))
@@ -148,18 +162,29 @@ def main(argv=None) -> None:
                "eos_token_id": policy.tokenizer.eos_token_id,
                "pad_token_id": policy.tokenizer.pad_token_id})
 
-        # one rollout = this many optimizer steps (sizes the LR horizon and
-        # the resume position)
-        updates_per_rollout = (max(1, (batch_size // mini_batch) * ppo_epochs)
+        # ACTUAL rollout rows: per-host prompt sampling rounds down, so
+        # the global rollout is this, not the nominal ppo.batch_size.
+        # Every derived quantity (minibatch count, LR horizon, resume
+        # position, trainer batch identity) uses it — a mismatch would
+        # desync resume and feed wrongly-sized minibatches.
+        rollout_rows = (batch_size // jax.process_count()
+                        ) * jax.process_count()
+        mb_size = min(mini_batch, rollout_rows)
+        n_minibatches = max(1, rollout_rows // mb_size)
+        # one rollout = this many optimizer steps (sizes the LR horizon
+        # and the resume position); PPO drops remainder rows each epoch
+        # (rollout_rows % mb_size), standard practice
+        updates_per_rollout = (n_minibatches * ppo_epochs
                                if algo == "ppo" else 1)
         # optimizer config: optimization block is the base, ppo.* wins
         base_opt = dict(config.get("optimization", {}))
+        update_bs = mb_size if algo == "ppo" else rollout_rows
         opt_block = {
             **base_opt,
             "learning_rate": ppo_cfg.get(
                 "learning_rate", base_opt.get("learning_rate", 1e-6)),
             "max_train_steps": n_steps * updates_per_rollout,
-            "total_batch_size": mini_batch if algo == "ppo" else batch_size,
+            "total_batch_size": update_bs,
             "micro_batch_size": ppo_cfg.get(
                 "micro_batch_size", base_opt.get("micro_batch_size")),
             "lr_scheduler": ppo_cfg.get(
@@ -169,26 +194,49 @@ def main(argv=None) -> None:
         }
         accum = int(config.get("hardware", {}).get(
             "gradient_accumulation_steps", 1))
-        update_bs = mini_batch if algo == "ppo" else batch_size
         if not opt_block.get("micro_batch_size"):
             dp = mesh.shape["data"] * mesh.shape["fsdp"]
             opt_block["micro_batch_size"] = max(1, update_bs // (dp * accum))
         cfg_for_trainer = {**config, "optimization": opt_block}
 
-        trainer = Trainer(
-            config=cfg_for_trainer, mesh=mesh,
-            loss_fn=make_policy_gradient_loss(policy.model, algo, clip_ratio),
-            params=policy.params, param_specs=policy.specs)
-
-        # frozen models placed once; reuse policy specs for the ref
         from dla_tpu.parallel.sharding import sharding_tree
-        ref_params = jax.device_put(
-            ref.params, sharding_tree(ref.specs, mesh))
+        merge_fn = None
+        if use_lora:
+            adapters, lora_specs = init_lora_adapters(
+                policy, jax.random.fold_in(rng, 17))
+            trainer = Trainer(
+                config=cfg_for_trainer, mesh=mesh,
+                loss_fn=make_policy_gradient_loss(policy.model, algo,
+                                                  clip_ratio, lora=True),
+                params=adapters, param_specs=lora_specs,
+                frozen={"base": policy.params},
+                frozen_specs={"base": policy.specs})
+            # rollouts decode over base+adapters folded into one tree
+            # (one transient merged copy per rollout; KV-cache decode
+            # stays adapter-free)
+            merge_fn = jax.jit(policy.model.merge_lora)
+            ref_params = (trainer.frozen["base"] if ref is policy
+                          else jax.device_put(
+                              ref.params, sharding_tree(ref.specs, mesh)))
+        else:
+            trainer = Trainer(
+                config=cfg_for_trainer, mesh=mesh,
+                loss_fn=make_policy_gradient_loss(policy.model, algo,
+                                                  clip_ratio),
+                params=policy.params, param_specs=policy.specs)
+            # frozen models placed once; reuse policy specs for the ref
+            ref_params = jax.device_put(
+                ref.params, sharding_tree(ref.specs, mesh))
         rm_params = jax.device_put(
             rm.params, sharding_tree(rm.specs, mesh))
 
         generate_fn = jax.jit(build_generate_fn(policy.model, gen))
         score_fn = make_score_fn(policy.model, ref.model, rm.model)
+
+        def rollout_params():
+            if merge_fn is None:
+                return trainer.params
+            return merge_fn(trainer.frozen["base"], trainer.params)
 
         prompts = load_prompt_records(config.get("sampling", {}))
         if not prompts:
@@ -224,9 +272,10 @@ def main(argv=None) -> None:
 
                 # 2. rollout (jitted scan decode) + 3. score (jitted SPMD)
                 roll_rng = jax.random.fold_in(rng, 10_000 + rollout_idx)
-                out = generate_fn(trainer.params, gbatch["ids"], gbatch["mask"],
+                rp = rollout_params()
+                out = generate_fn(rp, gbatch["ids"], gbatch["mask"],
                                   roll_rng)
-                scores = score_fn(trainer.params, ref_params, rm_params,
+                scores = score_fn(rp, ref_params, rm_params,
                                   out["sequences"], out["sequence_mask"],
                                   jnp.float32(kl_coef))
 
@@ -246,17 +295,15 @@ def main(argv=None) -> None:
                 }
                 losses = []
                 if algo == "ppo":
-                    # size minibatches off the ACTUAL rollout row count —
-                    # batch_size // process_count rounds down per host, so
-                    # permuting the nominal batch_size could emit
-                    # out-of-range gather indices (silently clamped)
-                    n_rows = int(up["sequences"].shape[0])
-                    n_mb = max(1, n_rows // mini_batch)
-                    mb_size = n_rows // n_mb
+                    # mb_size/n_minibatches derived from rollout_rows up
+                    # top (where updates_per_rollout and the trainer's
+                    # batch identity were sized); the permutation covers
+                    # the actual rows, remainder rows sit out this epoch
+                    assert int(up["sequences"].shape[0]) == rollout_rows
                     for epoch in range(ppo_epochs):
                         order = np.random.default_rng(
-                            (rollout_idx, epoch)).permutation(n_rows)
-                        for k in range(n_mb):
+                            (rollout_idx, epoch)).permutation(rollout_rows)
+                        for k in range(n_minibatches):
                             sl = jnp.asarray(
                                 order[k * mb_size:(k + 1) * mb_size])
                             mb = jax.tree.map(
@@ -308,6 +355,10 @@ def main(argv=None) -> None:
 
         trainer.save(extra_aux=model_aux(policy, model_cfg.get("tokenizer")),
                      tag="final")
+        if use_lora:
+            save_merged_lora_final(
+                trainer, policy, trainer.frozen["base"],
+                model_cfg.get("tokenizer"))
         trainer.logger.finish()
 
 

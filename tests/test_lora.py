@@ -214,16 +214,142 @@ def test_lora_run_without_step_checkpoints_still_resumable(mesh8, tmp_path):
         assert aux is not None and trainer2.step == 1
 
 
-def test_unwired_trainers_reject_lora_config():
-    from dla_tpu.training.model_io import load_causal_lm, require_no_lora
+def test_rlhf_lora_rollout_update(mesh8):
+    """RLHF with adapters: rollouts decode over the merged base+adapter
+    tree, the reinforce update trains adapters only, and the frozen base
+    doubles as the reference model (every phase now wires the reference's
+    dead model.lora surface)."""
+    from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
+    from dla_tpu.models.reward import RewardModel
+    from dla_tpu.training.model_io import init_lora_adapters, load_causal_lm
+    from dla_tpu.training.train_rlhf import (
+        make_policy_gradient_loss,
+        make_score_fn,
+    )
+    from dla_tpu.training.trainer import Trainer
+    from dla_tpu.parallel.sharding import sharding_tree
 
-    bundle = load_causal_lm(
-        "tiny", {"tokenizer": "byte", "lora": {"enabled": True, "r": 4}},
+    policy = load_causal_lm(
+        "tiny", {"tokenizer": "byte",
+                 "lora": {"enabled": True, "r": 4, "alpha": 8}},
         jax.random.key(0))
-    with pytest.raises(ValueError, match="RLHF trainer does not support"):
-        require_no_lora(bundle, "RLHF")
-    plain = load_causal_lm("tiny", {"tokenizer": "byte"}, jax.random.key(0))
-    require_no_lora(plain, "RLHF")  # no-op without adapters
+    adapters, lora_specs = init_lora_adapters(policy, jax.random.key(17))
+    rm = RewardModel(policy.config)
+    config = {
+        "experiment_name": "lora_rlhf_test",
+        "optimization": {"total_batch_size": 4, "micro_batch_size": 1,
+                         "learning_rate": 1e-3, "max_train_steps": 4,
+                         "lr_scheduler": "constant", "max_grad_norm": 1.0},
+        "logging": {"output_dir": "/tmp/lora_rlhf_test", "log_dir": None},
+        "hardware": {"gradient_accumulation_steps": 1},
+    }
+    with jax.sharding.set_mesh(mesh8):
+        trainer = Trainer(
+            config=config, mesh=mesh8,
+            loss_fn=make_policy_gradient_loss(policy.model, "reinforce",
+                                              0.2, lora=True),
+            params=adapters, param_specs=lora_specs,
+            frozen={"base": policy.params},
+            frozen_specs={"base": policy.specs})
+        rm_params = jax.device_put(
+            rm.init(jax.random.key(2)),
+            sharding_tree(rm.partition_specs(), mesh8))
+        gen = GenerationConfig(max_new_tokens=8, do_sample=True,
+                               temperature=1.0, eos_token_id=-1,
+                               pad_token_id=0)
+        generate_fn = jax.jit(build_generate_fn(policy.model, gen))
+        score_fn = make_score_fn(policy.model, policy.model, rm)
+        merge_fn = jax.jit(policy.model.merge_lora)
+
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(1, 100, (8, 8)), jnp.int32)
+        mask = jnp.ones((8, 8), jnp.int32)
+        for i in range(2):
+            rp = merge_fn(trainer.frozen["base"], trainer.params)
+            out = generate_fn(rp, ids, mask, jax.random.key(i))
+            scores = score_fn(rp, trainer.frozen["base"], rm_params,
+                              out["sequences"], out["sequence_mask"],
+                              jnp.float32(0.1))
+            up = {"sequences": out["sequences"],
+                  "sequence_mask": out["sequence_mask"],
+                  "advantages": scores["advantages"],
+                  "behavior_logp": scores["behavior_logp"]}
+            loss, metrics = trainer.step_on_device_batch(
+                up, jax.random.key(100 + i))
+            assert np.isfinite(loss)
+        # adapters moved; base untouched
+        moved = sum(float(jnp.sum(jnp.abs(l)))
+                    for l in jax.tree.leaves(trainer.params))
+        assert moved > 0.0
+        # on step 0 the merged tree equals the base (B adapters start 0),
+        # so behavior_logp under merged == logp under base+adapters
+        assert np.isfinite(float(jnp.mean(scores["behavior_logp"])))
+
+
+def test_reward_trainer_lora_loss_falls_and_merges(mesh8, tmp_path):
+    """Reward model with backbone adapters + full-rank head: pairwise
+    loss falls, and the merged export loads back as a plain reward model
+    scoring identically to the adapted one (the artifact RLHF chains)."""
+    from dla_tpu.training.model_io import build_reward_model
+    from dla_tpu.training.train_reward import make_reward_loss
+
+    model_cfg = {"base_model_name_or_path": "tiny", "tokenizer": "byte",
+                 "lora": {"enabled": True, "r": 4, "alpha": 8}}
+    from dla_tpu.training.model_io import (
+        init_lora_adapters,
+        save_merged_lora_final,
+    )
+    from dla_tpu.training.trainer import Trainer
+
+    bundle = build_reward_model(model_cfg, jax.random.key(0))
+    head = bundle.params.pop("reward_head")
+    head_spec = bundle.specs.pop("reward_head")
+    adapters, lora_specs = init_lora_adapters(bundle, jax.random.key(17))
+    config = {
+        "experiment_name": "lora_rm_test",
+        "optimization": {"total_batch_size": 8, "micro_batch_size": 2,
+                         "learning_rate": 1e-2, "max_train_steps": 30,
+                         "lr_scheduler": "constant", "max_grad_norm": 1.0},
+        "logging": {"output_dir": str(tmp_path / "ckpt"), "log_dir": None},
+        "hardware": {"gradient_accumulation_steps": 1},
+    }
+    with jax.sharding.set_mesh(mesh8):
+        trainer = Trainer(
+            config=config, mesh=mesh8,
+            loss_fn=make_reward_loss(bundle.model, lora=True),
+            params={"lora": adapters, "reward_head": head},
+            param_specs={"lora": lora_specs, "reward_head": head_spec},
+            frozen=bundle.params, frozen_specs=bundle.specs)
+
+        def sub(seed):
+            r = np.random.RandomState(seed)
+            return {"input_ids": r.randint(1, 100, (8, 16)).astype(np.int32),
+                    "attention_mask": np.ones((8, 16), np.int32)}
+
+        batch = {"chosen": sub(1), "rejected": sub(2)}
+        losses = []
+        for i in range(30):
+            loss, _ = trainer.step_on_batch(
+                batch, jax.random.fold_in(jax.random.key(0), i))
+            losses.append(loss)
+        assert losses[-1] < losses[0] - 0.05, (losses[0], losses[-1])
+
+        save_merged_lora_final(trainer, bundle, trainer.frozen, "byte")
+        # chained load: plain reward model (lora_r=0 in merged aux)
+        merged = build_reward_model(
+            {"base_model_name_or_path": str(tmp_path / "ckpt" / "latest"),
+             "tokenizer": "byte"}, jax.random.key(9))
+        assert merged.config.lora_r == 0
+        ids = sub(1)
+        want = bundle.model.apply(
+            {**trainer.frozen, "reward_head": trainer.params["reward_head"]},
+            jnp.asarray(ids["input_ids"]), jnp.asarray(ids["attention_mask"]),
+            lora=trainer.params["lora"])
+        got = merged.model.apply(
+            merged.params, jnp.asarray(ids["input_ids"]),
+            jnp.asarray(ids["attention_mask"]))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
 
 
 def test_dpo_trainer_lora_loss_falls(mesh8):
@@ -256,8 +382,6 @@ def test_dpo_trainer_lora_loss_falls(mesh8):
             params=adapters, param_specs=lora_specs,
             frozen={"base": policy.params},
             frozen_specs={"base": policy.specs})
-        rs = np.random.RandomState(1)
-
         def sub(seed):
             r = np.random.RandomState(seed)
             return {"input_ids": r.randint(1, 100, (8, 16)).astype(np.int32),
