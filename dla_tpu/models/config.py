@@ -50,6 +50,11 @@ class ModelConfig:
     # all-to-all, needs kv_heads % seq_axis == 0). Active only when the
     # ambient mesh has sequence > 1; decode paths always run unsharded.
     context_parallel: str = "ring"
+    # GPipe microbatch count when the mesh has stage > 1 (pipeline
+    # parallelism). 0 = auto (one microbatch per stage). More microbatches
+    # shrink the (S-1)/(M+S-1) bubble at the cost of smaller per-stage
+    # matmuls; batch must be divisible by it.
+    pipeline_microbatches: int = 0
     # LoRA (the reference's model.lora block, advertised but never wired —
     # reference base_model.py:45-49 dead code, SURVEY.md sec 2.5; here it
     # is functional). lora_r == 0 disables. Adapters are a separate

@@ -92,6 +92,12 @@ def _sequence_axis_size() -> int:
     return mesh.shape.get("sequence", 1) if mesh is not None else 1
 
 
+def _stage_axis_size() -> int:
+    """Size of the `stage` (pipeline) axis of the ambient mesh."""
+    mesh = _ambient_mesh()
+    return mesh.shape.get("stage", 1) if mesh is not None else 1
+
+
 class Transformer:
     """Functional model: a namespace of pure functions bound to a config."""
 
@@ -219,8 +225,8 @@ class Transformer:
         layers: Params = {}
         for t in self.cfg.lora_targets:
             spec = base[t]
-            layers[f"{t}_lora_a"] = P(None, spec[1], None)
-            layers[f"{t}_lora_b"] = P(None, None, spec[2])
+            layers[f"{t}_lora_a"] = P("stage", spec[1], None)
+            layers[f"{t}_lora_b"] = P("stage", None, spec[2])
         return {"layers": layers}
 
     def merge_lora(self, params: Params, lora: Params) -> Params:
@@ -265,7 +271,10 @@ class Transformer:
         """PartitionSpec pytree mirroring ``init``'s output.
 
         fsdp shards the embedding/hidden dim; model shards heads / MLP
-        hidden / vocab (megatron). Stacked layer leaves lead with None.
+        hidden / vocab (megatron). Stacked layer leaves lead with the
+        ``stage`` axis — pipeline parallelism is "shard the layer stack":
+        each stage owns a contiguous block of layers (no-op at stage=1,
+        where the axis prunes away).
 
         The token-embedding table is deliberately NOT model-sharded: a
         gather whose operand is sharded on the indexed (vocab) dim forces
@@ -279,19 +288,19 @@ class Transformer:
             specs = {
                 "embed": {"embedding": P("fsdp", None)},
                 "layers": {
-                    "ln": P(None, None), "ln_bias": P(None, None),
-                    "wq": P(None, "fsdp", "model"),
-                    "wq_bias": P(None, "model"),
-                    "wk": P(None, "fsdp", "model"),
-                    "wk_bias": P(None, "model"),
-                    "wv": P(None, "fsdp", "model"),
-                    "wv_bias": P(None, "model"),
-                    "wo": P(None, "model", "fsdp"),
-                    "wo_bias": P(None, None),
-                    "fc1": P(None, "fsdp", "model"),
-                    "fc1_bias": P(None, "model"),
-                    "fc2": P(None, "model", "fsdp"),
-                    "fc2_bias": P(None, None),
+                    "ln": P("stage", None), "ln_bias": P("stage", None),
+                    "wq": P("stage", "fsdp", "model"),
+                    "wq_bias": P("stage", "model"),
+                    "wk": P("stage", "fsdp", "model"),
+                    "wk_bias": P("stage", "model"),
+                    "wv": P("stage", "fsdp", "model"),
+                    "wv_bias": P("stage", "model"),
+                    "wo": P("stage", "model", "fsdp"),
+                    "wo_bias": P("stage", None),
+                    "fc1": P("stage", "fsdp", "model"),
+                    "fc1_bias": P("stage", "model"),
+                    "fc2": P("stage", "model", "fsdp"),
+                    "fc2_bias": P("stage", None),
                 },
                 "final_norm": P(None),
                 "final_norm_bias": P(None),
@@ -303,22 +312,22 @@ class Transformer:
         specs: Params = {
             "embed": {"embedding": P("fsdp", None)},
             "layers": {
-                "attn_norm": P(None, None),
-                "wq": P(None, "fsdp", "model"),
-                "wk": P(None, "fsdp", "model"),
-                "wv": P(None, "fsdp", "model"),
-                "wo": P(None, "model", "fsdp"),
-                "mlp_norm": P(None, None),
-                "w_gate": P(None, "fsdp", "model"),
-                "w_up": P(None, "fsdp", "model"),
-                "w_down": P(None, "model", "fsdp"),
+                "attn_norm": P("stage", None),
+                "wq": P("stage", "fsdp", "model"),
+                "wk": P("stage", "fsdp", "model"),
+                "wv": P("stage", "fsdp", "model"),
+                "wo": P("stage", "model", "fsdp"),
+                "mlp_norm": P("stage", None),
+                "w_gate": P("stage", "fsdp", "model"),
+                "w_up": P("stage", "fsdp", "model"),
+                "w_down": P("stage", "model", "fsdp"),
             },
             "final_norm": P(None),
         }
         if self.cfg.attention_bias:
-            specs["layers"]["wq_bias"] = P(None, "model")
-            specs["layers"]["wk_bias"] = P(None, "model")
-            specs["layers"]["wv_bias"] = P(None, "model")
+            specs["layers"]["wq_bias"] = P("stage", "model")
+            specs["layers"]["wk_bias"] = P("stage", "model")
+            specs["layers"]["wv_bias"] = P("stage", "model")
         if not self.cfg.tie_embeddings:
             specs["lm_head"] = P("fsdp", "model")
         return specs
@@ -534,9 +543,14 @@ class Transformer:
         # [B, T, T] mask materialization entirely (round-2 verdict item 1:
         # packing + flash now compose — segment ids go to the kernel).
         # Right-padding alone needs no mask at all under flash: pad keys
-        # sit above every real query's causal diagonal.
+        # sit above every real query's causal diagonal. Under pipeline
+        # parallelism (stage > 1) flash is off — deciding that HERE keeps
+        # the kv_mask construction below in play, so packed/padded
+        # batches keep their masks on the pipeline's XLA attention path.
+        n_stages = _stage_axis_size()
         allow_flash = (cfg.attention == "flash" and not gapped_mask
-                       and cp is None and _flash_tileable(t))
+                       and cp is None and n_stages == 1
+                       and _flash_tileable(t))
         flash_segs = None
         if allow_flash and segment_ids is not None:
             # broadcast to the kernel's tileable layouts ONCE, outside the
@@ -567,6 +581,22 @@ class Transformer:
             if dropout_rng is not None and cfg.lora_dropout > 0:
                 keys = jax.random.split(dropout_rng, cfg.num_layers)
 
+        if n_stages > 1:
+            # pipeline parallelism: layer stack sharded over `stage`,
+            # GPipe microbatch schedule (ops.pipeline). LoRA leaves ride
+            # in `layers` and reshape with everything else.
+            if cp is not None:
+                raise NotImplementedError(
+                    "stage > 1 (pipeline) with sequence > 1 (context "
+                    "parallelism) is not supported yet — pick one")
+            if keys is not None:
+                raise NotImplementedError(
+                    "lora_dropout under pipeline parallelism is not "
+                    "supported; set lora.dropout to 0")
+            x = self._pipeline_forward(layers, x, cos, sin, kv_mask,
+                                       positions, n_stages)
+            return self._final_norm(params, x)
+
         if keys is None:
             def body(carry, layer):
                 h, _ = self._block(layer, carry, cos, sin, kv_mask,
@@ -587,6 +617,46 @@ class Transformer:
 
         x, _ = jax.lax.scan(self._maybe_remat(body), x, layers)
         return self._final_norm(params, x)
+
+    def _pipeline_forward(self, layers: Params, x: jnp.ndarray,
+                          cos: jnp.ndarray, sin: jnp.ndarray,
+                          kv_mask: Optional[jnp.ndarray],
+                          positions: jnp.ndarray,
+                          n_stages: int) -> jnp.ndarray:
+        """GPipe over the `stage` mesh axis: reshape the [L, ...] layer
+        stack to [S, L/S, ...] (shard-local — the stage axis owns
+        contiguous layer blocks), microbatch the batch dim, and run the
+        shift-register schedule from ops.pipeline. Attention takes the
+        XLA path inside the pipeline (the flash kernel's shard_map
+        wrapper cannot nest under the stage vmap yet)."""
+        from dla_tpu.ops.pipeline import gpipe, microbatch
+        cfg = self.cfg
+        n_layers = cfg.num_layers
+        if n_layers % n_stages:
+            raise ValueError(
+                f"pipeline needs num_layers ({n_layers}) divisible by the "
+                f"stage axis ({n_stages})")
+        m = cfg.pipeline_microbatches or n_stages
+        stage_layers = jax.tree.map(
+            lambda l: l.reshape((n_stages, n_layers // n_stages)
+                                + l.shape[1:]), layers)
+        aux = {"cos": microbatch(cos, m), "sin": microbatch(sin, m),
+               "positions": microbatch(positions, m)}
+        if kv_mask is not None:
+            aux["kv_mask"] = microbatch(kv_mask, m)
+
+        def stage_fn(stage_params, h, aux_t):
+            def body(carry, layer):
+                out, _ = self._block(layer, carry, aux_t["cos"],
+                                     aux_t["sin"], aux_t.get("kv_mask"),
+                                     aux_t["positions"], aux_t["positions"],
+                                     allow_flash=False)
+                return out, None
+            h, _ = jax.lax.scan(self._maybe_remat(body), h, stage_params)
+            return h
+
+        out = gpipe(stage_fn, stage_layers, microbatch(x, m), aux, n_stages)
+        return out.reshape(x.shape)
 
     def _final_norm(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
         if self.cfg.arch == "phi":

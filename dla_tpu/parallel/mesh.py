@@ -8,6 +8,10 @@ construct a ``jax.sharding.Mesh`` and annotate arrays with
 ``NamedSharding``; GSPMD inserts the ICI collectives.
 
 Axis semantics:
+  stage     pipeline parallelism (layer stack split into stages; GPipe
+            microbatch schedule, activations collective-permuted between
+            stages — the point-to-point pattern that rides DCN well, so
+            it is the OUTERMOST axis for multi-slice scale-out)
   data      pure data parallelism (batch split; grads psum-ed by XLA)
   fsdp      ZeRO-3-equivalent: parameters/opt-state sharded on this axis,
             all-gathered per-layer on use; also acts as a batch axis
@@ -15,8 +19,8 @@ Axis semantics:
   sequence  context parallelism (ring attention / long-context)
 
 The reference's ZeRO-3 stage-3 (config/deepspeed_zero3.json:6) maps to
-``fsdp > 1``; its plain DDP maps to ``data > 1``; TP/CP have no reference
-equivalent (SURVEY.md sec 2.3) and are new capability.
+``fsdp > 1``; its plain DDP maps to ``data > 1``; TP/PP/CP have no
+reference equivalent (SURVEY.md sec 2.3) and are new capability.
 """
 from __future__ import annotations
 
@@ -28,13 +32,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "fsdp", "model", "sequence", "expert")
+AXES = ("stage", "data", "fsdp", "model", "sequence", "expert")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical mesh shape. -1 on exactly one axis means "absorb remaining devices"."""
 
+    stage: int = 1
     data: int = 1
     fsdp: int = -1
     model: int = 1
@@ -49,6 +54,7 @@ class MeshConfig:
     def from_dict(cls, cfg: Optional[Dict[str, Any]]) -> "MeshConfig":
         cfg = cfg or {}
         return cls(
+            stage=int(cfg.get("stage", 1)),
             data=int(cfg.get("data", 1)),
             fsdp=int(cfg.get("fsdp", -1)),
             model=int(cfg.get("model", 1)),
@@ -57,7 +63,7 @@ class MeshConfig:
         )
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        sizes = {"data": self.data, "fsdp": self.fsdp,
+        sizes = {"stage": self.stage, "data": self.data, "fsdp": self.fsdp,
                  "model": self.model, "sequence": self.sequence,
                  "expert": self.expert}
         wild = [k for k, v in sizes.items() if v == -1]
@@ -82,10 +88,11 @@ def build_mesh(
 ) -> Mesh:
     """Build a Mesh over the given (default: all) devices.
 
-    Axis order is (data, fsdp, model, sequence, expert): the innermost
-    axes (model, sequence) get adjacent devices, which on real TPU topologies keeps
-    TP/CP collectives on the shortest ICI paths, while data/fsdp span the
-    outer (possibly DCN) dimensions.
+    Axis order is (stage, data, fsdp, model, sequence, expert): the
+    innermost axes (model, sequence) get adjacent devices, which on real
+    TPU topologies keeps TP/CP collectives on the shortest ICI paths;
+    stage is outermost so pipeline hops land on the outer (possibly DCN)
+    dimension where point-to-point traffic is the right pattern.
     """
     mesh_config = mesh_config or MeshConfig()
     if devices is None:

@@ -64,7 +64,8 @@ def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
         out["attention"] = ("flash" if model_cfg["use_flash_attention"]
                             else "xla")
     for key in ("dtype", "param_dtype", "remat", "vocab_size", "attention",
-                "context_parallel", "arch", "rotary_pct", "attention_bias"):
+                "context_parallel", "arch", "rotary_pct", "attention_bias",
+                "pipeline_microbatches"):
         if key in model_cfg:
             out[key] = model_cfg[key]
     # reference model.lora block (config/distill_config.yaml:10-14; dead

@@ -23,7 +23,7 @@ def _check(path: str, n_devices: int):
 
 def test_70b_v5e256_config():
     cfg, sizes = _check("config/sft_llama2_70b_v5e256.yaml", 256)
-    assert sizes == {"data": 1, "fsdp": 32, "model": 8,
+    assert sizes == {"stage": 1, "data": 1, "fsdp": 32, "model": 8,
                      "sequence": 1, "expert": 1}
     assert cfg["model"]["model_name_or_path"] == "meta-llama/Llama-2-70b-hf"
 
@@ -41,5 +41,5 @@ def test_70b_mesh_builds_on_virtual_devices():
     # Mesh constructor accepts the layout.
     mesh = build_mesh(MeshConfig(data=1, fsdp=4, model=2, sequence=1),
                       devices=jax.devices()[:8])
-    assert dict(mesh.shape) == {"data": 1, "fsdp": 4, "model": 2,
-                                "sequence": 1, "expert": 1}
+    assert dict(mesh.shape) == {"stage": 1, "data": 1, "fsdp": 4,
+                                "model": 2, "sequence": 1, "expert": 1}
