@@ -417,7 +417,9 @@ class Transformer:
                 return ulysses_causal_attention(
                     q, k, v, q_positions=q_positions,
                     kv_positions=kv_positions, kv_valid=kv_valid,
-                    segment_ids=seg)
+                    segment_ids=seg,
+                    use_flash=(self.cfg.attention == "flash"
+                               and _flash_tileable(t)))
             from dla_tpu.ops.ring_attention import ring_causal_attention
             return ring_causal_attention(
                 q, k, v, q_positions=q_positions, kv_positions=kv_positions,
@@ -528,9 +530,10 @@ class Transformer:
 
         # Context parallelism: when the ambient mesh shards `sequence`,
         # attention runs ring/ulysses from 1-D metadata. Ring stays
-        # blockwise (no [B, T, T] mask); ulysses re-shards heads and still
-        # materializes full-length scores per head slice — prefer ring for
-        # very long sequences (see dla_tpu/ops/ulysses.py memory note).
+        # blockwise (no [B, T, T] mask); ulysses routes its per-shard
+        # full-sequence attention through the flash kernel when the
+        # backend is on (O(T) memory) and only its XLA fallback
+        # materializes full-length scores (dla_tpu/ops/ulysses.py).
         cp = None
         if cfg.context_parallel != "none" and _sequence_axis_size() > 1:
             kv_valid = (attention_mask if attention_mask is not None
@@ -636,7 +639,12 @@ class Transformer:
             raise ValueError(
                 f"pipeline needs num_layers ({n_layers}) divisible by the "
                 f"stage axis ({n_stages})")
+        import math as _math
         m = cfg.pipeline_microbatches or n_stages
+        # degrade gracefully on batches the configured M doesn't divide
+        # (a last partial eval batch, a small rollout): the largest
+        # divisor still pipelines; worst case M=1 runs stages serially
+        m = _math.gcd(m, x.shape[0]) or 1
         stage_layers = jax.tree.map(
             lambda l: l.reshape((n_stages, n_layers // n_stages)
                                 + l.shape[1:]), layers)

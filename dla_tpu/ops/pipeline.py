@@ -10,26 +10,27 @@ sharding, the idiom praxis/maxtext use for TPU pipelining:
 - the stacked layer params [L, ...] shard their leading dim over
   ``stage`` (stage s owns the contiguous layer block s*L/S..(s+1)*L/S-1,
   so the [L] -> [S, L/S] reshape is shard-local);
-- a state buffer holds the activation currently AT each stage,
-  [S, mb, ...] sharded over ``stage``; a ``jax.vmap`` of the per-stage
-  layer scan computes every stage in parallel with zero cross-stage
-  traffic (all operands are stage-aligned);
-- ``jnp.roll(state, 1, axis=0)`` advances activations to the next stage —
-  on a stage-sharded dim XLA lowers this to a CollectivePermute, the
-  point-to-point hop that rides DCN well (why ``stage`` is the outermost
-  mesh axis);
+- a ``shard_map`` manual over only the ``stage`` axis runs each stage's
+  layer scan on its shard; TP/FSDP collectives inside the stage remain
+  GSPMD's job (``axis_names={"stage"}`` partial-manual mode);
+- ``lax.ppermute`` advances each activation microbatch to the next
+  stage — one [mb, T, D] point-to-point hop per tick, the pattern that
+  rides DCN well (why ``stage`` is the outermost mesh axis);
 - a ``lax.scan`` over M + S - 1 ticks runs the GPipe schedule: microbatch
   t enters stage 0 at tick t and exits stage S-1 at tick t + S - 1.
   Bubble fraction is the standard (S-1)/(M+S-1).
 
-The roll is circular, so after the last real microbatch stage 0 receives
-stage S-1's output as garbage input; it is harmless — anything injected
-at tick t >= M reaches the collection window only after tick M + S - 1,
-which is past the end of the scan.
+The ppermute ring is circular, so after the last real microbatch stage 0
+receives stage S-1's output as garbage input; it is harmless — anything
+injected at tick t >= M reaches the collection window only after tick
+M + S - 1, which is past the end of the scan. Warmup/drain ticks process
+zeros/garbage with clipped aux indices; those emissions are never
+collected, and the finite mask constant (ops.attention.NEG_INF) keeps
+them NaN-free so no garbage can poison the psum collection.
 
-Backward: plain autodiff through scan/vmap/roll (the transpose of a
-collective-permute is a collective-permute), so grads pipeline in
-reverse automatically — no hand-written backward schedule.
+Backward: plain autodiff through scan/ppermute (the transpose of a
+collective-permute is the reverse permute), so grads pipeline in reverse
+automatically — no hand-written backward schedule.
 """
 from __future__ import annotations
 
@@ -42,26 +43,6 @@ from jax.sharding import PartitionSpec as P
 Pytree = Any
 
 
-def _constrain_stage_state(tree: Pytree) -> Pytree:
-    """Pin [S, mb, ...] buffers to P("stage", ("data","fsdp"), ...) —
-    without the explicit constraint GSPMD loses the stage sharding at the
-    roll/slice boundary and falls back to replicating the whole shift
-    register every tick (observed: 'Involuntary full rematerialization'
-    and a fully-replicated pipeline)."""
-    def c(a):
-        spec = P("stage", ("data", "fsdp"), *([None] * (a.ndim - 2)))
-        try:
-            return jax.lax.with_sharding_constraint(a, spec)
-        except (ValueError, RuntimeError):
-            return a  # no ambient mesh (plain single-device use)
-    return jax.tree.map(c, tree)
-
-
-def _pad_stream(a: jnp.ndarray, pad: int) -> jnp.ndarray:
-    return jnp.concatenate(
-        [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
-
-
 def gpipe(
     stage_fn: Callable[[Pytree, jnp.ndarray, Pytree], jnp.ndarray],
     stage_params: Pytree,       # leaves [S, L/S, ...], dim 0 sharded "stage"
@@ -71,22 +52,24 @@ def gpipe(
 ) -> jnp.ndarray:
     """Run ``stage_fn`` (one stage's layer stack) as a GPipe pipeline.
 
-    Primary path: ``shard_map`` manual over ONLY the ``stage`` axis
+    ``shard_map`` manual over ONLY the ``stage`` axis
     (``axis_names={"stage"}``; data/fsdp/model stay GSPMD-auto inside),
     with ``lax.ppermute`` as the stage-to-stage hop — the genuine
-    point-to-point schedule. ``aux_mb`` (rotary phases, masks, positions)
-    travels with its microbatch through the ring so stage s always sees
-    the aux of the microbatch it is processing. Outputs are collected
-    from the last stage via a masked psum (the unembedding is replicated
-    over ``stage`` anyway). Returns [M, mb, ...] in microbatch order.
+    point-to-point schedule, and the ONLY per-tick cross-stage traffic:
+    the aux stream (rotary phases, masks, positions) is replicated over
+    ``stage`` already, so each stage just INDEXES it at its own offset
+    (stage s processes microbatch t - s at tick t) instead of shipping
+    multi-MB masks around the ring. Outputs are collected from the last
+    stage via a masked psum (the unembedding is replicated over ``stage``
+    anyway). Returns [M, mb, ...] in microbatch order.
 
-    Without an ambient concrete mesh (plain CPU tests, single device) a
-    vmap-over-stages fallback runs the same schedule semantics.
+    Requires the ambient mesh to carry a ``stage`` axis of ``n_stages``
+    (Transformer._pipeline_forward guarantees it; direct callers get a
+    clear error).
     """
     m = x_mb.shape[0]
     pad = n_stages - 1
-    stream = (_pad_stream(x_mb, pad),
-              jax.tree.map(lambda a: _pad_stream(a, pad), aux_mb))
+    _require_stage_mesh(n_stages)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def run(params_l, stream_x, stream_aux):
@@ -94,79 +77,46 @@ def gpipe(
         p_l = jax.tree.map(lambda a: jnp.squeeze(a, 0), params_l)
         s_idx = jax.lax.axis_index("stage")
         st_x = jnp.zeros(stream_x.shape[1:], stream_x.dtype)
-        st_aux = jax.tree.map(
-            lambda a: jnp.zeros(a.shape[1:], a.dtype), stream_aux)
 
-        def tick(carry, xs_t):
-            sx, saux = carry
-            inj_x, inj_aux = xs_t
-            first = s_idx == 0
-            sx = jnp.where(first, inj_x, sx)
-            saux = jax.tree.map(lambda i, c: jnp.where(first, i, c),
-                                inj_aux, saux)
-            out = stage_fn(p_l, sx, saux)
-            nxt = jax.lax.ppermute(out, "stage", perm)
-            naux = jax.tree.map(
-                lambda a: jax.lax.ppermute(a, "stage", perm), saux)
-            return (nxt, naux), out
+        def tick(sx, t):
+            # microbatch index this stage works on at tick t (clipped
+            # during this stage's warmup/drain ticks, whose outputs are
+            # never collected)
+            idx = jnp.clip(t - s_idx, 0, m - 1)
+            aux_t = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, idx, 0, keepdims=False), stream_aux)
+            inj = jax.lax.dynamic_index_in_dim(
+                stream_x, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            sx = jnp.where(s_idx == 0, inj, sx)
+            out = stage_fn(p_l, sx, aux_t)
+            return jax.lax.ppermute(out, "stage", perm), out
 
-        _, ys = jax.lax.scan(tick, (st_x, st_aux), (stream_x, stream_aux))
+        _, ys = jax.lax.scan(tick, st_x, jnp.arange(m + pad))
         # only the last stage's emissions are the model output
         last = (s_idx == n_stages - 1).astype(ys.dtype)
         return jax.lax.psum(ys * last, "stage")
 
-    if _stage_mesh_available(n_stages):
-        fn = jax.shard_map(
-            run,
-            in_specs=(jax.tree.map(lambda _: P("stage"), stage_params),
-                      P(), jax.tree.map(lambda _: P(), aux_mb)),
-            out_specs=P(),
-            axis_names={"stage"}, check_vma=False)
-        ys = fn(stage_params, *stream)
-    else:
-        ys = _gpipe_vmap(stage_fn, stage_params, stream, n_stages)
+    fn = jax.shard_map(
+        run,
+        in_specs=(jax.tree.map(lambda _: P("stage"), stage_params),
+                  P(), jax.tree.map(lambda _: P(), aux_mb)),
+        out_specs=P(),
+        axis_names={"stage"}, check_vma=False)
+    ys = fn(stage_params, x_mb, aux_mb)
     return ys[pad:]                       # microbatch t exits at tick t+pad
 
 
-def _stage_mesh_available(n_stages: int) -> bool:
-    """Explicit gate for the shard_map path (a broad try/except here
-    would swallow genuine model bugs into a silent vmap re-run)."""
+def _require_stage_mesh(n_stages: int) -> None:
     try:
         mesh = jax.sharding.get_abstract_mesh()
     except (ValueError, RuntimeError):
-        return False
-    return (mesh is not None and not mesh.empty
-            and mesh.shape.get("stage", 1) == n_stages)
-
-
-def _gpipe_vmap(stage_fn, stage_params, stream, n_stages: int):
-    """Same schedule expressed in pure GSPMD (vmap over the stage dim +
-    shift register) — the fallback when shard_map has no mesh to bind."""
-    stream_x, stream_aux = stream
-    state_x = jnp.zeros((n_stages,) + stream_x.shape[1:], stream_x.dtype)
-    state_aux = jax.tree.map(
-        lambda a: jnp.zeros((n_stages,) + a.shape[1:], a.dtype), stream_aux)
-    vmapped = jax.vmap(stage_fn)
-
-    def tick(carry, xs_t):
-        sx, saux = carry
-        inj_x, inj_aux = xs_t
-        sx = _constrain_stage_state(sx.at[0].set(inj_x))
-        saux = _constrain_stage_state(jax.tree.map(
-            lambda s, i: s.at[0].set(i), saux, inj_aux))
-        out = _constrain_stage_state(vmapped(stage_params, sx, saux))
-        y = out[-1]
-
-        def shift(a):  # state[s+1] = out[s]; row 0 refilled next tick
-            widths = ((1, 0),) + ((0, 0),) * (a.ndim - 1)
-            return jnp.pad(a, widths)[:-1]
-
-        return (_constrain_stage_state(shift(out)),
-                _constrain_stage_state(jax.tree.map(shift, saux))), y
-
-    (_, _), ys = jax.lax.scan(tick, (state_x, state_aux),
-                              (stream_x, stream_aux))
-    return ys
+        mesh = None
+    if (mesh is None or mesh.empty
+            or mesh.shape.get("stage", 1) != n_stages):
+        raise ValueError(
+            f"gpipe requires an ambient mesh with a 'stage' axis of size "
+            f"{n_stages} (use jax.sharding.set_mesh)")
 
 
 def microbatch(x: Optional[jnp.ndarray], n_micro: int):

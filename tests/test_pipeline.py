@@ -133,6 +133,25 @@ def test_pipeline_more_microbatches(tiny_setup):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_pipeline_degrades_microbatches_for_odd_batches(tiny_setup):
+    """A batch the configured M doesn't divide (last partial eval batch)
+    must still run — M degrades to the gcd instead of raising."""
+    import dataclasses
+    model, params, _ = tiny_setup
+    cfg4 = dataclasses.replace(model.cfg, pipeline_microbatches=4)
+    model4 = Transformer(cfg4)
+    rs = np.random.RandomState(3)
+    ids = jnp.asarray(rs.randint(1, 100, (6, 16)), jnp.int32)  # gcd(4,6)=2
+    want = model4.apply(params, ids)
+    mesh = _stage_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model4.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model4.apply(p, ids))(sp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_pipeline_rejects_bad_combos(tiny_setup):
     import dataclasses
     model, params, ids = tiny_setup
