@@ -21,10 +21,14 @@ Robustness contract: the bench PREFERS the real accelerator, falls back
 to forced CPU when no accelerator comes up, and emits its JSON line with
 exit code 0 on EVERY path. Backend init through the TPU tunnel has been
 observed to *hang* (not raise) — so the parent process NEVER initializes
-jax itself: it orchestrates two bounded subprocesses (accelerator
-attempt, then forced-CPU fallback) and relays whichever JSON line
-arrives first. Timeouts: DLA_BENCH_ACCEL_TIMEOUT (default 900s) /
-DLA_BENCH_CPU_TIMEOUT (default 600s).
+jax itself: every jax touch happens in a bounded child. The accelerator
+attempt is a descent ladder over micro batch sizes (8 -> 6 -> 4, or just
+the operator-set DLA_BENCH_MICRO), each in a FRESH child because an HBM
+OOM can poison a live TPU client; a child that times out (wedged tunnel)
+or reports no backend ends the ladder immediately, then a forced-CPU
+child guarantees the line. Worst case wall time is
+len(ladder) * DLA_BENCH_ACCEL_TIMEOUT (default 900s each, crash-only
+path) + DLA_BENCH_CPU_TIMEOUT (default 600s).
 """
 from __future__ import annotations
 
@@ -101,7 +105,8 @@ def run_bench() -> dict:
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_layers=24, num_heads=16, num_kv_heads=16,
             max_seq_length=2048, remat="dots", attention="flash")
-        micro, seq, steps, warmup = 8, 2048, 6, 2
+        micro = int(os.environ.get("DLA_BENCH_MICRO", "8"))
+        seq, steps, warmup = 2048, 6, 2
     else:  # CPU fallback so the bench always emits its line
         cfg = ModelConfig(
             vocab_size=512, hidden_size=128, intermediate_size=384,
@@ -168,6 +173,10 @@ def run_bench() -> dict:
         "value": round(tok_s_chip, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(vs_baseline, 4),
+        # which ladder rung produced this number — a degraded micro=4
+        # fallback must be distinguishable from the tuned micro=8 config
+        "detail": {"micro": micro, "seq": seq,
+                   "params_m": round(n_params / 1e6)},
     }
 
 
@@ -335,8 +344,12 @@ def _extract_json_line(text: str) -> dict | None:
     return None
 
 
-def _relay_child(mode: str, timeout_s: float) -> dict | None:
-    """Run the bench in a bounded subprocess; return its JSON line."""
+def _relay_child(mode: str, timeout_s: float) -> tuple:
+    """Run the bench in a bounded subprocess; (JSON line | None, status)
+    where status is "ok" | "timeout" | "failed" — the caller retries a
+    smaller config only on "failed" (an OOM-class crash); a timeout means
+    the tunnel is wedged and further accel attempts would just burn the
+    driver's budget."""
     stdout, stderr, rc = "", "", None
     try:
         proc = subprocess.run(
@@ -351,15 +364,21 @@ def _relay_child(mode: str, timeout_s: float) -> dict | None:
             (e.stderr or b"").decode("utf-8", "replace")
         print(f"[bench] {mode} child timed out after {timeout_s:.0f}s",
               file=sys.stderr)
+        sys.stderr.write(stderr or "")
+        return _extract_json_line(stdout), "timeout"
     except Exception as e:
         print(f"[bench] {mode} child failed to launch: {e}", file=sys.stderr)
-        return None
+        return None, "failed"
     sys.stderr.write(stderr or "")
     result = _extract_json_line(stdout)
-    if result is None:
-        print(f"[bench] {mode} child emitted no JSON line (rc={rc})",
-              file=sys.stderr)
-    return result
+    if result is not None:
+        return result, "ok"
+    print(f"[bench] {mode} child emitted no JSON line (rc={rc})",
+          file=sys.stderr)
+    # rc=1 is the accel child's "no backend ever came up" exit
+    # (_try_devices returned None) — retrying a smaller config cannot
+    # help; rc!=1 crashes are OOM-class and worth a smaller retry
+    return None, ("no_backend" if rc == 1 else "failed")
 
 
 def _emit_and_maybe_extra() -> None:
@@ -398,14 +417,26 @@ def main() -> int:
         return 0
 
     # Parent orchestrator: NEVER initializes jax (backend init can hang);
-    # every jax touch happens in a time-bounded child.
+    # every jax touch happens in a time-bounded child. The accelerator
+    # attempt descends through micro batch sizes in FRESH children — an
+    # HBM OOM can poison a live TPU client (observed: later ops fail with
+    # RESOURCE_EXHAUSTED), so each retry gets a clean process.
     if "--extra" in sys.argv:
         os.environ["DLA_BENCH_EXTRA"] = "1"
     accel_t = float(os.environ.get("DLA_BENCH_ACCEL_TIMEOUT", "900"))
     cpu_t = float(os.environ.get("DLA_BENCH_CPU_TIMEOUT", "600"))
-    result = _relay_child("accel", accel_t)
+    preset = os.environ.get("DLA_BENCH_MICRO")
+    ladder = (int(preset),) if preset else (8, 6, 4)
+    result = None
+    for micro in ladder:
+        os.environ["DLA_BENCH_MICRO"] = str(micro)
+        result, status = _relay_child("accel", accel_t)
+        if result is not None or status in ("timeout", "no_backend"):
+            break
+        print(f"[bench] accel attempt at micro={micro} produced no "
+              f"result; retrying smaller", file=sys.stderr)
     if result is None:
-        result = _relay_child("cpu", cpu_t)
+        result, _ = _relay_child("cpu", cpu_t)
     if result is None:  # last resort: the line must still be emitted
         result = {
             "metric": "sft_tokens_per_sec_per_chip", "value": 0.0,
