@@ -105,7 +105,10 @@ def run_bench() -> dict:
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_layers=24, num_heads=16, num_kv_heads=16,
             max_seq_length=2048, remat="dots", attention="flash")
-        micro = int(os.environ.get("DLA_BENCH_MICRO", "8"))
+        try:
+            micro = int(os.environ.get("DLA_BENCH_MICRO", "8"))
+        except ValueError:
+            micro = 8
         seq, steps, warmup = 2048, 6, 2
     else:  # CPU fallback so the bench always emits its line
         cfg = ModelConfig(
@@ -426,7 +429,12 @@ def main() -> int:
     accel_t = float(os.environ.get("DLA_BENCH_ACCEL_TIMEOUT", "900"))
     cpu_t = float(os.environ.get("DLA_BENCH_CPU_TIMEOUT", "600"))
     preset = os.environ.get("DLA_BENCH_MICRO")
-    ladder = (int(preset),) if preset else (8, 6, 4)
+    try:  # a malformed value must not break the always-emit contract
+        ladder = (int(preset),) if preset else (8, 6, 4)
+    except ValueError:
+        print(f"[bench] ignoring malformed DLA_BENCH_MICRO={preset!r}",
+              file=sys.stderr)
+        ladder = (8, 6, 4)
     result = None
     for micro in ladder:
         os.environ["DLA_BENCH_MICRO"] = str(micro)

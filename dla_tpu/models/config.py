@@ -55,6 +55,17 @@ class ModelConfig:
     # shrink the (S-1)/(M+S-1) bubble at the cost of smaller per-stage
     # matmuls; batch must be divisible by it.
     pipeline_microbatches: int = 0
+    # Mixture-of-Experts (beyond-reference capability; makes the
+    # reserved `expert` mesh axis real — ops/moe.py). 0 = dense MLP.
+    # llama arch only; top-k routing with GShard capacity dispatch.
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    moe_capacity_factor: float = 1.25
+    # GShard token-group size: capacity is enforced per group of this
+    # many tokens, keeping dispatch memory/FLOPs O(T) at long context
+    moe_group_size: int = 512
+    moe_aux_weight: float = 0.01      # switch load-balance loss weight
+    moe_z_weight: float = 0.001       # router z-loss weight
     # LoRA (the reference's model.lora block, advertised but never wired —
     # reference base_model.py:45-49 dead code, SURVEY.md sec 2.5; here it
     # is functional). lora_r == 0 disables. Adapters are a separate
@@ -78,6 +89,19 @@ class ModelConfig:
                 f"scores and cannot run at max_seq_length="
                 f"{self.max_seq_length} (> {self.ULYSSES_MAX_SEQ}); use "
                 f"context_parallel: ring for long context")
+        if self.num_experts > 0:
+            if self.arch != "llama":
+                raise ValueError(
+                    f"MoE (num_experts={self.num_experts}) is implemented "
+                    f"for the llama block only, not arch='{self.arch}'")
+            if self.lora_r > 0:
+                ffn = {"w_gate", "w_up", "w_down", "fc1", "fc2"}
+                bad = ffn & set(self.lora_targets)
+                if bad:
+                    raise ValueError(
+                        f"LoRA targets {sorted(bad)} are dense-MLP "
+                        f"matrices; with num_experts > 0 restrict "
+                        f"lora_targets to attention projections")
 
     @property
     def head_dim_(self) -> int:
@@ -154,6 +178,14 @@ register_model("phi-2", ModelConfig(
     vocab_size=51200, hidden_size=2560, intermediate_size=10240,
     num_layers=32, num_heads=32, num_kv_heads=32, max_seq_length=2048,
     arch="phi", rotary_pct=0.4, rms_norm_eps=1e-5))
+# mixtral 8x7B (MoE): 8 experts, top-2 routing — beyond-reference
+# capability exercising the `expert` mesh axis. Weight import from HF
+# mixtral checkpoints is not wired yet (block_sparse_moe key mapping);
+# the preset initializes from scratch.
+register_model("mixtral-8x7b", ModelConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1e6,
+    max_seq_length=32768, num_experts=8, num_experts_per_token=2))
 # tiny models for tests / smoke runs
 register_model("tiny", ModelConfig(
     vocab_size=512, hidden_size=64, intermediate_size=192,
@@ -163,6 +195,11 @@ register_model("tiny-gqa", ModelConfig(
     vocab_size=512, hidden_size=128, intermediate_size=384,
     num_layers=4, num_heads=8, num_kv_heads=4, max_seq_length=512,
     param_dtype="float32", dtype="float32", remat="none"))
+register_model("tiny-moe", ModelConfig(
+    vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=2, max_seq_length=256,
+    num_experts=4, num_experts_per_token=2,
+    param_dtype="float32", dtype="float32", remat="none"))
 
 # HF repo-id aliases so reference configs keep working verbatim
 register_model("meta-llama/Llama-2-7b-hf", _REGISTRY["llama2-7b"])
@@ -171,3 +208,4 @@ register_model("meta-llama/Llama-2-70b-hf", _REGISTRY["llama2-70b"])
 register_model("mistralai/Mistral-7B-v0.1", _REGISTRY["mistral-7b"])
 register_model("Qwen/Qwen2-7B", _REGISTRY["qwen2-7b"])
 register_model("microsoft/phi-2", _REGISTRY["phi-2"])
+register_model("mistralai/Mixtral-8x7B-v0.1", _REGISTRY["mixtral-8x7b"])

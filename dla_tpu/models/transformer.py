@@ -151,6 +151,20 @@ class Transformer:
                 params["lm_head_bias"] = jnp.zeros(
                     (cfg.vocab_size,), self.pdtype)
             return params
+        if cfg.num_experts > 0:
+            E = cfg.num_experts
+            mlp = {
+                "router": mat(jax.random.fold_in(rng, 7), (L, D, E), std),
+                "w_gate": mat(keys[5], (L, E, D, F), std),
+                "w_up": mat(keys[6], (L, E, D, F), std),
+                "w_down": mat(keys[7], (L, E, F, D), out_std),
+            }
+        else:
+            mlp = {
+                "w_gate": mat(keys[5], (L, D, F), std),
+                "w_up": mat(keys[6], (L, D, F), std),
+                "w_down": mat(keys[7], (L, F, D), out_std),
+            }
         params: Params = {
             "embed": {"embedding": mat(keys[0], (cfg.vocab_size, D), std)},
             "layers": {
@@ -160,9 +174,7 @@ class Transformer:
                 "wv": mat(keys[3], (L, D, kvdim), std),
                 "wo": mat(keys[4], (L, qdim, D), out_std),
                 "mlp_norm": jnp.ones((L, D), self.pdtype),
-                "w_gate": mat(keys[5], (L, D, F), std),
-                "w_up": mat(keys[6], (L, D, F), std),
-                "w_down": mat(keys[7], (L, F, D), out_std),
+                **mlp,
             },
             "final_norm": jnp.ones((D,), self.pdtype),
         }
@@ -309,6 +321,19 @@ class Transformer:
                 specs["lm_head"] = P("fsdp", "model")
                 specs["lm_head_bias"] = P("model")
             return specs
+        if self.cfg.num_experts > 0:
+            mlp_specs = {
+                "router": P("stage", "fsdp", None),
+                "w_gate": P("stage", "expert", "fsdp", "model"),
+                "w_up": P("stage", "expert", "fsdp", "model"),
+                "w_down": P("stage", "expert", "model", "fsdp"),
+            }
+        else:
+            mlp_specs = {
+                "w_gate": P("stage", "fsdp", "model"),
+                "w_up": P("stage", "fsdp", "model"),
+                "w_down": P("stage", "model", "fsdp"),
+            }
         specs: Params = {
             "embed": {"embedding": P("fsdp", None)},
             "layers": {
@@ -318,9 +343,7 @@ class Transformer:
                 "wv": P("stage", "fsdp", "model"),
                 "wo": P("stage", "model", "fsdp"),
                 "mlp_norm": P("stage", None),
-                "w_gate": P("stage", "fsdp", "model"),
-                "w_up": P("stage", "fsdp", "model"),
-                "w_down": P("stage", "model", "fsdp"),
+                **mlp_specs,
             },
             "final_norm": P(None),
         }
@@ -344,6 +367,7 @@ class Transformer:
                flash_segs: Optional[jnp.ndarray] = None,
                cp: Optional[Tuple] = None,
                dropout_key: Optional[jax.Array] = None,
+               token_valid: Optional[jnp.ndarray] = None,  # [B, T] for MoE
                ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
         """One decoder block. Returns (output, (k, v)) — k/v before override,
         for cache writes. ``layer`` may carry LoRA leaves (merged upstream)."""
@@ -388,15 +412,33 @@ class Transformer:
             ff = _constrain(jax.nn.gelu(proj("fc1", h), approximate=True),
                             P(("data", "fsdp"), "sequence", "model"))
             mlp_out = _constrain(proj("fc2", ff), ACT_SPEC)
-            return x + attn_out + mlp_out, new_kv
+            return x + attn_out + mlp_out, new_kv, None
 
         x = x + _constrain(proj("wo", attn), ACT_SPEC)
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        mlp_out, moe_aux = self._mlp(layer, h, proj, token_valid)
+        x = x + _constrain(mlp_out, ACT_SPEC)
+        return x, new_kv, moe_aux
+
+    def _mlp(self, layer: Params, h: jnp.ndarray, proj,
+             token_valid: Optional[jnp.ndarray] = None):
+        """Dense gated-SiLU MLP, or the routed MoE variant when the layer
+        carries a router (cfg.num_experts > 0). Returns (out, aux | None);
+        aux is the (load_balance, router_z, dropped_frac) triple from
+        ops.moe for the trainer to weight in. ``token_valid`` keeps pad
+        tokens from claiming expert capacity or skewing router stats."""
+        if "router" in layer:
+            from dla_tpu.ops.moe import moe_mlp
+            out, aux = moe_mlp(
+                h, layer["router"], layer["w_gate"], layer["w_up"],
+                layer["w_down"], k=self.cfg.num_experts_per_token,
+                capacity_factor=self.cfg.moe_capacity_factor,
+                valid=token_valid, group_size=self.cfg.moe_group_size)
+            return out, aux
         gate = jax.nn.silu(proj("w_gate", h))
         up = proj("w_up", h)
         ff = _constrain(gate * up, P(("data", "fsdp"), "sequence", "model"))
-        x = x + _constrain(proj("w_down", ff), ACT_SPEC)
-        return x, new_kv
+        return proj("w_down", ff), None
 
     def _attention(self, q, k, v, kv_segment_mask, q_positions, kv_positions,
                    allow_flash: bool = False, cp: Optional[Tuple] = None,
@@ -497,6 +539,27 @@ class Transformer:
         dropout_rng: Optional[jax.Array] = None,        # enables lora dropout
     ) -> jnp.ndarray:
         """Full-sequence forward up to the final norm. [B, T, D].
+        (Aux-discarding wrapper — MoE models training through a CE loss
+        should use hidden_states_with_aux to keep the router's
+        load-balance loss.)"""
+        return self.hidden_states_with_aux(
+            params, input_ids, attention_mask, segment_ids, positions,
+            gapped_mask=gapped_mask, lora=lora, dropout_rng=dropout_rng)[0]
+
+    def hidden_states_with_aux(
+        self,
+        params: Params,
+        input_ids: jnp.ndarray,                 # [B, T]
+        attention_mask: Optional[jnp.ndarray] = None,   # [B, T] 1 = real
+        segment_ids: Optional[jnp.ndarray] = None,      # [B, T] for packing
+        positions: Optional[jnp.ndarray] = None,        # [B, T]
+        gapped_mask: bool = False,
+        lora: Optional[Params] = None,                  # adapter pytree
+        dropout_rng: Optional[jax.Array] = None,        # enables lora dropout
+    ) -> Tuple[jnp.ndarray, Optional[Any]]:
+        """Full-sequence forward up to the final norm. Returns
+        ([B, T, D], moe_aux) where moe_aux is an ops.moe.MoEAux of
+        layer-mean scalars when cfg.num_experts > 0, else None.
 
         ``gapped_mask``: declare that attention_mask may have internal
         zero gaps (not plain right-padding). Gapped masks are handled
@@ -596,30 +659,49 @@ class Transformer:
                 raise NotImplementedError(
                     "lora_dropout under pipeline parallelism is not "
                     "supported; set lora.dropout to 0")
+            if cfg.num_experts > 0:
+                raise NotImplementedError(
+                    "MoE under pipeline parallelism is not supported yet "
+                    "(the router's balance loss has no collection path "
+                    "through the stage schedule)")
             x = self._pipeline_forward(layers, x, cos, sin, kv_mask,
                                        positions, n_stages)
-            return self._final_norm(params, x)
+            return self._final_norm(params, x), None
+
+        # MoE routing must know which tokens are real: pads must not
+        # claim expert capacity or skew the balance statistics
+        token_valid = None
+        if cfg.num_experts > 0:
+            if attention_mask is not None:
+                token_valid = attention_mask
+            elif segment_ids is not None:
+                token_valid = (segment_ids > 0).astype(jnp.int32)
 
         if keys is None:
             def body(carry, layer):
-                h, _ = self._block(layer, carry, cos, sin, kv_mask,
-                                   positions, positions,
-                                   allow_flash=allow_flash,
-                                   flash_segs=flash_segs, cp=cp)
-                return h, None
+                h, _, aux = self._block(layer, carry, cos, sin, kv_mask,
+                                        positions, positions,
+                                        allow_flash=allow_flash,
+                                        flash_segs=flash_segs, cp=cp,
+                                        token_valid=token_valid)
+                return h, aux
         else:
             def body(carry, xs):
                 layer, key = xs
-                h, _ = self._block(layer, carry, cos, sin, kv_mask,
-                                   positions, positions,
-                                   allow_flash=allow_flash,
-                                   flash_segs=flash_segs, cp=cp,
-                                   dropout_key=key)
-                return h, None
+                h, _, aux = self._block(layer, carry, cos, sin, kv_mask,
+                                        positions, positions,
+                                        allow_flash=allow_flash,
+                                        flash_segs=flash_segs, cp=cp,
+                                        dropout_key=key,
+                                        token_valid=token_valid)
+                return h, aux
             layers = (layers, keys)
 
-        x, _ = jax.lax.scan(self._maybe_remat(body), x, layers)
-        return self._final_norm(params, x)
+        x, auxs = jax.lax.scan(self._maybe_remat(body), x, layers)
+        moe_aux = None
+        if auxs is not None:
+            moe_aux = type(auxs)(*(jnp.mean(a) for a in auxs))  # layer mean
+        return self._final_norm(params, x), moe_aux
 
     def _pipeline_forward(self, layers: Params, x: jnp.ndarray,
                           cos: jnp.ndarray, sin: jnp.ndarray,
@@ -655,10 +737,11 @@ class Transformer:
 
         def stage_fn(stage_params, h, aux_t):
             def body(carry, layer):
-                out, _ = self._block(layer, carry, aux_t["cos"],
-                                     aux_t["sin"], aux_t.get("kv_mask"),
-                                     aux_t["positions"], aux_t["positions"],
-                                     allow_flash=False)
+                out, _, _ = self._block(layer, carry, aux_t["cos"],
+                                        aux_t["sin"], aux_t.get("kv_mask"),
+                                        aux_t["positions"],
+                                        aux_t["positions"],
+                                        allow_flash=False)
                 return out, None
             h, _ = jax.lax.scan(self._maybe_remat(body), h, stage_params)
             return h
@@ -759,8 +842,10 @@ class Transformer:
         cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
 
         def body(carry, layer):
-            h, kv = self._block(layer, carry, cos, sin, kv_mask,
-                                positions, positions, allow_flash=flash_ok)
+            h, kv, _ = self._block(layer, carry, cos, sin, kv_mask,
+                                   positions, positions,
+                                   allow_flash=flash_ok,
+                                   token_valid=attention_mask)
             return h, kv
 
         x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
@@ -849,8 +934,7 @@ class Transformer:
                 return x2, (k_cache, v_cache)
             x1 = h_in + proj("wo", attn)
             hn2 = rms_norm(x1, layer["mlp_norm"], cfg.rms_norm_eps)
-            ff = jax.nn.silu(proj("w_gate", hn2)) * proj("w_up", hn2)
-            x2 = x1 + proj("w_down", ff)
+            x2 = x1 + self._mlp(layer, hn2, proj)[0]  # aux unused at decode
             return x2, (k_cache, v_cache)
 
         # validity/positions after writing this token

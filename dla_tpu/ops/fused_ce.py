@@ -128,30 +128,55 @@ def model_fused_ce(model, params, batch, lora=None, dropout_rng=None,
     """hidden_states -> unembed_params -> fused CE, the recipe shared by
     SFT / distill-CE / bench (one place to change chunking or bias
     threading). ``params`` is the base tree; LoRA adapters ride in
-    ``lora``. Returns (loss, n_valid_tokens)."""
-    h = model.hidden_states(
+    ``lora``. For MoE models the router's config-weighted auxiliary
+    losses (load balance + z-loss) fold into the returned loss.
+    Returns (loss, n_valid_tokens)."""
+    h, moe_aux = model.hidden_states_with_aux(
         params, batch["input_ids"],
         attention_mask=batch.get("attention_mask"),
         segment_ids=batch.get("segment_ids"),
         lora=lora, dropout_rng=dropout_rng)
     w, bias = model.unembed_params(params)
-    return fused_cross_entropy_loss(h, w, batch["labels"], bias=bias,
-                                    chunk=chunk)
+    loss, n = fused_cross_entropy_loss(h, w, batch["labels"], bias=bias,
+                                       chunk=chunk)
+    if moe_aux is not None:
+        loss = (loss
+                + model.cfg.moe_aux_weight * moe_aux.load_balance
+                + model.cfg.moe_z_weight * moe_aux.router_z)
+    return loss, n
+
+
+def weighted_moe_aux(model, *auxes):
+    """Config-weighted MoE auxiliary loss (0.0 for dense models): mean
+    load-balance + z-loss over the given forwards' aux tuples. Every
+    trainer that takes gradients through a router adds this — otherwise
+    the router trains unregularized and collapses onto one expert."""
+    live = [a for a in auxes if a is not None]
+    if not live:
+        return 0.0
+    lb = sum(a.load_balance for a in live) / len(live)
+    rz = sum(a.router_z for a in live) / len(live)
+    return (model.cfg.moe_aux_weight * lb
+            + model.cfg.moe_z_weight * rz)
 
 
 def model_fused_sequence_logprob(model, params, input_ids, attention_mask,
                                  lora=None, dropout_rng=None,
-                                 chunk: int = DEFAULT_CHUNK):
+                                 chunk: int = DEFAULT_CHUNK,
+                                 with_aux: bool = False):
     """hidden_states -> unembed_params -> fused sequence logp, the recipe
     shared by DPO and RLHF (policy loss + scoring). [B] fp32. ``params``
     is the base tree; LoRA adapters ride in ``lora`` (the unembedding is
-    never a LoRA target, so w always comes from the base)."""
-    h = model.hidden_states(params, input_ids,
-                            attention_mask=attention_mask,
-                            lora=lora, dropout_rng=dropout_rng)
+    never a LoRA target, so w always comes from the base).
+    ``with_aux`` additionally returns the MoE aux tuple (None for dense)
+    so policy-gradient losses can regularize the router."""
+    h, moe_aux = model.hidden_states_with_aux(
+        params, input_ids, attention_mask=attention_mask,
+        lora=lora, dropout_rng=dropout_rng)
     w, bias = model.unembed_params(params)
-    return fused_sequence_logprob_mean(h, w, input_ids, attention_mask,
+    logp = fused_sequence_logprob_mean(h, w, input_ids, attention_mask,
                                        bias=bias, chunk=chunk)
+    return (logp, moe_aux) if with_aux else logp
 
 
 def fused_token_logprobs(

@@ -1,0 +1,158 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``expert``
+mesh axis.
+
+Beyond-reference capability: the reference is dense-only (SURVEY.md sec
+2.3 EP row) and this framework had reserved the mesh axis without using
+it. This is the GShard/Mixtral TPU recipe — everything is einsum, so
+GSPMD shards the expert dim and inserts the all-to-alls:
+
+- router: logits [B, T, E] from a [D, E] projection; top-k softmax over
+  the selected experts' logits (Mixtral normalization);
+- GShard token grouping: the sequence folds into groups of at most
+  ``group_size`` tokens (groups ride the batch dim), so the dispatch
+  tensor is [rows, G, E, Cg] with Cg = ceil(k * G / E * cf) — O(T) total
+  memory and dispatch FLOPs instead of the O(T^2) a whole-sequence
+  capacity would cost at 32k context;
+- capacity dispatch: within each group, each expert takes at most Cg
+  tokens; a one-hot dispatch tensor built from a cumulative position
+  count routes token -> (expert, slot). Tokens over capacity are DROPPED
+  (standard GShard behavior): they contribute nothing here and ride the
+  residual connection. Padding tokens (``valid`` = 0) never claim a
+  slot and are excluded from the router statistics;
+- expert FFN: gated-SiLU like the dense block, batched over experts with
+  weights [E, D, F] whose expert dim is sharded over the mesh's
+  ``expert`` axis — the dispatch/return einsums become all-to-alls on
+  TPU;
+- combine: weighted sum of expert outputs back to [B, T, D] with the
+  top-k router weights;
+- aux losses: switch-style load-balance loss (mean fraction x mean
+  router prob per expert, scaled by E) and router z-loss, returned for
+  the trainer to weight in.
+
+Static shapes throughout (C is computed from static T/E/k), scan/remat
+friendly, composes with fsdp/model sharding on the non-expert dims.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, jnp.ndarray]
+
+
+class MoEAux(NamedTuple):
+    load_balance: jnp.ndarray   # scalar, switch-style balance loss
+    router_z: jnp.ndarray       # scalar, router logit z-loss
+    dropped_frac: jnp.ndarray   # scalar, fraction of token-slots dropped
+
+
+def expert_capacity(t: int, n_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    return max(1, math.ceil(t * k / n_experts * capacity_factor))
+
+
+def _constrain(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x
+
+
+def _fit_group(t: int, group_size: int) -> int:
+    """Largest divisor of t that is <= group_size (t itself when small)."""
+    g = min(t, group_size)
+    while g > 1 and t % g:
+        g -= 1
+    return max(g, 1)
+
+
+def moe_mlp(
+    h: jnp.ndarray,              # [B, T, D] block input (post-norm)
+    router_w: jnp.ndarray,       # [D, E]
+    w_gate: jnp.ndarray,         # [E, D, F]
+    w_up: jnp.ndarray,           # [E, D, F]
+    w_down: jnp.ndarray,         # [E, F, D]
+    *,
+    k: int,
+    capacity_factor: float = 1.25,
+    valid: Optional[jnp.ndarray] = None,   # [B, T] 1 = real token
+    group_size: int = 512,
+) -> Tuple[jnp.ndarray, MoEAux]:
+    """Routed gated-SiLU MLP. Returns ([B, T, D] output, aux losses)."""
+    b, t, d = h.shape
+    g = _fit_group(t, group_size)
+    rows = b * (t // g)
+    h2 = h.reshape(rows, g, d)
+    v2 = None if valid is None else valid.reshape(rows, g)
+    out, aux = _moe_rows(h2, router_w, w_gate, w_up, w_down, k=k,
+                         capacity_factor=capacity_factor, valid=v2)
+    return out.reshape(b, t, d), aux
+
+
+def _moe_rows(h, router_w, w_gate, w_up, w_down, *, k, capacity_factor,
+              valid):
+    rows, g, d = h.shape
+    e = router_w.shape[1]
+    k = min(k, e)
+    cap = expert_capacity(g, e, k, capacity_factor)
+    v = (jnp.ones((rows, g), jnp.float32) if valid is None
+         else valid.astype(jnp.float32))
+
+    logits = (h @ router_w.astype(h.dtype)).astype(jnp.float32)  # [R, G, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k experts per token; weights = softmax over the k chosen logits
+    top_w, top_e = jax.lax.top_k(logits, k)                # [R, G, k]
+    top_w = jax.nn.softmax(top_w, axis=-1)
+
+    # slot assignment: position of this token among all (token, choice)
+    # pairs routed to the same expert, counted in (choice-major, then
+    # token) order so primary routes win capacity over secondary ones.
+    # Padding tokens claim no slot at all (their one-hot is zeroed), so
+    # they can never evict real tokens from an expert's capacity.
+    choice_onehot = (jax.nn.one_hot(top_e, e, dtype=jnp.int32)
+                     * v[:, :, None, None].astype(jnp.int32))  # [R,G,k,E]
+    flat = choice_onehot.transpose(0, 2, 1, 3).reshape(rows, k * g, e)
+    pos_flat = jnp.cumsum(flat, axis=1) - flat                 # [R, k*G, E]
+    pos = pos_flat.reshape(rows, k, g, e).transpose(0, 2, 1, 3)
+    slot = jnp.sum(pos * choice_onehot, axis=-1)               # [R, G, k]
+    keep = slot < cap
+
+    # dispatch [R, G, E, C]: 1 where token (r, g) occupies expert slot
+    disp = (choice_onehot[..., None].astype(h.dtype) *
+            jax.nn.one_hot(slot, cap, dtype=h.dtype)[..., None, :]
+            * keep[..., None, None].astype(h.dtype))           # [R,G,k,E,C]
+    combine = jnp.sum(disp * top_w[..., None, None].astype(h.dtype), axis=2)
+    disp = jnp.sum(disp, axis=2)                               # [R,G,E,C]
+
+    # route tokens to expert buffers; expert dim sharded over `expert`
+    expert_in = jnp.einsum("rgec,rgd->ercd", disp, h)          # [E,R,C,D]
+    expert_in = _constrain(expert_in, P("expert", ("data", "fsdp"),
+                                        None, None))
+    gate = jax.nn.silu(jnp.einsum(
+        "ercd,edf->ercf", expert_in, w_gate.astype(h.dtype)))
+    up = jnp.einsum("ercd,edf->ercf", expert_in, w_up.astype(h.dtype))
+    act = _constrain(gate * up, P("expert", ("data", "fsdp"), None,
+                                  "model"))
+    expert_out = jnp.einsum("ercf,efd->ercd", act,
+                            w_down.astype(h.dtype))            # [E,R,C,D]
+    expert_out = _constrain(expert_out, P("expert", ("data", "fsdp"),
+                                          None, None))
+    out = jnp.einsum("rgec,ercd->rgd", combine, expert_out)
+
+    # aux over REAL tokens only: switch load-balance (fraction routed to
+    # e * mean router prob of e, summed, scaled by E — minimized at
+    # uniform) and z-loss on router logits
+    n_real = jnp.maximum(jnp.sum(v), 1.0)
+    primary = jax.nn.one_hot(top_e[..., 0], e, dtype=jnp.float32)
+    frac = jnp.sum(primary * v[..., None], axis=(0, 1)) / n_real
+    mean_prob = jnp.sum(probs * v[..., None], axis=(0, 1)) / n_real
+    load_balance = e * jnp.sum(frac * mean_prob)
+    router_z = jnp.sum(
+        jax.nn.logsumexp(logits, axis=-1) ** 2 * v) / n_real
+    dropped = 1.0 - jnp.sum(disp) / (k * n_real)
+    return out, MoEAux(load_balance, router_z, dropped)

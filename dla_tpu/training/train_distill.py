@@ -32,6 +32,7 @@ from dla_tpu.data.loaders import build_teacher_dataset
 from dla_tpu.ops.fused_ce import (
     fused_cross_entropy_loss,
     fused_kl_distill_loss,
+    weighted_moe_aux,
 )
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
@@ -56,14 +57,14 @@ def make_distill_loss(student_model, teacher_models: List[Any],
     def loss_fn(params, frozen, batch, rng):
         if lora:
             base = frozen["student_base"]
-            h = student_model.hidden_states(
+            h, moe_aux = student_model.hidden_states_with_aux(
                 base, batch["input_ids"],
                 attention_mask=batch["attention_mask"],
                 lora=params, dropout_rng=rng if train else None)
         else:
             del rng
             base = params
-            h = student_model.hidden_states(
+            h, moe_aux = student_model.hidden_states_with_aux(
                 params, batch["input_ids"],
                 attention_mask=batch["attention_mask"])
         sw, sbias = student_model.unembed_params(base)
@@ -87,6 +88,8 @@ def make_distill_loss(student_model, teacher_models: List[Any],
             loss, _ = fused_cross_entropy_loss(
                 h, sw, batch["labels"], bias=sbias)  # h computed above
             metrics["ce"] = loss
+        # MoE students: router regularization on the with-grad forward
+        loss = loss + weighted_moe_aux(student_model, moe_aux)
         return loss, metrics
     return loss_fn
 

@@ -21,7 +21,10 @@ import jax.numpy as jnp
 
 from dla_tpu.data.iterator import ShardedBatchIterator
 from dla_tpu.data.loaders import build_preference_dataset
-from dla_tpu.ops.fused_ce import model_fused_sequence_logprob
+from dla_tpu.ops.fused_ce import (
+    model_fused_sequence_logprob,
+    weighted_moe_aux,
+)
 from dla_tpu.ops.losses import dpo_loss
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
@@ -39,12 +42,13 @@ from dla_tpu.training.utils import seed_everything
 def make_dpo_loss(policy_model, ref_model, beta: float,
                   label_smoothing: float = 0.0, lora: bool = False,
                   train: bool = True):
-    def seq_logp(model, params, sub, adapters=None, rng=None):
+    def seq_logp(model, params, sub, adapters=None, rng=None,
+                 with_aux=False):
         # fused hidden @ unembed + gather: no [B, T, V] materialization
         # in any of the four forwards (cf. reference train_dpo.py:36)
         return model_fused_sequence_logprob(
             model, params, sub["input_ids"], sub["attention_mask"],
-            lora=adapters, dropout_rng=rng)
+            lora=adapters, dropout_rng=rng, with_aux=with_aux)
 
     def loss_fn(params, frozen, batch, rng):
         if lora:
@@ -55,21 +59,28 @@ def make_dpo_loss(policy_model, ref_model, beta: float,
             base = frozen["base"]
             refp = frozen.get("ref", base)
             drop = rng if train else None
-            pi_c = seq_logp(policy_model, base, batch["chosen"],
-                            adapters=params, rng=drop)
-            pi_r = seq_logp(policy_model, base, batch["rejected"],
-                            adapters=params, rng=drop)
+            pi_c, aux_c = seq_logp(policy_model, base, batch["chosen"],
+                                   adapters=params, rng=drop,
+                                   with_aux=True)
+            pi_r, aux_r = seq_logp(policy_model, base, batch["rejected"],
+                                   adapters=params, rng=drop,
+                                   with_aux=True)
         else:
             del rng
             refp = frozen
-            pi_c = seq_logp(policy_model, params, batch["chosen"])
-            pi_r = seq_logp(policy_model, params, batch["rejected"])
+            pi_c, aux_c = seq_logp(policy_model, params, batch["chosen"],
+                                   with_aux=True)
+            pi_r, aux_r = seq_logp(policy_model, params, batch["rejected"],
+                                   with_aux=True)
         ref_c = jax.lax.stop_gradient(
             seq_logp(ref_model, refp, batch["chosen"]))
         ref_r = jax.lax.stop_gradient(
             seq_logp(ref_model, refp, batch["rejected"]))
         loss, margin = dpo_loss(pi_c, pi_r, ref_c, ref_r,
                                 beta, label_smoothing)
+        # MoE policies: router balance/z regularization on the two
+        # with-grad forwards (0.0 for dense models)
+        loss = loss + weighted_moe_aux(policy_model, aux_c, aux_r)
         return loss, {
             "preference_rate": jnp.mean((margin > 0).astype(jnp.float32)),
             "margin": jnp.mean(margin),

@@ -45,7 +45,10 @@ from dla_tpu.generation.engine import (
     build_generate_fn,
     encode_prompt_batch,
 )
-from dla_tpu.ops.fused_ce import model_fused_sequence_logprob
+from dla_tpu.ops.fused_ce import (
+    model_fused_sequence_logprob,
+    weighted_moe_aux,
+)
 from dla_tpu.ops.losses import ppo_clip_loss, reinforce_loss
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
@@ -74,21 +77,23 @@ def make_policy_gradient_loss(policy_model, algo: str, clip_ratio: float,
         if lora:
             # trainable tree = adapters; the frozen base carries the
             # policy weights (rollouts decode over a merged copy)
-            logp = model_fused_sequence_logprob(
+            logp, moe_aux = model_fused_sequence_logprob(
                 policy_model, frozen["base"],
-                batch["sequences"], batch["sequence_mask"], lora=params)
+                batch["sequences"], batch["sequence_mask"], lora=params,
+                with_aux=True)
         else:
             del frozen
-            logp = model_fused_sequence_logprob(
+            logp, moe_aux = model_fused_sequence_logprob(
                 policy_model, params,
-                batch["sequences"], batch["sequence_mask"])
+                batch["sequences"], batch["sequence_mask"], with_aux=True)
+        aux_loss = weighted_moe_aux(policy_model, moe_aux)
         if algo == "ppo":
             loss, clip_frac = ppo_clip_loss(
                 logp, batch["behavior_logp"], batch["advantages"], clip_ratio)
-            return loss, {"policy_logp": jnp.mean(logp),
-                          "clip_frac": clip_frac}
+            return loss + aux_loss, {"policy_logp": jnp.mean(logp),
+                                     "clip_frac": clip_frac}
         loss = reinforce_loss(logp, batch["advantages"])
-        return loss, {"policy_logp": jnp.mean(logp)}
+        return loss + aux_loss, {"policy_logp": jnp.mean(logp)}
     return loss_fn
 
 

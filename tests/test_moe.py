@@ -1,0 +1,217 @@
+"""Mixture-of-Experts (ops/moe.py + transformer integration): routing
+parity with a per-token dense reference, expert-parallel mesh parity,
+aux-loss plumbing into the fused CE, end-to-end training, and the
+KV-cache decode path. Beyond-reference capability (the reference is
+dense-only, SURVEY.md sec 2.3 EP row) that makes the reserved `expert`
+mesh axis real."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dla_tpu.models.config import ModelConfig, get_model_config
+from dla_tpu.models.transformer import Transformer
+from dla_tpu.ops.fused_ce import model_fused_ce
+from dla_tpu.ops.moe import expert_capacity, moe_mlp
+from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+from dla_tpu.parallel.sharding import sharding_tree
+
+
+def _moe_weights(seed=0, d=6, f=10, e=4):
+    rs = np.random.RandomState(seed)
+    return (jnp.asarray(rs.randn(d, e).astype(np.float32) * 0.1),
+            jnp.asarray(rs.randn(e, d, f).astype(np.float32) * 0.2),
+            jnp.asarray(rs.randn(e, d, f).astype(np.float32) * 0.2),
+            jnp.asarray(rs.randn(e, f, d).astype(np.float32) * 0.2))
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_moe_matches_dense_per_token_reference(k):
+    """With unlimited capacity, routed output == looping over each
+    token's top-k experts with renormalized softmax weights."""
+    rs = np.random.RandomState(1)
+    b, t, d, f, e = 2, 8, 6, 10, 4
+    h = jnp.asarray(rs.randn(b, t, d).astype(np.float32))
+    rw, wg, wu, wd = _moe_weights(d=d, f=f, e=e)
+    got, aux = moe_mlp(h, rw, wg, wu, wd, k=k, capacity_factor=100.0)
+    logits = np.asarray(h @ rw)
+    want = np.zeros((b, t, d), np.float32)
+    for bi in range(b):
+        for ti in range(t):
+            idx = np.argsort(-logits[bi, ti])[:k]
+            w = np.exp(logits[bi, ti][idx] - logits[bi, ti][idx].max())
+            w /= w.sum()
+            for j, ei in enumerate(idx):
+                x = np.asarray(h)[bi, ti]
+                gate = x @ np.asarray(wg)[ei]
+                up = x @ np.asarray(wu)[ei]
+                act = gate / (1 + np.exp(-gate)) * up
+                want[bi, ti] += w[j] * (act @ np.asarray(wd)[ei])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+    assert float(aux.dropped_frac) == 0.0
+
+
+def test_moe_capacity_drops_and_stays_finite():
+    rs = np.random.RandomState(2)
+    h = jnp.asarray(rs.randn(2, 16, 6).astype(np.float32))
+    rw, wg, wu, wd = _moe_weights(seed=3)
+    got, aux = moe_mlp(h, rw, wg, wu, wd, k=2, capacity_factor=0.25)
+    assert np.isfinite(np.asarray(got)).all()
+    assert 0.0 < float(aux.dropped_frac) < 1.0
+    assert expert_capacity(16, 4, 2, 0.25) == 2
+
+
+def test_moe_balance_loss_prefers_uniform():
+    """Balanced routing -> load_balance ~= 1; a router that sends every
+    token to one expert -> ~E."""
+    rs = np.random.RandomState(4)
+    # positive inputs so a single positive router column dominates
+    h = jnp.asarray(np.abs(rs.randn(2, 32, 6)).astype(np.float32) + 0.5)
+    _, wg, wu, wd = _moe_weights(seed=5)
+    spread_rw = jnp.asarray(rs.randn(6, 4).astype(np.float32) * 0.01)
+    _, aux_u = moe_mlp(h, spread_rw, wg, wu, wd, k=1)
+    collapsed_rw = jnp.zeros((6, 4), jnp.float32).at[:, 0].set(10.0)
+    _, aux_c = moe_mlp(h, collapsed_rw, wg, wu, wd, k=1)
+    assert float(aux_c.load_balance) > 3.5  # ~E when fully collapsed
+    assert float(aux_c.load_balance) > float(aux_u.load_balance)
+
+
+def test_moe_expert_parallel_mesh_parity():
+    """expert=2 sharding reproduces the unsharded forward (the dispatch
+    einsums become all-to-alls under GSPMD)."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    model = Transformer(get_model_config("tiny-moe"))
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(5)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    want = model.apply(params, ids)
+    mesh = build_mesh(MeshConfig(data=1, fsdp=2, model=2, sequence=1,
+                                 expert=2))
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_trains_and_aux_regularizes(mesh8):
+    """Fused CE + weighted aux losses: loss falls on random labels, and
+    the router stays un-collapsed (balance loss near 1 after training)."""
+    from dla_tpu.training.trainer import Trainer
+
+    model = Transformer(get_model_config("tiny-moe"))
+    params = model.init(jax.random.key(0))
+
+    def loss_fn(p, frozen, batch, rng):
+        del frozen, rng
+        loss, _ = model_fused_ce(model, p, batch)
+        return loss, {}
+
+    config = {
+        "experiment_name": "moe_train_test",
+        "optimization": {"total_batch_size": 8, "micro_batch_size": 2,
+                         "learning_rate": 5e-3, "max_train_steps": 25,
+                         "lr_scheduler": "constant", "max_grad_norm": 1.0},
+        "logging": {"output_dir": "/tmp/moe_train_test", "log_dir": None},
+        "hardware": {"gradient_accumulation_steps": 1},
+    }
+    rs = np.random.RandomState(0)
+    batch = {"input_ids": rs.randint(1, 100, (8, 16)).astype(np.int32),
+             "attention_mask": np.ones((8, 16), np.int32),
+             "labels": rs.randint(1, 100, (8, 16)).astype(np.int32)}
+    with jax.sharding.set_mesh(mesh8):
+        trainer = Trainer(config=config, mesh=mesh8, loss_fn=loss_fn,
+                          params=params,
+                          param_specs=model.partition_specs())
+        losses = [trainer.step_on_batch(batch, jax.random.key(i))[0]
+                  for i in range(25)]
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+    # router grads flowed (router weights moved from init)
+    moved = float(jnp.sum(jnp.abs(
+        trainer.params["layers"]["router"]
+        - params["layers"]["router"])))
+    assert moved > 0.0
+
+
+def test_moe_decode_matches_forward():
+    """KV-cache decode through the routed MLP == slicing the full
+    forward (same parity contract the dense decode path has). Capacity
+    is raised so nothing drops: token dropping depends on how many other
+    tokens share the batch, so the contract only holds drop-free —
+    exactly why decode uses per-call capacity from its own T."""
+    import dataclasses
+    cfg = dataclasses.replace(get_model_config("tiny-moe"),
+                              moe_capacity_factor=4.0)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(1))
+    rs = np.random.RandomState(6)
+    b, t = 2, 8
+    ids = jnp.asarray(rs.randint(1, 100, (b, t)), jnp.int32)
+    mask = jnp.ones((b, t), jnp.int32)
+    full = model.apply(params, ids, attention_mask=mask)
+
+    logits0, cache = model.start_decode(params, ids[:, :4],
+                                        jnp.ones((b, 4), jnp.int32), t - 4)
+    np.testing.assert_allclose(np.asarray(logits0),
+                               np.asarray(full[:, 3]), rtol=2e-4, atol=2e-4)
+    logits = logits0
+    for s in range(t - 4 - 1):
+        logits, cache = model.decode_step(params, cache, ids[:, 4 + s])
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, 4 + s]),
+            rtol=2e-4, atol=2e-4)
+
+
+def test_moe_pads_never_claim_capacity():
+    """Padding tokens must not evict real tokens from expert slots or
+    enter the router statistics: a row of real tokens routes identically
+    whether or not pads share the batch row."""
+    rs = np.random.RandomState(7)
+    d, f, e = 6, 10, 4
+    rw, wg, wu, wd = _moe_weights(seed=8, d=d, f=f, e=e)
+    real = rs.randn(1, 8, d).astype(np.float32)
+    # tight capacity so eviction WOULD happen if pads took slots
+    out_alone, aux_alone = moe_mlp(
+        jnp.asarray(real), rw, wg, wu, wd, k=2, capacity_factor=1.0)
+    padded = np.concatenate([real, np.tile(real[:, :1], (1, 8, 1))], axis=1)
+    valid = jnp.asarray(np.concatenate(
+        [np.ones((1, 8), np.int32), np.zeros((1, 8), np.int32)], axis=1))
+    # group_size=8 makes the real tokens their own group with the SAME
+    # per-group capacity as the alone run; the pad group claims nothing
+    out_padded, aux_padded = moe_mlp(
+        jnp.asarray(padded), rw, wg, wu, wd, k=2, capacity_factor=1.0,
+        valid=valid, group_size=8)
+    np.testing.assert_allclose(np.asarray(out_padded)[:, :8],
+                               np.asarray(out_alone), rtol=1e-4, atol=1e-5)
+    # stats computed over real tokens only
+    np.testing.assert_allclose(float(aux_padded.load_balance),
+                               float(aux_alone.load_balance), rtol=1e-5)
+
+
+def test_moe_grouping_is_o_t():
+    """Token grouping bounds the dispatch tensor: per-group capacity at
+    T=64/group=16 equals the T=16 capacity, and parity holds with the
+    ungrouped computation when nothing drops."""
+    rs = np.random.RandomState(9)
+    h = jnp.asarray(rs.randn(1, 64, 6).astype(np.float32))
+    rw, wg, wu, wd = _moe_weights(seed=10)
+    grouped, _ = moe_mlp(h, rw, wg, wu, wd, k=2, capacity_factor=50.0,
+                         group_size=16)
+    ungrouped, _ = moe_mlp(h, rw, wg, wu, wd, k=2, capacity_factor=50.0,
+                           group_size=64)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(ungrouped),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_config_guards():
+    with pytest.raises(ValueError, match="llama block only"):
+        ModelConfig(vocab_size=8, hidden_size=8, intermediate_size=8,
+                    num_layers=1, num_heads=1, num_kv_heads=1,
+                    arch="phi", num_experts=2)
+    with pytest.raises(ValueError, match="attention projections"):
+        ModelConfig(vocab_size=8, hidden_size=8, intermediate_size=8,
+                    num_layers=1, num_heads=1, num_kv_heads=1,
+                    num_experts=2, lora_r=4,
+                    lora_targets=("wq", "w_gate"))
