@@ -179,9 +179,9 @@ register_model("phi-2", ModelConfig(
     num_layers=32, num_heads=32, num_kv_heads=32, max_seq_length=2048,
     arch="phi", rotary_pct=0.4, rms_norm_eps=1e-5))
 # mixtral 8x7B (MoE): 8 experts, top-2 routing — beyond-reference
-# capability exercising the `expert` mesh axis. Weight import from HF
-# mixtral checkpoints is not wired yet (block_sparse_moe key mapping);
-# the preset initializes from scratch.
+# capability exercising the `expert` mesh axis. HF mixtral checkpoints
+# import via models/hf_import (block_sparse_moe mapping,
+# logits-parity-tested against transformers).
 register_model("mixtral-8x7b", ModelConfig(
     vocab_size=32000, hidden_size=4096, intermediate_size=14336,
     num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1e6,

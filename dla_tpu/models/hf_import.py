@@ -46,6 +46,10 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
         attention_bias=bool(hf_cfg.get("attention_bias",
                                        model_type == "qwen2")),
     )
+    if model_type == "mixtral" or "num_local_experts" in hf_cfg:
+        fields["num_experts"] = int(hf_cfg.get("num_local_experts", 8))
+        fields["num_experts_per_token"] = int(
+            hf_cfg.get("num_experts_per_tok", 2))
     fields.update(overrides)
     return ModelConfig(**fields)
 
@@ -126,9 +130,12 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
         return _import_phi(sd, cfg, pdtype, take, linear)
 
     L = cfg.num_layers
+    moe = cfg.num_experts > 0
     stacked: Dict[str, list] = {k: [] for k in (
         "attn_norm", "wq", "wk", "wv", "wo",
         "mlp_norm", "w_gate", "w_up", "w_down")}
+    if moe:
+        stacked["router"] = []
     if cfg.attention_bias:
         for k in ("wq_bias", "wk_bias", "wv_bias"):
             stacked[k] = []
@@ -148,9 +155,25 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
         stacked["wo"].append(linear(p + "self_attn.o_proj.weight"))
         stacked["mlp_norm"].append(
             take(p + "post_attention_layernorm.weight").astype(pdtype))
-        stacked["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
-        stacked["w_up"].append(linear(p + "mlp.up_proj.weight"))
-        stacked["w_down"].append(linear(p + "mlp.down_proj.weight"))
+        if moe:
+            # Mixtral MoE layout: block_sparse_moe.gate -> router,
+            # experts.j.{w1,w3,w2} -> per-expert gate/up/down, stacked
+            # along a leading [E] dim
+            m = p + "block_sparse_moe."
+            stacked["router"].append(linear(m + "gate.weight"))
+            stacked["w_gate"].append(np.stack(
+                [linear(m + f"experts.{j}.w1.weight")
+                 for j in range(cfg.num_experts)]))
+            stacked["w_up"].append(np.stack(
+                [linear(m + f"experts.{j}.w3.weight")
+                 for j in range(cfg.num_experts)]))
+            stacked["w_down"].append(np.stack(
+                [linear(m + f"experts.{j}.w2.weight")
+                 for j in range(cfg.num_experts)]))
+        else:
+            stacked["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
+            stacked["w_up"].append(linear(p + "mlp.up_proj.weight"))
+            stacked["w_down"].append(linear(p + "mlp.down_proj.weight"))
 
     params: Dict[str, Any] = {
         "embed": {"embedding": take("embed_tokens.weight").astype(pdtype)},

@@ -71,14 +71,19 @@ class RewardModel:
     def apply(self, params: Params, input_ids: jnp.ndarray,
               attention_mask: jnp.ndarray,
               dropout_rng: Optional[jax.Array] = None,
-              lora: Optional[Params] = None) -> jnp.ndarray:
+              lora: Optional[Params] = None,
+              with_aux: bool = False):
         """[B, T] -> [B] scalar rewards (fp32). ``dropout_rng`` drives
-        both the pooled-feature dropout and (split) LoRA dropout."""
+        both the pooled-feature dropout and (split) LoRA dropout.
+        ``with_aux`` additionally returns the backbone's MoE aux tuple
+        (None for dense backbones) so the pairwise-loss trainer can
+        regularize the router."""
         lora_rng = None
         if dropout_rng is not None and lora is not None:
             dropout_rng, lora_rng = jax.random.split(dropout_rng)
-        h = self.backbone.hidden_states(params, input_ids, attention_mask,
-                                        lora=lora, dropout_rng=lora_rng)
+        h, moe_aux = self.backbone.hidden_states_with_aux(
+            params, input_ids, attention_mask,
+            lora=lora, dropout_rng=lora_rng)
         mask = attention_mask.astype(jnp.float32)
         if self.pooling == "last_token":
             idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
@@ -92,7 +97,8 @@ class RewardModel:
                 dropout_rng, 1.0 - self.dropout, pooled.shape)
             pooled = jnp.where(keep, pooled / (1.0 - self.dropout), 0.0)
         head = params["reward_head"]
-        return (pooled @ head["w"].astype(jnp.float32)
-                + head["b"].astype(jnp.float32))[:, 0]
+        rewards = (pooled @ head["w"].astype(jnp.float32)
+                   + head["b"].astype(jnp.float32))[:, 0]
+        return (rewards, moe_aux) if with_aux else rewards
 
     __call__ = apply

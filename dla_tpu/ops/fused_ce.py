@@ -139,11 +139,7 @@ def model_fused_ce(model, params, batch, lora=None, dropout_rng=None,
     w, bias = model.unembed_params(params)
     loss, n = fused_cross_entropy_loss(h, w, batch["labels"], bias=bias,
                                        chunk=chunk)
-    if moe_aux is not None:
-        loss = (loss
-                + model.cfg.moe_aux_weight * moe_aux.load_balance
-                + model.cfg.moe_z_weight * moe_aux.router_z)
-    return loss, n
+    return loss + weighted_moe_aux(model, moe_aux), n
 
 
 def weighted_moe_aux(model, *auxes):

@@ -67,7 +67,7 @@ def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
                 "context_parallel", "arch", "rotary_pct", "attention_bias",
                 "pipeline_microbatches", "num_experts",
                 "num_experts_per_token", "moe_capacity_factor",
-                "moe_aux_weight", "moe_z_weight"):
+                "moe_group_size", "moe_aux_weight", "moe_z_weight"):
         if key in model_cfg:
             out[key] = model_cfg[key]
     # reference model.lora block (config/distill_config.yaml:10-14; dead

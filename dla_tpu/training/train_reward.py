@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from dla_tpu.data.iterator import ShardedBatchIterator
 from dla_tpu.data.loaders import build_preference_dataset
+from dla_tpu.ops.fused_ce import weighted_moe_aux
 from dla_tpu.ops.losses import pairwise_reward_loss
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
@@ -44,15 +45,17 @@ def make_reward_loss(model, lora: bool = False):
             del frozen
             full, adapters = params, None
         drng = jax.random.split(rng, 2)
-        chosen = model.apply(
+        chosen, aux_c = model.apply(
             full, batch["chosen"]["input_ids"],
             batch["chosen"]["attention_mask"], dropout_rng=drng[0],
-            lora=adapters)
-        rejected = model.apply(
+            lora=adapters, with_aux=True)
+        rejected, aux_r = model.apply(
             full, batch["rejected"]["input_ids"],
             batch["rejected"]["attention_mask"], dropout_rng=drng[1],
-            lora=adapters)
+            lora=adapters, with_aux=True)
         loss = pairwise_reward_loss(chosen, rejected)
+        # MoE backbones: router regularization on both with-grad forwards
+        loss = loss + weighted_moe_aux(model, aux_c, aux_r)
         acc = jnp.mean((chosen > rejected).astype(jnp.float32))
         return loss, {"acc": acc,
                       "reward_margin": jnp.mean(chosen - rejected)}
