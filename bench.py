@@ -95,15 +95,21 @@ def run_bench() -> dict:
 
     on_accel = jax.devices()[0].platform != "cpu"
     if on_accel:
-        # ~460M-param Llama-style model: big enough to exercise the MXU,
-        # small enough that params + fp32 Adam state fit one v5e chip.
-        # Measured-fastest single-chip configuration (round-3 sweep):
-        # Pallas flash attention (512-blocks), remat="dots", micro=8,
-        # fused chunked CE — 19.3k tok/s vs 14.3k for the round-2
-        # xla-attention/full-remat/micro-4 setup.
+        # ~350M-param Mistral-style decoder (GQA 8q/4kv like Mistral-7B's
+        # 32q/8kv ratio, head_dim 128): big enough to exercise the MXU,
+        # small enough that params + Adam state fit one v5e chip.
+        # Measured-fastest single-chip configuration (round-3 on-chip
+        # sweep, tools/sweep_bench.py): Pallas flash attention
+        # (512-blocks), remat="dots", micro=8, fused chunked CE, bf16
+        # Adam first moment — 31.7k tok/s (33.7% MFU, 1.05x the
+        # H100-normalized bar). head_dim 64 -> 128 was the big rock: it
+        # fills the MXU's 128-deep contraction in the attention kernel
+        # AND stops the saved flash activations from 2x lane-padding
+        # ([.,.,.,64] tiles pad to 128 — round-2's hd-64 config OOMed
+        # at micro=8 for exactly that reason, BENCH r3 logs).
         cfg = ModelConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_layers=24, num_heads=16, num_kv_heads=16,
+            num_layers=24, num_heads=8, num_kv_heads=4,
             max_seq_length=2048, remat="dots", attention="flash")
         try:
             micro = int(os.environ.get("DLA_BENCH_MICRO", "8"))
@@ -140,6 +146,8 @@ def run_bench() -> dict:
             "micro_batch_size": micro, "learning_rate": 1e-4,
             "max_train_steps": steps, "lr_scheduler": "constant",
             "max_grad_norm": 1.0,
+            # bf16 first moment frees ~0.7G for the micro=8 batch
+            "adam_moment_dtype": "bfloat16",
         },
         "logging": {"output_dir": "/tmp/dla_bench_ckpt", "log_dir": None},
         "hardware": {"gradient_accumulation_steps": 1},
@@ -374,6 +382,11 @@ def _relay_child(mode: str, timeout_s: float) -> tuple:
         return None, "failed"
     sys.stderr.write(stderr or "")
     result = _extract_json_line(stdout)
+    if result is not None and result.get("error"):
+        # a child line carrying an error is a failure, not a measurement
+        print(f"[bench] {mode} child line carries error: "
+              f"{result['error'][:200]}", file=sys.stderr)
+        return None, "failed"
     if result is not None:
         return result, "ok"
     print(f"[bench] {mode} child emitted no JSON line (rc={rc})",
@@ -462,6 +475,15 @@ if __name__ == "__main__":
     except SystemExit:
         raise
     except Exception as e:  # absolute backstop: never exit without the line
+        if os.environ.get("DLA_BENCH_PLATFORM"):
+            # Child process: an exception here is an OOM-class failure the
+            # PARENT must see as rc!=0 so its ladder retries a smaller
+            # config. Emitting the 0.0 line from the child instead would
+            # hand the parent a "valid" result and freeze the ladder on
+            # the first rung (observed: micro=8 HBM OOM reported as 0.0).
+            print(f"[bench] child crashed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            sys.exit(2)
         print(json.dumps({
             "metric": "sft_tokens_per_sec_per_chip", "value": 0.0,
             "unit": "tok/s/chip", "vs_baseline": 0.0,

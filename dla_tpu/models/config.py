@@ -36,6 +36,13 @@ class ModelConfig:
     # biases on the q/k/v projections within the llama block layout —
     # the qwen2 family (phi carries biases on every projection already)
     attention_bias: bool = False
+    # mistral-style sliding-window attention (HF ``sliding_window``):
+    # each token attends kv positions in (pos - window, pos]. None/0 =
+    # full causal. Applies to every attention path (flash, xla, decode);
+    # incompatible with an active sequence mesh axis — Transformer.__init__
+    # raises when both are set under the ambient mesh (the mesh isn't
+    # known here, and context_parallel is a harmless default otherwise).
+    sliding_window: Optional[int] = None
     # numerics
     dtype: str = "bfloat16"             # activation dtype
     param_dtype: str = "float32"        # master param dtype
@@ -166,7 +173,8 @@ register_model("llama2-70b", ModelConfig(
     num_layers=80, num_heads=64, num_kv_heads=8, max_seq_length=4096))
 register_model("mistral-7b", ModelConfig(
     vocab_size=32000, hidden_size=4096, intermediate_size=14336,
-    num_layers=32, num_heads=32, num_kv_heads=8, max_seq_length=8192))
+    num_layers=32, num_heads=32, num_kv_heads=8, max_seq_length=8192,
+    sliding_window=4096))  # HF config.json sliding_window (mistral v0.1)
 register_model("qwen2-7b", ModelConfig(
     vocab_size=152064, hidden_size=3584, intermediate_size=18944,
     num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1e6,

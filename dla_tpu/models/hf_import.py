@@ -50,6 +50,11 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
         fields["num_experts"] = int(hf_cfg.get("num_local_experts", 8))
         fields["num_experts_per_token"] = int(
             hf_cfg.get("num_experts_per_tok", 2))
+    # mistral sliding-window attention; qwen2 ships sliding_window with
+    # use_sliding_window: false, which must stay full-causal
+    sw = hf_cfg.get("sliding_window")
+    if sw and hf_cfg.get("use_sliding_window", True):
+        fields["sliding_window"] = int(sw)
     fields.update(overrides)
     return ModelConfig(**fields)
 

@@ -105,6 +105,16 @@ class Transformer:
         self.cfg = cfg
         self.adtype = jnp.dtype(cfg.dtype)
         self.pdtype = jnp.dtype(cfg.param_dtype)
+        if (cfg.sliding_window and cfg.context_parallel != "none"
+                and _sequence_axis_size() > 1):
+            # fail at model construction (trainers build models under the
+            # ambient mesh, before checkpoint load or compile), not at the
+            # first jit trace deep in _attention
+            raise NotImplementedError(
+                "sliding-window attention is not supported under context "
+                "parallelism (ring/ulysses shard the kv rotation on "
+                "full-causal assumptions); unset model.sliding_window or "
+                "the sequence mesh axis")
 
     # ------------------------------------------------------------------ init
 
@@ -453,6 +463,12 @@ class Transformer:
         ulysses context-parallel."""
         t, s = q.shape[1], k.shape[1]
         if cp is not None:
+            if self.cfg.sliding_window:
+                raise NotImplementedError(
+                    "sliding-window attention is not supported under "
+                    "context parallelism (ring/ulysses shard the kv "
+                    "rotation on full-causal assumptions); unset "
+                    "model.sliding_window or the sequence mesh axis")
             mode, kv_valid, seg = cp
             if mode == "ulysses":
                 from dla_tpu.ops.ulysses import ulysses_causal_attention
@@ -471,7 +487,8 @@ class Transformer:
             return self._flash(q, k, v, flash_segs)
         return causal_attention(
             q, k, v, kv_segment_mask=kv_segment_mask,
-            q_positions=q_positions, kv_positions=kv_positions)
+            q_positions=q_positions, kv_positions=kv_positions,
+            window=self.cfg.sliding_window or None)
 
     def _flash(self, q, k, v, segs: Optional[Tuple]):
         """Invoke the pallas flash kernel, shard_map-wrapped when the
@@ -482,9 +499,10 @@ class Transformer:
         num_kv_heads in any valid TP layout. ``segs`` is the
         pre-broadcast (qseg, kseg) pair from broadcast_segment_ids."""
         from dla_tpu.ops.flash_attention import flash_causal_attention
+        win = self.cfg.sliding_window or None
         mesh = _flash_mesh()
         if mesh is None:
-            return flash_causal_attention(q, k, v, segs=segs)
+            return flash_causal_attention(q, k, v, segs=segs, window=win)
         model_size = mesh.shape.get("model", 1)
         batch_shards = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
         if (q.shape[0] % batch_shards or self.cfg.num_heads % model_size
@@ -493,17 +511,18 @@ class Transformer:
             # eval batch, B < dp shards in a rollout) take the bare
             # pallas_call, which GSPMD runs replicated — correct, just not
             # partitioned. Training batches are always divisible.
-            return flash_causal_attention(q, k, v, segs=segs)
+            return flash_causal_attention(q, k, v, segs=segs, window=win)
         bspec = P(("data", "fsdp"), None, "model", None)
         if segs is None:
             fn = jax.shard_map(
-                lambda a, b, c: flash_causal_attention(a, b, c),
+                lambda a, b, c: flash_causal_attention(a, b, c, window=win),
                 mesh=mesh, in_specs=(bspec, bspec, bspec),
                 out_specs=bspec, check_vma=False)
             return fn(q, k, v)
         sspec = P(("data", "fsdp"), None, None)
         fn = jax.shard_map(
-            lambda a, b, c, s: flash_causal_attention(a, b, c, segs=s),
+            lambda a, b, c, s: flash_causal_attention(a, b, c, segs=s,
+                                                      window=win),
             mesh=mesh,
             in_specs=(bspec, bspec, bspec, (sspec, sspec)),
             out_specs=bspec, check_vma=False)
@@ -926,7 +945,8 @@ class Transformer:
             attn = causal_attention(
                 q, k_cache, v_cache,
                 kv_segment_mask=kv_mask_next[:, None, :],
-                q_positions=positions, kv_positions=kv_pos_next)
+                q_positions=positions, kv_positions=kv_pos_next,
+                window=cfg.sliding_window or None)
             attn = attn.reshape(b, 1, cfg.num_heads * dh)
             if cfg.arch == "phi":
                 ff = jax.nn.gelu(proj("fc1", hn), approximate=True)

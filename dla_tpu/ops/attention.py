@@ -30,12 +30,16 @@ def causal_attention(
     kv_positions: Optional[jnp.ndarray] = None,  # [B, S]
     causal: bool = True,
     softmax_scale: Optional[float] = None,
+    window: Optional[int] = None,  # sliding window: attend (q-window, q]
 ) -> jnp.ndarray:
     """Grouped-query causal attention. Returns [B, T, H, D].
 
     Causality is evaluated on absolute positions so the same op serves
     full-sequence training (q_positions == kv_positions == arange) and
     single-token decode against a KV cache (q_positions = current step).
+    ``window`` adds mistral-style sliding-window attention (HF
+    ``sliding_window``): token q attends only kv positions in
+    (q - window, q]. Position-based, so it is decode-correct too.
     """
     b, t, h, d = q.shape
     _, s, kheads, _ = k.shape
@@ -47,12 +51,16 @@ def causal_attention(
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
 
     mask = None
-    if causal:
+    if causal or window is not None:
         if q_positions is None:
             q_positions = jnp.arange(t)[None, :]
         if kv_positions is None:
             kv_positions = jnp.arange(s)[None, :]
-        mask = q_positions[:, :, None] >= kv_positions[:, None, :]  # [B, T, S]
+        delta = q_positions[:, :, None] - kv_positions[:, None, :]  # [B,T,S]
+        mask = delta >= 0 if causal else None
+        if window is not None:
+            win = delta < window
+            mask = win if mask is None else (mask & win)
     if kv_segment_mask is not None:
         seg = kv_segment_mask.astype(bool)
         mask = seg if mask is None else (mask & seg)

@@ -83,15 +83,20 @@ def _fit_block(n: int, pref: int) -> int:
         f"flash attention needs sequence length % 128 == 0 on TPU, got {n}")
 
 
-def _tile_mask(q_start, k_start, block_q, block_k, qseg_ref, kseg_ref):
+def _tile_mask(q_start, k_start, block_q, block_k, qseg_ref, kseg_ref,
+               window: Optional[int] = None):
     """[bq, bk] validity: causal by global index, AND same segment when
     segment refs are present (qseg tile [bq, bk] lane-replicated, kseg
-    row [1, bk] — broadcasting the row across sublanes is cheap)."""
+    row [1, bk] — broadcasting the row across sublanes is cheap), AND
+    within the sliding window when one is set (q attends (q-window, q],
+    mistral semantics)."""
     q_pos = q_start + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = k_start + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     mask = q_pos >= k_pos
+    if window is not None:
+        mask = mask & (q_pos - k_pos < window)
     if qseg_ref is not None:
         qs = qseg_ref[0]          # [bq, bk]
         ks = kseg_ref[0, 0:1]     # [1, bk]
@@ -99,8 +104,21 @@ def _tile_mask(q_start, k_start, block_q, block_k, qseg_ref, kseg_ref):
     return mask
 
 
+def _block_live(q_start, k_start, block_q: int, block_k: int,
+                window: Optional[int]):
+    """Whether a (q block, kv block) pair has any unmasked entry: the kv
+    block must not sit entirely above the causal diagonal, nor (when a
+    sliding window is set) entirely out of the window — the closest pair
+    is (q_start, k_start + block_k - 1), live iff its distance is
+    < window."""
+    live = k_start <= q_start + block_q - 1
+    if window is not None:
+        live = live & (q_start - k_start - block_k + 1 < window)
+    return live
+
+
 def _flash_kernel(*refs, scale: float, block_q: int, block_k: int,
-                  has_segments: bool):
+                  has_segments: bool, window: Optional[int]):
     if has_segments:
         (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
          m_scratch, l_scratch, acc_scratch) = refs
@@ -120,8 +138,9 @@ def _flash_kernel(*refs, scale: float, block_q: int, block_k: int,
 
     q_start = iq * block_q
     k_start = ik * block_k
-    # skip kv blocks entirely above the causal diagonal
-    @pl.when(k_start <= q_start + block_q - 1)
+    # skip kv blocks entirely above the causal diagonal, or (with a
+    # sliding window) entirely below it
+    @pl.when(_block_live(q_start, k_start, block_q, block_k, window))
     def _compute():
         # dots stay in the input dtype (bf16 on the training path) with
         # fp32 accumulation: casting operands to fp32 first would push
@@ -135,7 +154,7 @@ def _flash_kernel(*refs, scale: float, block_q: int, block_k: int,
             preferred_element_type=jnp.float32) * scale   # [bq, bk] fp32
 
         mask = _tile_mask(q_start, k_start, block_q, block_k,
-                          qseg_ref, kseg_ref)
+                          qseg_ref, kseg_ref, window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scratch[:]                         # [bq, 1]
@@ -170,7 +189,7 @@ def _seg_specs(bq: int, bk: int, q_index_map, kv_index_map):
 
 def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    segs, scale: float, block_q: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, window: Optional[int] = None):
     """q [B, H, T, D], k/v [B, KH, S, D] -> (out [B, H, T, D],
     lse [B, H, T, 1] log-sum-exp of each score row, for the backward;
     trailing singleton keeps the block 2-D for mosaic's tiling rules).
@@ -185,7 +204,7 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     kernel = functools.partial(
         _flash_kernel, scale=scale, block_q=bq, block_k=bk,
-        has_segments=segs is not None)
+        has_segments=segs is not None, window=window)
     in_specs = [
         pl.BlockSpec((1, 1, bq, d),
                      lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -231,7 +250,7 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def _flash_bwd_dq_kernel(*refs, scale: float, block_q: int, block_k: int,
-                         has_segments: bool):
+                         has_segments: bool, window: Optional[int]):
     if has_segments:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          qseg_ref, kseg_ref, dq_ref, dq_scratch) = refs
@@ -250,7 +269,7 @@ def _flash_bwd_dq_kernel(*refs, scale: float, block_q: int, block_k: int,
     q_start = iq * block_q
     k_start = ik * block_k
 
-    @pl.when(k_start <= q_start + block_q - 1)
+    @pl.when(_block_live(q_start, k_start, block_q, block_k, window))
     def _compute():
         q = q_ref[0, 0]                              # [bq, D]
         k = k_ref[0, 0]                              # [bk, D]
@@ -263,7 +282,7 @@ def _flash_bwd_dq_kernel(*refs, scale: float, block_q: int, block_k: int,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [bq, bk]
         mask = _tile_mask(q_start, k_start, block_q, block_k,
-                          qseg_ref, kseg_ref)
+                          qseg_ref, kseg_ref, window)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -279,7 +298,8 @@ def _flash_bwd_dq_kernel(*refs, scale: float, block_q: int, block_k: int,
 
 
 def _flash_bwd_dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
-                          n_q_blocks: int, has_segments: bool):
+                          n_q_blocks: int, has_segments: bool,
+                          window: Optional[int]):
     # innermost (sequential) axis runs the GQA group members x q blocks:
     # j = gi * n_q_blocks + qi. dK/dV accumulate per *kv* head in VMEM
     # across the whole group, so no [B, H, S, D] per-query-head buffers
@@ -304,7 +324,7 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
     q_start = iq * block_q
     k_start = ik * block_k
 
-    @pl.when(q_start + block_q - 1 >= k_start)
+    @pl.when(_block_live(q_start, k_start, block_q, block_k, window))
     def _compute():
         q = q_ref[0, 0]                              # [bq, D]
         k = k_ref[0, 0]                              # [bk, D]
@@ -317,7 +337,7 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [bq, bk]
         mask = _tile_mask(q_start, k_start, block_q, block_k,
-                          qseg_ref, kseg_ref)
+                          qseg_ref, kseg_ref, window)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
 
         dv_scratch[:] += jax.lax.dot_general(
@@ -338,7 +358,7 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
 
 
 def _flash_backward(q, k, v, segs, out, lse, do, scale, block_q, block_k,
-                    interpret):
+                    interpret, window: Optional[int] = None):
     """Blockwise backward. Returns (dq [B,H,T,D], dk, dv [B,KH,S,D])."""
     b, h, t, d = q.shape
     _, kh, s, _ = k.shape
@@ -351,7 +371,7 @@ def _flash_backward(q, k, v, segs, out, lse, do, scale, block_q, block_k,
 
     kq = functools.partial(_flash_bwd_dq_kernel, scale=scale,
                            block_q=bq, block_k=bk,
-                           has_segments=has_segments)
+                           has_segments=has_segments, window=window)
     dq_in_specs = [
         pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         pl.BlockSpec((1, 1, bk, d),
@@ -388,7 +408,7 @@ def _flash_backward(q, k, v, segs, out, lse, do, scale, block_q, block_k,
     nq = t // bq
     kkv = functools.partial(_flash_bwd_dkv_kernel, scale=scale,
                             block_q=bq, block_k=bk, n_q_blocks=nq,
-                            has_segments=has_segments)
+                            has_segments=has_segments, window=window)
     # grid is over *kv* heads; the sequential axis walks every (group
     # member, q block) pair, accumulating dK/dV for the kv head in VMEM.
     # Query-head tensors (q, do, lse, delta) index with
@@ -433,10 +453,11 @@ def _flash_backward(q, k, v, segs, out, lse, do, scale, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention_core(q, k, v, segs, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_core(q, k, v, segs, scale, block_q, block_k, interpret,
+                          window):
     return _flash_forward(q, k, v, segs, scale, block_q, block_k,
-                          interpret)[0]
+                          interpret, window)[0]
 
 
 def _xla_reference(q, k, v, scale):
@@ -447,9 +468,9 @@ def _xla_reference(q, k, v, scale):
     return out.transpose(0, 2, 1, 3)
 
 
-def _core_fwd(q, k, v, segs, scale, block_q, block_k, interpret):
+def _core_fwd(q, k, v, segs, scale, block_q, block_k, interpret, window):
     out, lse = _flash_forward(q, k, v, segs, scale, block_q, block_k,
-                              interpret)
+                              interpret, window)
     # Name the backward's residuals so a remat policy can SAVE them:
     # without this, jax.checkpoint replays the whole pallas forward just
     # to regenerate (out, lse) before the backward kernels run — at
@@ -461,10 +482,10 @@ def _core_fwd(q, k, v, segs, scale, block_q, block_k, interpret):
     return out, (q, k, v, segs, out, lse)
 
 
-def _core_bwd(scale, block_q, block_k, interpret, res, g):
+def _core_bwd(scale, block_q, block_k, interpret, window, res, g):
     q, k, v, segs, out, lse = res
     dq, dk, dv = _flash_backward(q, k, v, segs, out, lse, g, scale,
-                                 block_q, block_k, interpret)
+                                 block_q, block_k, interpret, window)
     return dq, dk, dv, None  # int segment ids carry no gradient
 
 
@@ -504,6 +525,7 @@ def flash_causal_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,   # sliding window (mistral): (q-w, q]
 ) -> jnp.ndarray:
     """Drop-in for ops.attention.causal_attention on contiguous right-padded
     sequences (same [B, T, H, D] layout). GQA supported. With
@@ -518,7 +540,10 @@ def flash_causal_attention(
         interpret = jax.devices()[0].platform == "cpu"
     if segs is None and segment_ids is not None:
         segs = broadcast_segment_ids(segment_ids, kv_segment_ids, block_k)
+    if window is not None and window <= 0:
+        raise ValueError(f"sliding window must be positive, got {window}")
     out = _flash_attention_core(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3), segs, scale, block_q, block_k, interpret)
+        v.transpose(0, 2, 1, 3), segs, scale, block_q, block_k, interpret,
+        window)
     return out.transpose(0, 2, 1, 3)

@@ -65,7 +65,7 @@ def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
                             else "xla")
     for key in ("dtype", "param_dtype", "remat", "vocab_size", "attention",
                 "context_parallel", "arch", "rotary_pct", "attention_bias",
-                "pipeline_microbatches", "num_experts",
+                "sliding_window", "pipeline_microbatches", "num_experts",
                 "num_experts_per_token", "moe_capacity_factor",
                 "moe_group_size", "moe_aux_weight", "moe_z_weight"):
         if key in model_cfg:
