@@ -216,7 +216,7 @@ def run_ppo_bench() -> dict:
     if on_accel:
         cfg = ModelConfig(
             vocab_size=32000, hidden_size=768, intermediate_size=2048,
-            num_layers=12, num_heads=12, num_kv_heads=12,
+            num_layers=12, num_heads=6, num_kv_heads=3,
             max_seq_length=512, remat="dots", attention="flash")
         batch, prompt_w, new_tokens, rollouts, warmup = 32, 128, 128, 3, 1
     else:
@@ -308,7 +308,7 @@ def run_decode_bench() -> dict:
     if on_accel:
         cfg = ModelConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_layers=24, num_heads=16, num_kv_heads=16,
+            num_layers=24, num_heads=8, num_kv_heads=4,
             max_seq_length=2048, attention="flash", remat="none")
         b, prompt, new = 8, 128, 256
     else:

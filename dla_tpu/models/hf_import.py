@@ -54,6 +54,16 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
     # use_sliding_window: false, which must stay full-causal
     sw = hf_cfg.get("sliding_window")
     if sw and hf_cfg.get("use_sliding_window", True):
+        # qwen2's partial scheme (sliding window on the first
+        # max_window_layers only) is per-layer; this architecture applies
+        # the window globally — refuse rather than silently mis-import
+        # the full-attention tail layers
+        mwl = hf_cfg.get("max_window_layers")
+        if mwl is not None and int(mwl) < int(hf_cfg["num_hidden_layers"]):
+            raise ValueError(
+                f"partial sliding-window scheme (max_window_layers={mwl} "
+                f"< num_hidden_layers={hf_cfg['num_hidden_layers']}) is "
+                "not supported; sliding_window here is all-layers")
         fields["sliding_window"] = int(sw)
     fields.update(overrides)
     return ModelConfig(**fields)

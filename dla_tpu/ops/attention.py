@@ -50,6 +50,10 @@ def causal_attention(
     # scores [B, K, G, T, S]
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
 
+    if window is not None and not causal:
+        raise ValueError("window implements causal sliding-window "
+                         "semantics (q - window, q]; causal=False with a "
+                         "window would silently attend the whole future")
     mask = None
     if causal or window is not None:
         if q_positions is None:

@@ -57,3 +57,39 @@ def test_load_causal_lm_resolves_hf_dir(tiny_hf_dir):
         jax.random.key(0))
     assert bundle.config.vocab_size == 128
     assert bundle.params["layers"]["wq"].shape == (2, 32, 32)
+
+
+def test_hf_config_sliding_window_mapping():
+    """mistral's sliding_window maps through; qwen2-style configs that
+    ship the key with use_sliding_window: false stay full-causal."""
+    from dla_tpu.models.hf_import import hf_config_to_model_config
+
+    base = dict(model_type="mistral", vocab_size=128, hidden_size=32,
+                intermediate_size=64, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2)
+    assert hf_config_to_model_config(
+        {**base, "sliding_window": 4096}).sliding_window == 4096
+    assert hf_config_to_model_config(base).sliding_window is None
+    assert hf_config_to_model_config(
+        {**base, "model_type": "qwen2", "sliding_window": 131072,
+         "use_sliding_window": False}).sliding_window is None
+    assert hf_config_to_model_config(
+        {**base, "sliding_window": None}).sliding_window is None
+
+
+def test_hf_config_partial_sliding_window_rejected():
+    """qwen2's partial scheme (window on first max_window_layers only)
+    cannot be represented by the global sliding_window — refuse loudly."""
+    import pytest
+    from dla_tpu.models.hf_import import hf_config_to_model_config
+
+    cfg = dict(model_type="qwen2", vocab_size=128, hidden_size=32,
+               intermediate_size=64, num_hidden_layers=28,
+               num_attention_heads=4, num_key_value_heads=2,
+               sliding_window=4096, use_sliding_window=True,
+               max_window_layers=21)
+    with pytest.raises(ValueError, match="max_window_layers"):
+        hf_config_to_model_config(cfg)
+    # all-layers window (max_window_layers >= num_hidden_layers) is fine
+    cfg["max_window_layers"] = 28
+    assert hf_config_to_model_config(cfg).sliding_window == 4096

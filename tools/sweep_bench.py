@@ -30,7 +30,7 @@ def run_variant(name: str, *, hidden=1024, inter=2816, layers=24, heads=16,
     from dla_tpu.ops.fused_ce import model_fused_ce
     from dla_tpu.parallel.mesh import MeshConfig, build_mesh
     from dla_tpu.training.trainer import Trainer
-    from bench import count_params, peak_flops
+    from bench import BASELINE_MFU, count_params, peak_flops
 
     cfg = ModelConfig(
         vocab_size=32000, hidden_size=hidden, intermediate_size=inter,
@@ -84,7 +84,7 @@ def run_variant(name: str, *, hidden=1024, inter=2816, layers=24, heads=16,
     mfu = tok_s * 6 * n_params / peak_flops(jax.devices()[0])
     row = {"variant": name, "tok_s_chip": round(tok_s, 1),
            "mfu_pct": round(mfu * 100, 2),
-           "vs_baseline": round(mfu / 0.32, 4),
+           "vs_baseline": round(mfu / BASELINE_MFU, 4),
            "params_m": round(n_params / 1e6),
            "step_ms": round(dt / steps * 1000, 1)}
     print(row, flush=True)
