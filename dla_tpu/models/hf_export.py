@@ -1,0 +1,184 @@
+"""Export dla_tpu weights to a HuggingFace checkpoint directory.
+
+The inverse of models/hf_import: the reference's sixth phase is
+"Packaging" (reference README.md:46 — collect artifacts for downstream
+use); the strongest packaging for a trained model is the interchange
+format everything else can load. Writes ``config.json`` +
+``model.safetensors`` in the Llama-family layout (llama / mistral /
+qwen2 / mixtral), so a model trained in this framework loads straight
+into ``transformers`` (or any safetensors consumer), and round-trips
+through models/hf_import.
+
+Layout inversions mirror the importer exactly: our ``x @ w`` [in, out]
+matrices transpose back to HF's [out, in] Linear layout, and the
+scan-over-layers leading [L] dim unstacks into ``model.layers.{i}.*``
+keys. MoE expert stacks [L, E, ...] expand to
+``block_sparse_moe.experts.{j}.{w1,w3,w2}``.
+
+CLI:
+    python -m dla_tpu.models.hf_export \
+        --checkpoint checkpoints/sft/latest --output export/sft_hf
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dla_tpu.models.config import ModelConfig
+
+
+def _hf_model_type(cfg: ModelConfig) -> str:
+    if cfg.arch == "phi":
+        return "phi"
+    if cfg.num_experts > 0:
+        return "mixtral"
+    # attention_bias wins over sliding_window: MistralForCausalLM defines
+    # no q/k/v bias tensors, so a biased windowed model must be qwen2
+    # (which supports both) or the biases would be silently dropped
+    if cfg.attention_bias:
+        return "qwen2"
+    if cfg.sliding_window:
+        return "mistral"
+    return "llama"
+
+
+def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
+    """ModelConfig -> HF config.json dict (inverse of
+    hf_config_to_model_config for the llama family)."""
+    if cfg.arch == "phi":
+        raise NotImplementedError(
+            "phi export is not implemented (import-only architecture); "
+            "export llama-family models")
+    out: Dict[str, Any] = {
+        "architectures": [{"mixtral": "MixtralForCausalLM",
+                           "mistral": "MistralForCausalLM",
+                           "qwen2": "Qwen2ForCausalLM",
+                           "llama": "LlamaForCausalLM"}[_hf_model_type(cfg)]],
+        "model_type": _hf_model_type(cfg),
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim_,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "max_position_embeddings": cfg.max_seq_length,
+        "hidden_act": "silu",
+        "torch_dtype": "float32",
+    }
+    if cfg.attention_bias:
+        out["attention_bias"] = True
+    if cfg.sliding_window:
+        out["sliding_window"] = int(cfg.sliding_window)
+        if _hf_model_type(cfg) == "qwen2":
+            # HF qwen2: the first max_window_layers layers run FULL
+            # attention; 0 means SWA on every layer — which is what this
+            # framework's global window does
+            out["use_sliding_window"] = True
+            out["max_window_layers"] = 0
+    if cfg.num_experts > 0:
+        out["num_local_experts"] = cfg.num_experts
+        out["num_experts_per_tok"] = cfg.num_experts_per_token
+    return out
+
+
+def export_hf_weights(params: Dict[str, Any], cfg: ModelConfig,
+                      out_dir) -> Path:
+    """Write ``config.json`` + ``model.safetensors`` (fp32) to out_dir.
+    ``params`` is the dla_tpu pytree (host numpy or device arrays)."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    if cfg.arch == "phi":
+        raise NotImplementedError(
+            "phi export is not implemented (import-only architecture)")
+
+    def host(x) -> np.ndarray:
+        return np.asarray(x, dtype=np.float32)
+
+    def linear(x) -> np.ndarray:
+        return host(x).T.copy()  # [in, out] -> HF [out, in]
+
+    layers = params["layers"]
+    L = cfg.num_layers
+    moe = cfg.num_experts > 0
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": host(params["embed"]["embedding"]),
+        "model.norm.weight": host(params["final_norm"]),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = host(layers["attn_norm"][i])
+        sd[p + "self_attn.q_proj.weight"] = linear(layers["wq"][i])
+        sd[p + "self_attn.k_proj.weight"] = linear(layers["wk"][i])
+        sd[p + "self_attn.v_proj.weight"] = linear(layers["wv"][i])
+        sd[p + "self_attn.o_proj.weight"] = linear(layers["wo"][i])
+        if cfg.attention_bias:
+            sd[p + "self_attn.q_proj.bias"] = host(layers["wq_bias"][i])
+            sd[p + "self_attn.k_proj.bias"] = host(layers["wk_bias"][i])
+            sd[p + "self_attn.v_proj.bias"] = host(layers["wv_bias"][i])
+        sd[p + "post_attention_layernorm.weight"] = host(
+            layers["mlp_norm"][i])
+        if moe:
+            m = p + "block_sparse_moe."
+            sd[m + "gate.weight"] = linear(layers["router"][i])
+            for j in range(cfg.num_experts):
+                sd[m + f"experts.{j}.w1.weight"] = linear(
+                    layers["w_gate"][i][j])
+                sd[m + f"experts.{j}.w3.weight"] = linear(
+                    layers["w_up"][i][j])
+                sd[m + f"experts.{j}.w2.weight"] = linear(
+                    layers["w_down"][i][j])
+        else:
+            sd[p + "mlp.gate_proj.weight"] = linear(layers["w_gate"][i])
+            sd[p + "mlp.up_proj.weight"] = linear(layers["w_up"][i])
+            sd[p + "mlp.down_proj.weight"] = linear(layers["w_down"][i])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        sd["lm_head.weight"] = linear(params["lm_head"])
+
+    from safetensors.numpy import save_file
+    save_file(sd, str(out_dir / "model.safetensors"))
+    with (out_dir / "config.json").open("w") as fh:
+        json.dump(model_config_to_hf(cfg), fh, indent=1)
+    return out_dir
+
+
+def export_checkpoint(checkpoint_path, out_dir) -> Path:
+    """dla_tpu checkpoint dir (or its ``latest`` pointer) -> HF dir.
+    Checkpoints store ``model_config`` aux, so the export is
+    self-describing. LoRA checkpoints must be saved ``merged`` (the
+    trainers' default final save) — raw adapter trees are refused, never
+    silently dropped."""
+    from dla_tpu.checkpoint.checkpointer import load_tree_numpy
+    params, aux = load_tree_numpy(checkpoint_path, prefix="params")
+    mc = aux.get("model_config")
+    if mc is None:
+        raise ValueError(
+            f"checkpoint {checkpoint_path} lacks model_config aux; "
+            "cannot derive the HF config")
+    if "lora" in params:
+        raise ValueError(
+            "checkpoint holds unmerged LoRA adapters; re-save merged "
+            "(trainers write merged final checkpoints) and export that")
+    return export_hf_weights(params, ModelConfig.from_dict(mc), out_dir)
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="Export a dla_tpu checkpoint to HF safetensors")
+    ap.add_argument("--checkpoint", required=True,
+                    help="dla_tpu checkpoint dir or its latest pointer")
+    ap.add_argument("--output", required=True, help="output directory")
+    args = ap.parse_args(argv)
+    out = export_checkpoint(args.checkpoint, args.output)
+    print(f"[dla_tpu] exported HF checkpoint to {out}")
+
+
+if __name__ == "__main__":
+    main()

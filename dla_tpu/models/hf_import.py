@@ -54,17 +54,24 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
     # use_sliding_window: false, which must stay full-causal
     sw = hf_cfg.get("sliding_window")
     if sw and hf_cfg.get("use_sliding_window", True):
-        # qwen2's partial scheme (sliding window on the first
-        # max_window_layers only) is per-layer; this architecture applies
-        # the window globally — refuse rather than silently mis-import
-        # the full-attention tail layers
+        # qwen2's max_window_layers: the FIRST mwl layers run full
+        # attention, SWA applies to layers i >= mwl (transformers
+        # configuration_qwen2.py layer_types derivation). This
+        # architecture's window is all-layers, so only mwl == 0 (SWA
+        # everywhere) is representable; mwl >= L means SWA is disabled
+        # entirely; anything between is per-layer — refuse rather than
+        # silently windowing the full-attention layers.
         mwl = hf_cfg.get("max_window_layers")
-        if mwl is not None and int(mwl) < int(hf_cfg["num_hidden_layers"]):
+        n_layers = int(hf_cfg["num_hidden_layers"])
+        if mwl is None or int(mwl) == 0:
+            fields["sliding_window"] = int(sw)
+        elif int(mwl) >= n_layers:
+            pass  # every layer full-attention: window never applies
+        else:
             raise ValueError(
                 f"partial sliding-window scheme (max_window_layers={mwl} "
-                f"< num_hidden_layers={hf_cfg['num_hidden_layers']}) is "
-                "not supported; sliding_window here is all-layers")
-        fields["sliding_window"] = int(sw)
+                f"of {n_layers} layers full-attention) is not supported; "
+                "sliding_window here is all-layers")
     fields.update(overrides)
     return ModelConfig(**fields)
 

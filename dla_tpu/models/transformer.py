@@ -477,7 +477,9 @@ class Transformer:
                     kv_positions=kv_positions, kv_valid=kv_valid,
                     segment_ids=seg,
                     use_flash=(self.cfg.attention == "flash"
-                               and _flash_tileable(t)))
+                               and _flash_tileable(t)),
+                    flash_block_q=self.cfg.flash_block_q,
+                    flash_block_k=self.cfg.flash_block_k)
             from dla_tpu.ops.ring_attention import ring_causal_attention
             return ring_causal_attention(
                 q, k, v, q_positions=q_positions, kv_positions=kv_positions,
@@ -498,11 +500,17 @@ class Transformer:
         group; GQA grouping survives because the model axis divides
         num_kv_heads in any valid TP layout. ``segs`` is the
         pre-broadcast (qseg, kseg) pair from broadcast_segment_ids."""
-        from dla_tpu.ops.flash_attention import flash_causal_attention
-        win = self.cfg.sliding_window or None
+        from dla_tpu.ops.flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+            flash_causal_attention,
+        )
+        kw = dict(window=self.cfg.sliding_window or None,
+                  block_q=self.cfg.flash_block_q or DEFAULT_BLOCK_Q,
+                  block_k=self.cfg.flash_block_k or DEFAULT_BLOCK_K)
         mesh = _flash_mesh()
         if mesh is None:
-            return flash_causal_attention(q, k, v, segs=segs, window=win)
+            return flash_causal_attention(q, k, v, segs=segs, **kw)
         model_size = mesh.shape.get("model", 1)
         batch_shards = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
         if (q.shape[0] % batch_shards or self.cfg.num_heads % model_size
@@ -511,18 +519,17 @@ class Transformer:
             # eval batch, B < dp shards in a rollout) take the bare
             # pallas_call, which GSPMD runs replicated — correct, just not
             # partitioned. Training batches are always divisible.
-            return flash_causal_attention(q, k, v, segs=segs, window=win)
+            return flash_causal_attention(q, k, v, segs=segs, **kw)
         bspec = P(("data", "fsdp"), None, "model", None)
         if segs is None:
             fn = jax.shard_map(
-                lambda a, b, c: flash_causal_attention(a, b, c, window=win),
+                lambda a, b, c: flash_causal_attention(a, b, c, **kw),
                 mesh=mesh, in_specs=(bspec, bspec, bspec),
                 out_specs=bspec, check_vma=False)
             return fn(q, k, v)
         sspec = P(("data", "fsdp"), None, None)
         fn = jax.shard_map(
-            lambda a, b, c, s: flash_causal_attention(a, b, c, segs=s,
-                                                      window=win),
+            lambda a, b, c, s: flash_causal_attention(a, b, c, segs=s, **kw),
             mesh=mesh,
             in_specs=(bspec, bspec, bspec, (sspec, sspec)),
             out_specs=bspec, check_vma=False)
@@ -642,8 +649,13 @@ class Transformer:
             # scan-over-layers: inside the body the [B,T,block_k] expansion
             # would be rebuilt per layer (and re-rebuilt per layer in the
             # remat'd backward)
-            from dla_tpu.ops.flash_attention import broadcast_segment_ids
-            flash_segs = broadcast_segment_ids(segment_ids)
+            from dla_tpu.ops.flash_attention import (
+                DEFAULT_BLOCK_K,
+                broadcast_segment_ids,
+            )
+            flash_segs = broadcast_segment_ids(
+                segment_ids,
+                block_k=self.cfg.flash_block_k or DEFAULT_BLOCK_K)
 
         kv_mask = None
         if cp is None and not allow_flash:

@@ -36,7 +36,8 @@ SEQ_AXIS = "sequence"
 
 
 def _ulysses_local(q, k, v, q_pos, kv_pos, kv_valid, seg,
-                   *, axis_name: str, scale: float, use_flash: bool):
+                   *, axis_name: str, scale: float, use_flash: bool,
+                   block_q: int = 0, block_k: int = 0):
     """Per-device: q [B, Tl, H, D], k/v [B, Tl, K, D], metadata [B, Tl]."""
 
     def to_heads(x):  # [B, Tl, H, D] -> [B, T, H/n, D]
@@ -54,10 +55,16 @@ def _ulysses_local(q, k, v, q_pos, kv_pos, kv_valid, seg,
         # are monotone in index), and folding validity into the segment
         # ids (invalid -> 0, real -> seg+1) excludes mid-row invalid
         # keys the way the explicit mask would.
-        from dla_tpu.ops.flash_attention import flash_causal_attention
+        from dla_tpu.ops.flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+            flash_causal_attention,
+        )
         seg_eff = jnp.where(kv_valid_g > 0, seg_g + 1, 0)
         out = flash_causal_attention(qh, kh, vh, segment_ids=seg_eff,
-                                     softmax_scale=scale)
+                                     softmax_scale=scale,
+                                     block_q=block_q or DEFAULT_BLOCK_Q,
+                                     block_k=block_k or DEFAULT_BLOCK_K)
     else:
         q_pos_g, kv_pos_g = gather(q_pos), gather(kv_pos)
         mask = kv_valid_g[:, None, :].astype(bool) & (
@@ -81,6 +88,8 @@ def ulysses_causal_attention(
     mesh: Optional[jax.sharding.Mesh] = None,
     softmax_scale: Optional[float] = None,
     use_flash: bool = False,
+    flash_block_q: int = 0,   # 0 = kernel default; cfg.flash_block_q knob
+    flash_block_k: int = 0,
 ) -> jnp.ndarray:
     """Causal GQA self-attention, sequence dim sharded via head all-to-all.
     ``use_flash`` routes the per-shard full-sequence attention through the
@@ -110,7 +119,8 @@ def ulysses_causal_attention(
     sspec = P(batch, SEQ_AXIS)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=SEQ_AXIS, scale=scale,
-                          use_flash=use_flash),
+                          use_flash=use_flash, block_q=flash_block_q,
+                          block_k=flash_block_k),
         mesh=mesh,
         in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec),
         out_specs=qspec,

@@ -78,8 +78,10 @@ def test_hf_config_sliding_window_mapping():
 
 
 def test_hf_config_partial_sliding_window_rejected():
-    """qwen2's partial scheme (window on first max_window_layers only)
-    cannot be represented by the global sliding_window — refuse loudly."""
+    """qwen2's max_window_layers: the FIRST mwl layers run full
+    attention, SWA applies from layer mwl on (HF configuration_qwen2.py
+    layer_types). Only mwl=0 (SWA everywhere) maps to the global window;
+    mwl >= L disables SWA entirely; in between is per-layer — refused."""
     import pytest
     from dla_tpu.models.hf_import import hf_config_to_model_config
 
@@ -90,6 +92,9 @@ def test_hf_config_partial_sliding_window_rejected():
                max_window_layers=21)
     with pytest.raises(ValueError, match="max_window_layers"):
         hf_config_to_model_config(cfg)
-    # all-layers window (max_window_layers >= num_hidden_layers) is fine
+    # mwl >= L: every layer full attention — window must NOT apply
     cfg["max_window_layers"] = 28
+    assert hf_config_to_model_config(cfg).sliding_window is None
+    # mwl == 0: SWA on every layer — exactly the global window
+    cfg["max_window_layers"] = 0
     assert hf_config_to_model_config(cfg).sliding_window == 4096

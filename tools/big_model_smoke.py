@@ -1,0 +1,108 @@
+"""7B-on-one-chip proof: LoRA DPO training step at Llama-2-7B scale.
+
+The north-star config (BASELINE.json) is Llama-2-7B DPO/PPO on v5e.
+A single v5e chip has 15.75 GB HBM; a full-precision 7B DPO run needs a
+multi-chip mesh, but the LoRA path (VERDICT r2 item 8) makes one chip
+enough for a real training step:
+
+- base params in bf16 (param_dtype: bfloat16) ~= 13.5 GB, stored ONCE —
+  the frozen base doubles as the DPO reference model,
+- trainable tree = LoRA adapters only (fp32 + Adam state, ~100 MB at
+  r=16), so no 7B-sized optimizer state exists anywhere,
+- remat: full + flash attention keeps the 4-forward DPO step's
+  activations O(sqrt) at T=512, micro=1.
+
+Run (on the TPU):  python tools/big_model_smoke.py [n_steps]
+Prints loss per step + step time; the loss falling over a handful of
+steps on a fixed synthetic preference batch is the convergence smoke.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.training.train_dpo import make_dpo_loss
+    from dla_tpu.training.trainer import Trainer
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    on_accel = jax.devices()[0].platform != "cpu"
+    name = "llama2-7b" if on_accel else "tiny-gqa"
+    seq = 512 if on_accel else 64
+    micro = 1  # per-shard micro batch
+    cfg = get_model_config(
+        name, param_dtype="bfloat16", dtype="bfloat16", remat="full",
+        # pallas interpret mode is far too slow for a CPU smoke
+        attention="flash" if on_accel else "xla",
+        max_seq_length=seq, lora_r=16)
+    print(f"[7b-smoke] model {name}: "
+          f"{cfg.num_layers}L x {cfg.hidden_size}H, seq {seq}, "
+          f"lora_r {cfg.lora_r}", flush=True)
+
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
+    model = Transformer(cfg)
+    with jax.sharding.set_mesh(mesh):
+        t0 = time.perf_counter()
+        params = model.init(jax.random.key(0))
+        jax.block_until_ready(params)
+        n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+        print(f"[7b-smoke] base init: {n_params/1e9:.2f}B params "
+              f"(bf16, {time.perf_counter()-t0:.0f}s)", flush=True)
+        adapters = model.init_lora(jax.random.key(1))
+        lora_specs = model.lora_partition_specs()
+        n_adapt = sum(int(l.size) for l in jax.tree.leaves(adapters))
+        print(f"[7b-smoke] adapters: {n_adapt/1e6:.1f}M trainable",
+              flush=True)
+
+        config = {
+            "experiment_name": "7b_smoke",
+            "optimization": {
+                "total_batch_size": micro * jax.device_count(),
+                "micro_batch_size": micro,
+                "learning_rate": 5e-4, "max_train_steps": steps,
+                "lr_scheduler": "constant", "max_grad_norm": 1.0,
+            },
+            "logging": {"output_dir": "/tmp/dla_7b_smoke", "log_dir": None},
+            "hardware": {"gradient_accumulation_steps": 1},
+        }
+        trainer = Trainer(
+            config=config, mesh=mesh,
+            loss_fn=make_dpo_loss(model, model, beta=0.1, lora=True),
+            params=adapters, param_specs=lora_specs,
+            frozen={"base": params},
+            frozen_specs={"base": model.partition_specs()})
+
+        rs = np.random.RandomState(0)
+        local_bs = micro * jax.device_count()
+        def sub():
+            return {
+                "input_ids": rs.randint(
+                    1, cfg.vocab_size, (local_bs, seq)).astype(np.int32),
+                "attention_mask": np.ones((local_bs, seq), np.int32),
+            }
+        batch = {"chosen": sub(), "rejected": sub()}
+
+        for i in range(steps):
+            t1 = time.perf_counter()
+            loss, _metrics = trainer.step_on_batch(
+                batch, jax.random.key(10 + i))
+            print(f"[7b-smoke] step {i}: dpo loss {float(loss):.6f} "
+                  f"({time.perf_counter()-t1:.1f}s)", flush=True)
+    print("[7b-smoke] OK: LoRA DPO step at "
+          f"{n_params/1e9:.2f}B scale on {jax.devices()[0].device_kind} "
+          f"x{jax.device_count()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

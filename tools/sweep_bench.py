@@ -23,7 +23,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def run_variant(name: str, *, hidden=1024, inter=2816, layers=24, heads=16,
                 kv_heads=None, micro=8, seq=2048, remat="dots",
                 attention="flash", steps=6, warmup=2,
-                moment_dtype=None) -> dict:
+                moment_dtype=None, block_q=0, block_k=0,
+                ce_chunk=None, packed=False) -> dict:
     import jax
     from dla_tpu.models.config import ModelConfig
     from dla_tpu.models.transformer import Transformer
@@ -36,7 +37,8 @@ def run_variant(name: str, *, hidden=1024, inter=2816, layers=24, heads=16,
         vocab_size=32000, hidden_size=hidden, intermediate_size=inter,
         num_layers=layers, num_heads=heads,
         num_kv_heads=kv_heads if kv_heads is not None else heads,
-        max_seq_length=seq, remat=remat, attention=attention)
+        max_seq_length=seq, remat=remat, attention=attention,
+        flash_block_q=block_q, flash_block_k=block_k)
     mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
     model = Transformer(cfg)
     params = model.init(jax.random.key(0))
@@ -45,7 +47,9 @@ def run_variant(name: str, *, hidden=1024, inter=2816, layers=24, heads=16,
 
     def loss_fn(p, frozen, batch, rng):
         del frozen, rng
-        loss, _ = model_fused_ce(model, p, batch)
+        loss, _ = model_fused_ce(
+            model, p, batch,
+            **({"chunk": ce_chunk} if ce_chunk else {}))
         return loss, {}
 
     config = {
@@ -72,6 +76,16 @@ def run_variant(name: str, *, hidden=1024, inter=2816, layers=24, heads=16,
             "labels": rs.randint(1, cfg.vocab_size, (local_bs, seq)
                                  ).astype(np.int32),
         }
+        if packed:
+            # 4 synthetic segments per row: drives the segment-aware
+            # flash path exactly like data.packing: true does
+            bounds = sorted(rs.choice(np.arange(1, seq), 3, replace=False))
+            seg = np.zeros((local_bs, seq), np.int32)
+            prev = 0
+            for si, bnd in enumerate(list(bounds) + [seq]):
+                seg[:, prev:bnd] = si + 1
+                prev = bnd
+            batch["segment_ids"] = seg
         for i in range(warmup):
             trainer.step_on_batch(batch, jax.random.key(i))
         t0 = time.perf_counter()
@@ -112,6 +126,33 @@ VARIANTS = {
     # no remat at small micro (backward skips all recompute)
     "hd128_noremat_micro4_bf16m": dict(heads=8, micro=4, remat="none",
                                        moment_dtype="bfloat16"),
+    # flash tile-size sweep around the shipped kv4/micro8 config
+    "kv4_micro8_bq1024": dict(heads=8, kv_heads=4, micro=8,
+                              moment_dtype="bfloat16", block_q=1024),
+    "kv4_micro8_b1024": dict(heads=8, kv_heads=4, micro=8,
+                             moment_dtype="bfloat16",
+                             block_q=1024, block_k=1024),
+    "kv4_micro8_bq2048": dict(heads=8, kv_heads=4, micro=8,
+                              moment_dtype="bfloat16", block_q=2048),
+    # fused-CE chunk sweep (rows per [chunk, V] fp32 logit tile)
+    "kv4_micro8_ce512": dict(heads=8, kv_heads=4, micro=8,
+                             moment_dtype="bfloat16", ce_chunk=512),
+    "kv4_micro8_ce2048": dict(heads=8, kv_heads=4, micro=8,
+                              moment_dtype="bfloat16", ce_chunk=2048),
+    "kv4_micro8_ce4096": dict(heads=8, kv_heads=4, micro=8,
+                              moment_dtype="bfloat16", ce_chunk=4096),
+    # odd micro between the 8-OOM-at-hd64 and 12-OOM-at-hd128 cliffs
+    "kv4_micro10": dict(heads=8, kv_heads=4, micro=10,
+                        moment_dtype="bfloat16"),
+    # the flagship packing:true path — segment ids through the
+    # segment-aware flash kernel (fwd + bwd)
+    "kv4_micro8_packed": dict(heads=8, kv_heads=4, micro=8,
+                              moment_dtype="bfloat16", packed=True),
+    # long context: 32k tokens in one sequence, O(T) flash memory,
+    # full remat (activation stash at 32k doesn't fit "dots")
+    "kv4_seq32k_micro1": dict(heads=8, kv_heads=4, micro=1, seq=32768,
+                              remat="full", moment_dtype="bfloat16",
+                              steps=3, warmup=1),
 }
 
 
