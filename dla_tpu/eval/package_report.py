@@ -1,0 +1,178 @@
+"""Packaging phase: collect metrics, plots, and qualitative samples.
+
+The reference names a sixth pipeline phase — "Packaging: Collect
+metrics, plots, and qualitative samples for reports/portfolio"
+(reference README.md:46) — but ships no code for it. This CLI is that
+phase, first-class: it gathers a run's JSONL metrics, the eval suite's
+artifacts (results.json / summary.md / latency.json — the reference
+formats), and optional generation samples, renders loss/throughput
+curves, and writes one self-contained report directory:
+
+    report/
+      report.md            # headline numbers + links, human-readable
+      metrics_<k>.png      # one curve per plotted metric
+      samples.md           # qualitative generations (when provided)
+
+Usage:
+    python -m dla_tpu.eval.package_report \
+        --metrics logs/metrics.jsonl [--eval-dir logs/eval] \
+        [--samples data/rollouts.jsonl] --output report/
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+PLOT_KEYS = ("train/loss", "eval/loss", "tokens_per_sec_per_chip",
+             "train/kl", "train/reward_mean", "eval/acc",
+             "train/preference_rate")
+
+
+def iter_jsonl(path, limit: Optional[int] = None):
+    """Lazily yield parsed rows, skipping torn tail lines from killed
+    runs. ``limit`` stops reading early (sample files can be GBs)."""
+    n = 0
+    with Path(path).open() as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            n += 1
+            if limit is not None and n >= limit:
+                return
+
+
+def read_metrics(path) -> List[Dict[str, Any]]:
+    return list(iter_jsonl(path))
+
+
+def _series(rows, key):
+    xs, ys = [], []
+    for r in rows:
+        if key in r and "step" in r:
+            xs.append(r["step"])
+            ys.append(float(r[key]))
+    return xs, ys
+
+
+def plot_metric(rows, key, out_png) -> bool:
+    xs, ys = _series(rows, key)
+    if len(xs) < 2:
+        return False
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    fig, ax = plt.subplots(figsize=(6, 3.2), dpi=120)
+    ax.plot(xs, ys, lw=1.5)
+    ax.set_xlabel("step")
+    ax.set_ylabel(key)
+    ax.set_title(key)
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_png)
+    plt.close(fig)
+    return True
+
+
+def _fmt(v) -> str:
+    return f"{v:.4g}" if isinstance(v, float) else str(v)
+
+
+def write_report(metrics_path, eval_dir, samples_path, out_dir,
+                 title: str = "Training run report") -> Path:
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    lines = [f"# {title}", ""]
+
+    rows = read_metrics(metrics_path) if metrics_path else []
+    if rows:
+        last = rows[-1]
+        lines += ["## Final metrics", "",
+                  "| metric | last value |", "|---|---|"]
+        for k in sorted(last):
+            if k in ("time",):
+                continue
+            lines.append(f"| {k} | {_fmt(last[k])} |")
+        lines.append("")
+        plotted = []
+        for key in PLOT_KEYS:
+            fname = "metrics_" + key.replace("/", "_") + ".png"
+            if plot_metric(rows, key, out / fname):
+                plotted.append((key, fname))
+        if plotted:
+            lines += ["## Curves", ""]
+            for key, fname in plotted:
+                lines += [f"![{key}]({fname})", ""]
+
+    if eval_dir:
+        ed = Path(eval_dir)
+        results = ed / "results.json"
+        if results.is_file():
+            lines += ["## Alignment eval", ""]
+            data = json.loads(results.read_text())
+            lines += ["| model | benchmark | avg_length | refusal_rate "
+                      "| toxicity_proxy |", "|---|---|---|---|---|"]
+            for model, benches in data.items():
+                for bench, s in benches.items():
+                    lines.append(
+                        f"| {model} | {bench} | {_fmt(s.get('avg_length'))}"
+                        f" | {_fmt(s.get('refusal_rate'))} | "
+                        f"{_fmt(s.get('toxicity_proxy'))} |")
+            lines.append("")
+        latency = ed / "latency.json"
+        if latency.is_file():
+            data = json.loads(latency.read_text())
+            lines += ["## Latency", "",
+                      "```json", json.dumps(data, indent=1)[:4000], "```",
+                      ""]
+        summary = ed / "summary.md"
+        if summary.is_file():
+            lines += ["## Eval summary", "", summary.read_text(), ""]
+
+    if samples_path and Path(samples_path).is_file():
+        sm = ["# Qualitative samples", ""]
+        for i, row in enumerate(iter_jsonl(samples_path, limit=21)):
+            if i >= 20:
+                sm.append(f"*(truncated; more in {samples_path})*")
+                break
+            prompt = row.get("prompt", "")
+            resp = (row.get("teacher_response") or row.get("response")
+                    or row.get("chosen") or "")
+            reward = row.get("reward")
+            sm += [f"## Sample {i}",
+                   f"**Prompt:** {prompt}", "",
+                   f"**Response:** {resp}", ""]
+            if reward is not None:
+                sm += [f"**Reward:** {_fmt(float(reward))}", ""]
+        (out / "samples.md").write_text("\n".join(sm))
+        lines += ["## Samples", "", "See [samples.md](samples.md).", ""]
+
+    report = out / "report.md"
+    report.write_text("\n".join(lines))
+    return report
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="Package a run's metrics/evals/samples into a report")
+    ap.add_argument("--metrics", help="logs/metrics.jsonl from a trainer")
+    ap.add_argument("--eval-dir", help="logs/eval dir with results.json/"
+                                       "summary.md/latency.json")
+    ap.add_argument("--samples", help="JSONL of generations "
+                                      "(e.g. teacher rollouts)")
+    ap.add_argument("--output", required=True, help="report directory")
+    ap.add_argument("--title", default="Training run report")
+    args = ap.parse_args(argv)
+    report = write_report(args.metrics, args.eval_dir, args.samples,
+                          args.output, args.title)
+    print(f"[dla_tpu] wrote {report}")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,10 @@ class ModelConfig:
     #   "phi"    parallel residual block (shared input LayerNorm feeding
     #            both attention and MLP), biased projections, GELU MLP,
     #            partial RoPE (phi-2 / phi-1.5)
+    #   "gemma"  llama block shape with gated GELU-tanh MLP, embeddings
+    #            scaled by sqrt(hidden) on read, tied unembedding, and
+    #            (1+w) RMSNorm — the +1 folds into the stored weights at
+    #            import/init so the norm path stays shared (gemma-1)
     arch: str = "llama"
     # fraction of head_dim that rotates (phi-2: 0.4); 1.0 = full RoPE
     rotary_pct: float = 1.0
@@ -180,6 +184,16 @@ register_model("mistral-7b", ModelConfig(
     vocab_size=32000, hidden_size=4096, intermediate_size=14336,
     num_layers=32, num_heads=32, num_kv_heads=8, max_seq_length=8192,
     sliding_window=4096))  # HF config.json sliding_window (mistral v0.1)
+register_model("gemma-2b", ModelConfig(
+    vocab_size=256000, hidden_size=2048, intermediate_size=16384,
+    num_layers=18, num_heads=8, num_kv_heads=1, head_dim=256,
+    rms_norm_eps=1e-6, tie_embeddings=True, max_seq_length=8192,
+    arch="gemma"))  # HF google/gemma-2b config.json (MQA)
+register_model("gemma-7b", ModelConfig(
+    vocab_size=256000, hidden_size=3072, intermediate_size=24576,
+    num_layers=28, num_heads=16, num_kv_heads=16, head_dim=256,
+    rms_norm_eps=1e-6, tie_embeddings=True, max_seq_length=8192,
+    arch="gemma"))
 register_model("llama3-8b", ModelConfig(
     vocab_size=128256, hidden_size=4096, intermediate_size=14336,
     num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
@@ -223,6 +237,8 @@ register_model("tiny-moe", ModelConfig(
     param_dtype="float32", dtype="float32", remat="none"))
 
 # HF repo-id aliases so reference configs keep working verbatim
+register_model("google/gemma-2b", _REGISTRY["gemma-2b"])
+register_model("google/gemma-7b", _REGISTRY["gemma-7b"])
 register_model("meta-llama/Meta-Llama-3-8B", _REGISTRY["llama3-8b"])
 register_model("meta-llama/Meta-Llama-3-70B", _REGISTRY["llama3-70b"])
 register_model("meta-llama/Llama-2-7b-hf", _REGISTRY["llama2-7b"])

@@ -34,6 +34,8 @@ from dla_tpu.models.config import ModelConfig
 def _hf_model_type(cfg: ModelConfig) -> str:
     if cfg.arch == "phi":
         return "phi"
+    if cfg.arch == "gemma":
+        return "gemma"
     if cfg.num_experts > 0:
         return "mixtral"
     # attention_bias wins over sliding_window: MistralForCausalLM defines
@@ -57,6 +59,7 @@ def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
         "architectures": [{"mixtral": "MixtralForCausalLM",
                            "mistral": "MistralForCausalLM",
                            "qwen2": "Qwen2ForCausalLM",
+                           "gemma": "GemmaForCausalLM",
                            "llama": "LlamaForCausalLM"}[_hf_model_type(cfg)]],
         "model_type": _hf_model_type(cfg),
         "vocab_size": cfg.vocab_size,
@@ -70,7 +73,8 @@ def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
         "rms_norm_eps": cfg.rms_norm_eps,
         "tie_word_embeddings": cfg.tie_embeddings,
         "max_position_embeddings": cfg.max_seq_length,
-        "hidden_act": "silu",
+        "hidden_act": ("gelu_pytorch_tanh" if cfg.arch == "gemma"
+                       else "silu"),
         "torch_dtype": "float32",
     }
     if cfg.attention_bias:
@@ -108,13 +112,21 @@ def export_hf_weights(params: Dict[str, Any], cfg: ModelConfig,
     layers = params["layers"]
     L = cfg.num_layers
     moe = cfg.num_experts > 0
+    # gemma stores norms centered at 0 (runtime computes x * (1 + w));
+    # this framework folds the +1 into the weights at import/init, so
+    # export subtracts it back out
+    off = np.float32(1.0) if cfg.arch == "gemma" else np.float32(0.0)
+
+    def norm(x) -> np.ndarray:
+        return host(x) - off
+
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": host(params["embed"]["embedding"]),
-        "model.norm.weight": host(params["final_norm"]),
+        "model.norm.weight": norm(params["final_norm"]),
     }
     for i in range(L):
         p = f"model.layers.{i}."
-        sd[p + "input_layernorm.weight"] = host(layers["attn_norm"][i])
+        sd[p + "input_layernorm.weight"] = norm(layers["attn_norm"][i])
         sd[p + "self_attn.q_proj.weight"] = linear(layers["wq"][i])
         sd[p + "self_attn.k_proj.weight"] = linear(layers["wk"][i])
         sd[p + "self_attn.v_proj.weight"] = linear(layers["wv"][i])
@@ -123,7 +135,7 @@ def export_hf_weights(params: Dict[str, Any], cfg: ModelConfig,
             sd[p + "self_attn.q_proj.bias"] = host(layers["wq_bias"][i])
             sd[p + "self_attn.k_proj.bias"] = host(layers["wk_bias"][i])
             sd[p + "self_attn.v_proj.bias"] = host(layers["wv_bias"][i])
-        sd[p + "post_attention_layernorm.weight"] = host(
+        sd[p + "post_attention_layernorm.weight"] = norm(
             layers["mlp_norm"][i])
         if moe:
             m = p + "block_sparse_moe."
@@ -162,10 +174,16 @@ def export_checkpoint(checkpoint_path, out_dir) -> Path:
         raise ValueError(
             f"checkpoint {checkpoint_path} lacks model_config aux; "
             "cannot derive the HF config")
-    if "lora" in params:
+    layer_keys = params.get("layers", {})
+    if "embed" not in params or any(
+            k.endswith(("_lora_a", "_lora_b")) for k in layer_keys):
+        # a LoRA run's step/`final` checkpoints hold the ADAPTER tree
+        # ({'layers': {'wq_lora_a': ...}}); only the `merged` tag holds
+        # the folded base weights this exporter needs
         raise ValueError(
-            "checkpoint holds unmerged LoRA adapters; re-save merged "
-            "(trainers write merged final checkpoints) and export that")
+            "checkpoint holds unmerged LoRA adapters (or no base "
+            "weights); export the `merged` checkpoint the trainers "
+            "write (checkpoints/<phase>/merged)")
     return export_hf_weights(params, ModelConfig.from_dict(mc), out_dir)
 
 

@@ -28,6 +28,10 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
     model_type = str(hf_cfg.get("model_type", "")).lower()
     if model_type == "phi":
         return _phi_config(hf_cfg, overrides)
+    if model_type == "gemma2":
+        raise NotImplementedError(
+            "gemma-2 (logit softcapping, alternating-layer SWA, pre+post "
+            "norms) is not supported; gemma-1 is (model_type 'gemma')")
     n_heads = int(hf_cfg["num_attention_heads"])
     fields = dict(
         vocab_size=int(hf_cfg["vocab_size"]),
@@ -46,6 +50,13 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
         attention_bias=bool(hf_cfg.get("attention_bias",
                                        model_type == "qwen2")),
     )
+    if model_type == "gemma":
+        # gated GELU MLP, sqrt(hidden)-scaled embeddings, (1+w) norms
+        # (folded into the stored weights at import), tied unembedding
+        # (GemmaConfig defaults tie_word_embeddings=True)
+        fields["arch"] = "gemma"
+        fields["tie_embeddings"] = bool(
+            hf_cfg.get("tie_word_embeddings", True))
     if model_type == "mixtral" or "num_local_experts" in hf_cfg:
         fields["num_experts"] = int(hf_cfg.get("num_local_experts", 8))
         fields["num_experts_per_token"] = int(
@@ -202,6 +213,12 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
         "layers": {k: np.stack(v) for k, v in stacked.items()},
         "final_norm": take("norm.weight").astype(pdtype),
     }
+    if cfg.arch == "gemma":
+        # HF gemma RMSNorm computes x * (1 + w); fold the +1 here so the
+        # model's shared rms_norm path needs no arch branch
+        for k in ("attn_norm", "mlp_norm"):
+            params["layers"][k] = params["layers"][k] + np.asarray(1, pdtype)
+        params["final_norm"] = params["final_norm"] + np.asarray(1, pdtype)
     if not cfg.tie_embeddings:
         if "lm_head.weight" in sd:
             params["lm_head"] = np.asarray(sd["lm_head.weight"]).T.astype(pdtype)

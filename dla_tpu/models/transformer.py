@@ -445,7 +445,10 @@ class Transformer:
                 capacity_factor=self.cfg.moe_capacity_factor,
                 valid=token_valid, group_size=self.cfg.moe_group_size)
             return out, aux
-        gate = jax.nn.silu(proj("w_gate", h))
+        if self.cfg.arch == "gemma":
+            gate = jax.nn.gelu(proj("w_gate", h), approximate=True)
+        else:
+            gate = jax.nn.silu(proj("w_gate", h))
         up = proj("w_up", h)
         ff = _constrain(gate * up, P(("data", "fsdp"), "sequence", "model"))
         return proj("w_down", ff), None
@@ -666,9 +669,7 @@ class Transformer:
                 same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
                 kv_mask = same_seg if kv_mask is None else (kv_mask & same_seg)
 
-        x = jnp.take(params["embed"]["embedding"], input_ids, axis=0
-                     ).astype(self.adtype)
-        x = _constrain(x, ACT_SPEC)
+        x = _constrain(self._embed(params, input_ids), ACT_SPEC)
         cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
 
         layers = params["layers"]
@@ -787,6 +788,17 @@ class Transformer:
                               self.cfg.rms_norm_eps)
         return rms_norm(x, params["final_norm"], self.cfg.rms_norm_eps)
 
+    def _embed(self, params: Params, ids: jnp.ndarray) -> jnp.ndarray:
+        """Token embedding read in the activation dtype. Gemma scales the
+        input embedding by sqrt(hidden) (normalizer cast to the activation
+        dtype, matching HF GemmaModel's bf16-rounded multiplier); the tied
+        unembedding stays unscaled."""
+        x = jnp.take(params["embed"]["embedding"], ids, axis=0
+                     ).astype(self.adtype)
+        if self.cfg.arch == "gemma":
+            x = x * jnp.asarray(self.cfg.hidden_size ** 0.5, self.adtype)
+        return x
+
     def unembed_params(self, params: Params
                        ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         """(w [D, V] in activation dtype, bias [V] or None) — the
@@ -868,8 +880,7 @@ class Transformer:
         flash_ok = cfg.attention == "flash" and _flash_tileable(t)
         kv_mask = None if flash_ok else jnp.broadcast_to(
             attention_mask[:, None, :].astype(bool), (b, t, t))
-        x = jnp.take(params["embed"]["embedding"], input_ids, axis=0
-                     ).astype(self.adtype)
+        x = self._embed(params, input_ids)
         cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
 
         def body(carry, layer):
@@ -913,8 +924,7 @@ class Transformer:
         write_idx = cache["lengths"]                       # [B] logical position
 
         positions = write_idx[:, None]                     # [B, 1]
-        x = jnp.take(params["embed"]["embedding"], tokens[:, None], axis=0
-                     ).astype(self.adtype)
+        x = self._embed(params, tokens[:, None])
         cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
 
         # Physical write slot: prompts are right-padded to a uniform width T,

@@ -114,3 +114,19 @@ def test_hf_config_inversion_fields():
     assert back.sliding_window == 4096
     assert back.num_kv_heads == cfg.num_kv_heads
     assert back.rope_theta == cfg.rope_theta
+
+
+def test_export_refuses_adapter_checkpoint(tmp_path):
+    """A LoRA run's step/final checkpoints hold the adapter tree, not
+    base weights — export must refuse with the merged-checkpoint hint."""
+    import pytest
+    from dla_tpu.checkpoint.checkpointer import Checkpointer
+    from dla_tpu.models.hf_export import export_checkpoint
+
+    cfg = get_model_config("tiny-gqa", lora_r=4)
+    model = Transformer(cfg)
+    adapters = model.init_lora(jax.random.key(0))
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save(1, {"params": adapters}, aux={"model_config": cfg.to_dict()})
+    with pytest.raises(ValueError, match="merged"):
+        export_checkpoint(tmp_path / "ckpt" / "latest", tmp_path / "out")

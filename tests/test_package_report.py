@@ -1,0 +1,61 @@
+"""Packaging phase CLI: metrics plots + eval artifacts + samples into
+one report directory (reference README.md:46's phase 6, which shipped no
+code)."""
+import json
+
+from dla_tpu.eval.package_report import main, read_metrics, write_report
+
+
+def _write_metrics(path, n=20):
+    with path.open("w") as fh:
+        for s in range(1, n + 1):
+            fh.write(json.dumps({
+                "step": s, "time": 1000.0 + s,
+                "train/loss": 5.0 / s,
+                "tokens_per_sec_per_chip": 100.0 + s}) + "\n")
+        fh.write("{torn line")  # killed-run tail must not break parsing
+
+
+def test_report_end_to_end(tmp_path):
+    metrics = tmp_path / "metrics.jsonl"
+    _write_metrics(metrics)
+
+    eval_dir = tmp_path / "eval"
+    eval_dir.mkdir()
+    (eval_dir / "results.json").write_text(json.dumps({
+        "base": {"helpfulness": {"avg_length": 12.5, "refusal_rate": 0.1,
+                                 "toxicity_proxy": 0.0}}}))
+    (eval_dir / "summary.md").write_text("| col |\n|---|\n")
+    (eval_dir / "latency.json").write_text(json.dumps(
+        {"results": [{"batch": 1, "tokens_per_second": 100.0}]}))
+
+    samples = tmp_path / "rollouts.jsonl"
+    with samples.open("w") as fh:
+        fh.write(json.dumps({"prompt": "hi", "teacher_response": "hello",
+                             "reward": 0.5}) + "\n")
+
+    out = tmp_path / "report"
+    report = write_report(metrics, eval_dir, samples, out)
+    text = report.read_text()
+    assert "train/loss" in text
+    assert "helpfulness" in text
+    assert "samples.md" in text
+    assert (out / "metrics_train_loss.png").is_file()
+    assert (out / "metrics_tokens_per_sec_per_chip.png").is_file()
+    assert "hello" in (out / "samples.md").read_text()
+
+
+def test_read_metrics_skips_torn_lines(tmp_path):
+    metrics = tmp_path / "m.jsonl"
+    _write_metrics(metrics, n=3)
+    rows = read_metrics(metrics)
+    assert len(rows) == 3 and rows[-1]["step"] == 3
+
+
+def test_cli(tmp_path):
+    metrics = tmp_path / "metrics.jsonl"
+    _write_metrics(metrics, n=5)
+    out = tmp_path / "rep"
+    main(["--metrics", str(metrics), "--output", str(out),
+          "--title", "smoke"])
+    assert (out / "report.md").read_text().startswith("# smoke")
