@@ -218,7 +218,9 @@ def run_ppo_bench() -> dict:
             vocab_size=32000, hidden_size=768, intermediate_size=2048,
             num_layers=12, num_heads=6, num_kv_heads=3,
             max_seq_length=512, remat="dots", attention="flash")
-        batch, prompt_w, new_tokens, rollouts, warmup = 32, 128, 128, 3, 1
+        # rollout batch 64 = the reference's own scale
+        # (config/rlhf_config.yaml rollout_batch_size)
+        batch, prompt_w, new_tokens, rollouts, warmup = 64, 128, 128, 3, 1
     else:
         cfg = ModelConfig(
             vocab_size=512, hidden_size=64, intermediate_size=192,

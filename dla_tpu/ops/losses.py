@@ -181,3 +181,83 @@ def kl_distill_loss(
     t_logp = jnp.log(t_probs + 1e-20)
     per_token_kl = jnp.sum(t_probs * (t_logp - s_logp), axis=-1)  # [B, T-1]
     return masked_mean(per_token_kl, mask[:, 1:]) * (temperature ** 2)
+
+
+# ----------------------------------------------------- per-token PPO (GAE)
+
+
+def gae_advantages(
+    rewards: jnp.ndarray,      # [B, T] per-position rewards (0 off-action)
+    values: jnp.ndarray,       # [B, T] value head estimates V(s_t)
+    action_mask: jnp.ndarray,  # [B, T] 1 where position t is an action
+    gamma: float = 1.0,
+    lam: float = 0.95,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Generalized Advantage Estimation over the response region.
+
+    The action region is contiguous per row (engine.left_align puts
+    response tokens right after the prompt, pads after): positions past
+    the last action are terminal (V := 0), positions before the first
+    action carry no advantage. Returns (advantages, returns), both
+    zeroed off-action; ``returns = advantages + values`` are the value
+    targets. Pure function of detached inputs — callers stop_gradient.
+
+    This is the critic-based PPO the reference's "ppo" naming implies
+    but never implements (its update is REINFORCE with a batch-mean
+    baseline, src/training/train_rlhf.py:151-153).
+    """
+    m = action_mask.astype(jnp.float32)
+    # m_next[t] = whether t+1 is still an action (bootstrap gate)
+    m_next = jnp.concatenate([m[:, 1:], jnp.zeros_like(m[:, :1])], axis=1)
+    v_next = jnp.concatenate(
+        [values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1) * m_next
+    delta = (rewards + gamma * v_next - values) * m
+
+    def step(carry, xs):
+        d_t, mn_t = xs
+        a_t = d_t + gamma * lam * mn_t * carry
+        return a_t, a_t
+
+    # reverse scan over time on [T, B] layout
+    _, adv_rev = jax.lax.scan(
+        step, jnp.zeros(rewards.shape[0], rewards.dtype),
+        (delta.T[::-1], m_next.T[::-1]))
+    adv = adv_rev[::-1].T * m
+    return adv, (adv + values) * m
+
+
+def ppo_token_loss(
+    policy_logp: jnp.ndarray,    # [B, T] current per-token logp (with grad)
+    behavior_logp: jnp.ndarray,  # [B, T] rollout-policy logp (detached)
+    advantages: jnp.ndarray,     # [B, T] (detached, whitened by caller)
+    action_mask: jnp.ndarray,    # [B, T]
+    clip_ratio: float = 0.2,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Token-level clipped surrogate, masked mean over action tokens.
+    Returns (loss, clip_frac)."""
+    adv = jax.lax.stop_gradient(advantages)
+    ratio = jnp.exp(policy_logp - jax.lax.stop_gradient(behavior_logp))
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1 - clip_ratio, 1 + clip_ratio) * adv
+    loss = -masked_mean(jnp.minimum(unclipped, clipped), action_mask)
+    clip_frac = masked_mean(
+        (jnp.abs(ratio - 1.0) > clip_ratio).astype(jnp.float32), action_mask)
+    return loss, clip_frac
+
+
+def ppo_value_loss(
+    values: jnp.ndarray,          # [B, T] current value head (with grad)
+    behavior_values: jnp.ndarray, # [B, T] values at rollout time (detached)
+    returns: jnp.ndarray,         # [B, T] GAE returns (detached)
+    action_mask: jnp.ndarray,     # [B, T]
+    value_clip: float = 0.2,
+) -> jnp.ndarray:
+    """Clipped value loss (PPO2-style): the update is pessimistic between
+    the raw squared error and the one with values clipped around their
+    rollout-time estimates."""
+    ret = jax.lax.stop_gradient(returns)
+    v_old = jax.lax.stop_gradient(behavior_values)
+    v_clip = v_old + jnp.clip(values - v_old, -value_clip, value_clip)
+    err = jnp.square(values - ret)
+    err_clip = jnp.square(v_clip - ret)
+    return 0.5 * masked_mean(jnp.maximum(err, err_clip), action_mask)

@@ -215,14 +215,16 @@ def init_lora_adapters(bundle: ModelBundle, rng: jax.Array):
 
 
 def save_merged_lora_final(trainer, bundle: ModelBundle, base_params,
-                           tokenizer_name: Optional[str] = None) -> None:
+                           tokenizer_name: Optional[str] = None,
+                           adapters=None) -> None:
     """Write a `merged` checkpoint with adapters folded into the base
     weights so downstream phases (configs chain via checkpoints/X/latest —
     save() repoints `latest` here) load a plain model. The adapter `final`
     and step checkpoints remain intact for resume; Trainer.try_resume
     falls back to them when `latest` names this export artifact."""
     from dla_tpu.utils.logging import log_rank_zero
-    merged = bundle.model.merge_lora(base_params, trainer.params)
+    merged = bundle.model.merge_lora(
+        base_params, adapters if adapters is not None else trainer.params)
     aux = {"step": trainer.step, **model_aux(bundle, tokenizer_name)}
     aux["model_config"] = dataclasses.replace(
         bundle.config, lora_r=0).to_dict()

@@ -357,3 +357,30 @@ def test_model_sliding_window_decode_matches_forward():
             want[i, s] = nxt
             seq.append(nxt)
     np.testing.assert_array_equal(got, want)
+
+
+def test_model_sliding_window_sharded_matches_single_device():
+    """The window threads through the shard_map-wrapped flash path: a
+    windowed model's sharded forward equals its single-device forward."""
+    import jax
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.parallel.sharding import sharding_tree
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, sequence=1))
+    cfg = get_model_config("tiny-gqa", attention="flash", sliding_window=6)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(3)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+
+    want = model.apply(params, ids)
+    with jax.sharding.set_mesh(mesh):
+        sharded = jax.device_put(
+            params, sharding_tree(model.partition_specs(), mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sharded)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-4)
