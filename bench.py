@@ -184,10 +184,12 @@ def run_bench() -> dict:
         "value": round(tok_s_chip, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(vs_baseline, 4),
-        # which ladder rung produced this number — a degraded micro=4
-        # fallback must be distinguishable from the tuned micro=8 config
+        # which ladder rung / platform produced this number — a degraded
+        # micro=4 fallback or the forced-CPU fallback (wedged tunnel)
+        # must be distinguishable from the tuned TPU micro=8 config
         "detail": {"micro": micro, "seq": seq,
-                   "params_m": round(n_params / 1e6)},
+                   "params_m": round(n_params / 1e6),
+                   "platform": jax.devices()[0].device_kind},
     }
 
 

@@ -25,10 +25,66 @@ def _apply_limit(records: List[Dict[str, Any]], limit) -> List[Dict[str, Any]]:
     return records[: int(limit)] if limit else records
 
 
+def _load_mixture(cfg: Dict[str, Any], split: str, loader
+                  ) -> List[Dict[str, Any]]:
+    """Weighted multi-source mixture (beyond-reference capability; the
+    reference is strictly single-source per run, src/data/datasets.py).
+
+    ``data.mixture`` is a list of per-source config fragments, each with
+    an optional ``weight`` (default 1.0); fragments inherit the outer
+    data config's keys (template, limit, max_length stay shared unless
+    overridden). The epoch holds ``data.mixture_size`` records (default:
+    combined size of all sources), apportioned to sources by weight
+    (largest-remainder, so counts sum exactly); undersized sources
+    repeat deterministically after a seeded shuffle. The final epoch
+    order is shuffled with ``data.mixture_seed`` (default 0) so the
+    interleave is reproducible across hosts and resumes.
+    """
+    import random as _random
+
+    entries = cfg["mixture"]
+    if not entries:
+        raise ValueError("data.mixture is empty")
+    outer = {k: v for k, v in cfg.items()
+             if k not in ("mixture", "mixture_size", "mixture_seed")}
+    per = [loader({**outer, **e}, split) for e in entries]
+    for e, recs in zip(entries, per):
+        if not recs:
+            raise ValueError(f"mixture source produced no records: {e}")
+    weights = [max(0.0, float(e.get("weight", 1.0))) for e in entries]
+    wsum = sum(weights)
+    if wsum <= 0:
+        raise ValueError("mixture weights sum to zero")
+    total = int(cfg.get("mixture_size", sum(len(r) for r in per)))
+    # largest-remainder apportionment: counts sum to exactly `total`
+    quotas = [w / wsum * total for w in weights]
+    counts = [int(q) for q in quotas]
+    rema = sorted(range(len(quotas)), key=lambda i: quotas[i] - counts[i],
+                  reverse=True)
+    for i in rema[: total - sum(counts)]:
+        counts[i] += 1
+
+    seed = int(cfg.get("mixture_seed", 0))
+    out: List[Dict[str, Any]] = []
+    for si, (recs, n) in enumerate(zip(per, counts)):
+        order = list(range(len(recs)))
+        _random.Random(f"{seed}:src{si}").shuffle(order)
+        out.extend(recs[order[i % len(recs)]] for i in range(n))
+    _random.Random(f"{seed}:epoch").shuffle(out)
+    return out
+
+
 def load_instruction_records(cfg: Dict[str, Any],
                              split: str = "train") -> List[Dict[str, Any]]:
     """{prompt, response} records from a local JSONL or an HF dataset with
-    column remapping and optional prompt template."""
+    column remapping and optional prompt template; ``data.mixture``
+    composes several such sources by weight."""
+    if cfg.get("mixture") and split == "train":
+        # the mixture weights/resampling shape the TRAINING epoch only;
+        # eval stays the outer config's single held-out set (weighted
+        # oversampling of an eval file would duplicate rows and skew the
+        # metric)
+        return _load_mixture(cfg, split, load_instruction_records)
     if cfg.get("source", "local") == "hf":
         cols = cfg.get("columns", {})
         pk = cols.get("prompt", "prompt")
@@ -51,7 +107,10 @@ def load_instruction_records(cfg: Dict[str, Any],
 
 def load_preference_records(cfg: Dict[str, Any],
                             split: str = "train") -> List[Dict[str, Any]]:
-    """{prompt, chosen, rejected} records; same source rules."""
+    """{prompt, chosen, rejected} records; same source rules (incl.
+    ``data.mixture``, train split only)."""
+    if cfg.get("mixture") and split == "train":
+        return _load_mixture(cfg, split, load_preference_records)
     if cfg.get("source", "local") == "hf":
         cols = cfg.get("columns", {})
         pk = cols.get("prompt", "prompt")

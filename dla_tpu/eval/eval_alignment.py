@@ -80,6 +80,73 @@ def summarize_responses(responses: List[str]) -> Dict[str, float]:
     }
 
 
+def evaluate_perplexity(bundle, bench_cfg: Dict, batch_size: int,
+                        limit: Optional[int]) -> Dict[str, float]:
+    """benchmark ``type: perplexity``: token-mean NLL / perplexity over a
+    JSONL of {prompt, response} pairs (reference template + prompt
+    masking, so only response tokens count) or raw {text} rows. A
+    likelihood-based metric the reference's keyword heuristics
+    (src/eval/eval_alignment.py:83-95) cannot provide; runs through the
+    fused CE path, so no [B, T, V] logits materialize."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dla_tpu.data.datasets import encode_prompt_response
+    from dla_tpu.ops.fused_ce import fused_cross_entropy_loss
+    from dla_tpu.ops.losses import IGNORE_INDEX
+
+    recs = read_jsonl(bench_cfg.get("path") or bench_cfg["prompts_path"])
+    if limit:
+        recs = recs[:limit]
+    tok = bundle.tokenizer
+    width = int(bench_cfg.get(
+        "max_seq_length", bundle.config.max_seq_length))
+
+    rows = []
+    for r in recs:
+        if "response" in r:
+            enc = encode_prompt_response(
+                tok, r.get("prompt", ""), r["response"], width,
+                mask_prompt=True)
+            rows.append((enc["input_ids"], enc["labels"]))
+        elif r.get("text"):
+            ids = np.asarray(tok.encode(r["text"])[:width], np.int32)
+            rows.append((ids, ids.copy()))
+    if not rows:
+        return {"perplexity": float("nan"), "nll": float("nan"),
+                "n_tokens": 0}
+
+    def ce_only(p, b):
+        # pure token CE — model_fused_ce would fold MoE router
+        # regularizers into the loss and inflate the reported NLL
+        h, _ = bundle.model.hidden_states_with_aux(
+            p, b["input_ids"], attention_mask=b["attention_mask"])
+        w, bias = bundle.model.unembed_params(p)
+        return fused_cross_entropy_loss(h, w, b["labels"], bias=bias)
+
+    step = jax.jit(ce_only)
+    total_nll, total_tok = 0.0, 0
+    for start in range(0, len(rows), batch_size):
+        chunk = rows[start:start + batch_size]
+        ids = np.full((batch_size, width), tok.pad_token_id, np.int32)
+        labels = np.full((batch_size, width), IGNORE_INDEX, np.int32)
+        mask = np.zeros((batch_size, width), np.int32)
+        for i, (ri, rl) in enumerate(chunk):
+            ids[i, :len(ri)] = ri
+            labels[i, :len(rl)] = rl
+            mask[i, :len(ri)] = 1
+        loss, n = step(bundle.params, {
+            "input_ids": jnp.asarray(ids),
+            "attention_mask": jnp.asarray(mask),
+            "labels": jnp.asarray(labels)})
+        total_nll += float(loss) * int(n)
+        total_tok += int(n)
+    nll = total_nll / max(total_tok, 1)
+    import math
+    return {"perplexity": float(math.exp(min(nll, 80.0))),
+            "nll": float(nll), "n_tokens": total_tok}
+
+
 def generate_batched(engine: GenerationEngine, params, prompts: List[str],
                      batch_size: int, max_prompt_len: int, rng) -> List[str]:
     responses: List[str] = []
@@ -114,12 +181,16 @@ def main(argv=None) -> None:
         model_metrics: Dict[str, Dict[str, float]] = {}
         for bench_name, bench_cfg in config["benchmarks"].items():
             limit = bench_cfg.get("max_samples") or args.max_prompts
-            prompts = load_prompts(bench_cfg, limit,
-                                   seed=int(config.get("seed", 0)))
-            responses = generate_batched(
-                engine, bundle.params, prompts, batch_size,
-                max_prompt_len, rng)
-            model_metrics[bench_name] = summarize_responses(responses)
+            if bench_cfg.get("type") == "perplexity":
+                model_metrics[bench_name] = evaluate_perplexity(
+                    bundle, bench_cfg, batch_size, limit)
+            else:
+                prompts = load_prompts(bench_cfg, limit,
+                                       seed=int(config.get("seed", 0)))
+                responses = generate_batched(
+                    engine, bundle.params, prompts, batch_size,
+                    max_prompt_len, rng)
+                model_metrics[bench_name] = summarize_responses(responses)
             log_rank_zero(f"[dla_tpu][eval] {model_name} x {bench_name}: "
                           f"{model_metrics[bench_name]}")
         results[model_name] = model_metrics
@@ -134,11 +205,21 @@ def main(argv=None) -> None:
     table_path.parent.mkdir(parents=True, exist_ok=True)
     lines = ["| Model | Benchmark | Avg Len | Refusal | Toxicity Proxy |",
              "|-------|-----------|---------|---------|----------------|"]
+    ppl_lines = []
     for model_name, bench_metrics in results.items():
         for bench, m in bench_metrics.items():
-            lines.append(
-                f"| {model_name} | {bench} | {m['avg_length']:.1f} "
-                f"| {m['refusal_rate']:.2f} | {m['toxicity_proxy']:.2f} |")
+            if "perplexity" in m:
+                ppl_lines.append(
+                    f"| {model_name} | {bench} | {m['perplexity']:.3f} "
+                    f"| {m['nll']:.4f} | {m['n_tokens']} |")
+            else:
+                lines.append(
+                    f"| {model_name} | {bench} | {m['avg_length']:.1f} "
+                    f"| {m['refusal_rate']:.2f} | {m['toxicity_proxy']:.2f} |")
+    if ppl_lines:
+        lines += ["", "| Model | Benchmark | Perplexity | NLL | Tokens |",
+                  "|-------|-----------|------------|-----|--------|",
+                  *ppl_lines]
     table_path.write_text("\n".join(lines) + "\n")
     log_rank_zero(f"[dla_tpu][eval] wrote {out_path} and {table_path}")
 

@@ -178,3 +178,84 @@ def test_sharded_iterator_epoch_reshuffle(tok):
     assert not np.array_equal(e0, e1)
     # same content, different order
     assert ({tuple(r) for r in e0} == {tuple(r) for r in e1})
+
+
+# --------------------------------------------------- weighted mixtures
+
+
+def _write_source(tmp_path, name, n, tag):
+    from dla_tpu.data.jsonl import write_jsonl
+    p = tmp_path / f"{name}.jsonl"
+    write_jsonl(p, [{"prompt": f"{tag} q{i}", "response": f"{tag} a{i}"}
+                    for i in range(n)])
+    return str(p)
+
+
+def test_mixture_apportions_by_weight(tmp_path):
+    from dla_tpu.data.loaders import load_instruction_records
+
+    a = _write_source(tmp_path, "a", 20, "A")
+    b = _write_source(tmp_path, "b", 20, "B")
+    cfg = {"mixture": [{"train_path": a, "weight": 3.0},
+                       {"train_path": b, "weight": 1.0}],
+           "mixture_size": 16}
+    recs = load_instruction_records(cfg)
+    assert len(recs) == 16
+    n_a = sum(1 for r in recs if r["prompt"].startswith("A"))
+    assert n_a == 12 and len(recs) - n_a == 4
+
+
+def test_mixture_deterministic_and_oversampled(tmp_path):
+    """A source smaller than its quota repeats deterministically; two
+    loads produce identical epochs (multi-host coherence)."""
+    from dla_tpu.data.loaders import load_instruction_records
+
+    a = _write_source(tmp_path, "small", 3, "S")
+    b = _write_source(tmp_path, "big", 30, "L")
+    cfg = {"mixture": [{"train_path": a, "weight": 1.0},
+                       {"train_path": b, "weight": 1.0}],
+           "mixture_size": 20, "mixture_seed": 7}
+    r1 = load_instruction_records(cfg)
+    r2 = load_instruction_records(cfg)
+    assert r1 == r2
+    assert sum(1 for r in r1 if r["prompt"].startswith("S")) == 10
+    # the 3-row source fills its 10-slot quota by repetition
+    assert len({r["prompt"] for r in r1 if r["prompt"].startswith("S")}) == 3
+
+
+def test_mixture_entries_inherit_outer_keys(tmp_path):
+    from dla_tpu.data.loaders import load_instruction_records
+
+    a = _write_source(tmp_path, "x", 10, "X")
+    # outer limit applies per source unless the entry overrides it
+    cfg = {"mixture": [{"train_path": a}], "limit": 4}
+    assert len(load_instruction_records(cfg)) == 4
+
+
+def test_mixture_preference_records(tmp_path):
+    from dla_tpu.data.jsonl import write_jsonl
+    from dla_tpu.data.loaders import load_preference_records
+
+    p = tmp_path / "pref.jsonl"
+    write_jsonl(p, [{"prompt": f"q{i}", "chosen": "good", "rejected": "bad"}
+                    for i in range(6)])
+    cfg = {"mixture": [{"train_path": str(p), "weight": 1.0}],
+           "mixture_size": 6}
+    recs = load_preference_records(cfg)
+    assert len(recs) == 6 and recs[0]["chosen"] == "good"
+
+
+def test_mixture_does_not_touch_eval_split(tmp_path):
+    """The mixture shapes the training epoch only — eval loads the outer
+    config's held-out set untouched (no weighting/oversampling)."""
+    from dla_tpu.data.jsonl import write_jsonl
+    from dla_tpu.data.loaders import load_instruction_records
+
+    a = _write_source(tmp_path, "trn", 10, "T")
+    ev = tmp_path / "eval.jsonl"
+    write_jsonl(ev, [{"prompt": f"e{i}", "response": f"r{i}"}
+                     for i in range(5)])
+    cfg = {"mixture": [{"train_path": a, "weight": 2.0}],
+           "mixture_size": 40, "eval_path": str(ev)}
+    recs = load_instruction_records(cfg, split="eval")
+    assert len(recs) == 5 and recs[0]["prompt"] == "e0"

@@ -100,3 +100,42 @@ def test_eval_latency_end_to_end(tmp_path):
                for r in rows)
     dec = lat["tiny"]["decode"]
     assert dec["decode_tokens_per_second"] > 0
+
+
+def test_eval_perplexity_benchmark(tmp_path):
+    """benchmark type: perplexity — token-mean NLL over {prompt,response}
+    pairs through the fused CE path, folded into results.json/summary.md."""
+    from dla_tpu.eval.eval_alignment import main
+    write_jsonl(tmp_path / "ppl.jsonl",
+                [{"prompt": f"question {i}", "response": f"answer {i}"}
+                 for i in range(5)])
+    write_jsonl(tmp_path / "prompts.jsonl",
+                [{"prompt": "hello"} for _ in range(2)])
+    cfg = {
+        "seed": 0,
+        "models": {"base": "tiny"},
+        "model": {"tokenizer": "byte"},
+        "benchmarks": {
+            "gen_bench": {"type": "local",
+                          "prompts_path": str(tmp_path / "prompts.jsonl")},
+            "heldout_ppl": {"type": "perplexity",
+                            "path": str(tmp_path / "ppl.jsonl"),
+                            "max_seq_length": 48},
+        },
+        "generation": {"max_new_tokens": 4, "batch_size": 2,
+                       "max_prompt_length": 24},
+        "logging": {"output_path": str(tmp_path / "out" / "results.json"),
+                    "table_path": str(tmp_path / "out" / "summary.md")},
+    }
+    p = tmp_path / "eval.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    main(["--config", str(p)])
+
+    results = json.loads((tmp_path / "out" / "results.json").read_text())
+    m = results["base"]["heldout_ppl"]
+    assert m["n_tokens"] > 0
+    assert np.isfinite(m["nll"]) and m["perplexity"] > 1.0
+    table = (tmp_path / "out" / "summary.md").read_text()
+    assert "Perplexity" in table and "heldout_ppl" in table
+    # the generation benchmark still renders in the heuristics table
+    assert "| base | gen_bench |" in table
