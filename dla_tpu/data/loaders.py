@@ -45,8 +45,16 @@ def _load_mixture(cfg: Dict[str, Any], split: str, loader
     entries = cfg["mixture"]
     if not entries:
         raise ValueError("data.mixture is empty")
+    # entries inherit shared shaping keys (limit, template, max_length)
+    # but NEVER the outer source selection — otherwise a local-path entry
+    # under an outer `source: hf` config would silently load the outer
+    # HF dataset instead of its own JSONL
+    _source_keys = ("source", "hf_path", "hf_name", "path", "train_path",
+                    "eval_path", "prompt_path", "preference_path", "split",
+                    "train_split", "eval_split", "columns")
     outer = {k: v for k, v in cfg.items()
-             if k not in ("mixture", "mixture_size", "mixture_seed")}
+             if k not in ("mixture", "mixture_size", "mixture_seed")
+             and k not in _source_keys}
     per = [loader({**outer, **e}, split) for e in entries]
     for e, recs in zip(entries, per):
         if not recs:

@@ -103,6 +103,7 @@ def evaluate_perplexity(bundle, bench_cfg: Dict, batch_size: int,
         "max_seq_length", bundle.config.max_seq_length))
 
     rows = []
+    skipped = 0
     for r in recs:
         if "response" in r:
             enc = encode_prompt_response(
@@ -112,9 +113,18 @@ def evaluate_perplexity(bundle, bench_cfg: Dict, batch_size: int,
         elif r.get("text"):
             ids = np.asarray(tok.encode(r["text"])[:width], np.int32)
             rows.append((ids, ids.copy()))
+        else:
+            skipped += 1
+    if skipped:
+        log_rank_zero(f"[dla_tpu][eval] perplexity: skipped {skipped} "
+                      "records without 'response' or 'text' keys")
     if not rows:
-        return {"perplexity": float("nan"), "nll": float("nan"),
-                "n_tokens": 0}
+        # 0-token sentinel, not NaN: json.dumps would emit a bare NaN
+        # token that strict JSON parsers reject, poisoning results.json
+        # for every other benchmark
+        log_rank_zero("[dla_tpu][eval] perplexity: NO usable records "
+                      f"(all {len(recs)} skipped)")
+        return {"perplexity": 0.0, "nll": 0.0, "n_tokens": 0}
 
     def ce_only(p, b):
         # pure token CE — model_fused_ce would fold MoE router

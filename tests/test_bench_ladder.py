@@ -1,0 +1,77 @@
+"""bench.py parent-orchestrator logic: the descent ladder must treat
+child crashes/OOMs as retryable, error-bearing JSON lines as failures
+(regression: a child backstop once emitted a value-0.0 line on HBM OOM,
+which the parent accepted as a measurement and froze the ladder on the
+first rung), and timeouts as tunnel wedges that end accel attempts."""
+import json
+import subprocess
+import types
+
+import bench
+
+
+def test_extract_json_line_picks_metric_line():
+    text = "\n".join([
+        "[bench] noise",
+        '{"not_metric": 1}',
+        '{"metric": "sft_tokens_per_sec_per_chip", "value": 5.0}',
+    ])
+    got = bench._extract_json_line(text)
+    assert got and got["value"] == 5.0
+
+
+def test_extract_json_line_none_on_garbage():
+    assert bench._extract_json_line("no json here\n{broken") is None
+
+
+def _fake_run(stdout="", returncode=0, raise_timeout=False):
+    def run(cmd, **kw):
+        if raise_timeout:
+            raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 1),
+                                            output=stdout, stderr="")
+        return types.SimpleNamespace(stdout=stdout, stderr="",
+                                     returncode=returncode)
+    return run
+
+
+def test_relay_child_ok(monkeypatch):
+    line = json.dumps({"metric": "m", "value": 3.0})
+    monkeypatch.setattr(bench.subprocess, "run", _fake_run(stdout=line))
+    monkeypatch.setattr(bench, "_child_env", lambda mode: {})
+    result, status = bench._relay_child("accel", 10)
+    assert status == "ok" and result["value"] == 3.0
+
+
+def test_relay_child_error_line_is_failure(monkeypatch):
+    """A JSON line carrying an error field is NOT a measurement — the
+    ladder must retry a smaller config instead of recording 0.0."""
+    line = json.dumps({"metric": "m", "value": 0.0,
+                       "error": "RESOURCE_EXHAUSTED: hbm"})
+    monkeypatch.setattr(bench.subprocess, "run", _fake_run(stdout=line))
+    monkeypatch.setattr(bench, "_child_env", lambda mode: {})
+    result, status = bench._relay_child("accel", 10)
+    assert result is None and status == "failed"
+
+
+def test_relay_child_crash_is_failure(monkeypatch):
+    monkeypatch.setattr(bench.subprocess, "run",
+                        _fake_run(stdout="", returncode=2))
+    monkeypatch.setattr(bench, "_child_env", lambda mode: {})
+    result, status = bench._relay_child("accel", 10)
+    assert result is None and status == "failed"
+
+
+def test_relay_child_no_backend_rc1(monkeypatch):
+    monkeypatch.setattr(bench.subprocess, "run",
+                        _fake_run(stdout="", returncode=1))
+    monkeypatch.setattr(bench, "_child_env", lambda mode: {})
+    result, status = bench._relay_child("accel", 10)
+    assert result is None and status == "no_backend"
+
+
+def test_relay_child_timeout_is_wedge(monkeypatch):
+    monkeypatch.setattr(bench.subprocess, "run",
+                        _fake_run(raise_timeout=True))
+    monkeypatch.setattr(bench, "_child_env", lambda mode: {})
+    result, status = bench._relay_child("accel", 10)
+    assert result is None and status == "timeout"

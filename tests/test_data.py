@@ -259,3 +259,17 @@ def test_mixture_does_not_touch_eval_split(tmp_path):
            "mixture_size": 40, "eval_path": str(ev)}
     recs = load_instruction_records(cfg, split="eval")
     assert len(recs) == 5 and recs[0]["prompt"] == "e0"
+
+
+def test_mixture_entry_source_not_inherited(tmp_path):
+    """A local-path entry under an outer `source: hf` config must load
+    its own JSONL, not the outer HF dataset."""
+    from dla_tpu.data.loaders import load_instruction_records
+
+    a = _write_source(tmp_path, "local_src", 6, "LOC")
+    cfg = {"source": "hf", "hf_path": "would-hit-network/if-inherited",
+           "mixture": [{"train_path": a, "weight": 1.0}],
+           "mixture_size": 6}
+    recs = load_instruction_records(cfg)
+    assert len(recs) == 6
+    assert all(r["prompt"].startswith("LOC") for r in recs)

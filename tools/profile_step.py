@@ -1,0 +1,97 @@
+"""Capture an xplane trace of the headline bench train step.
+
+Profiling artifact generator for layout work (docs/SCALING.md "Profiling
+the layout"): runs the shipped bench configuration for a few steps with
+``jax.profiler`` tracing the hot ones, writing an XProf/TensorBoard-
+compatible trace directory. Run on the TPU:
+
+    python tools/profile_step.py [trace_dir]      # default /tmp/dla_trace
+
+Open the trace in XProf and check MXU utilization on the matmuls, the
+flash kernel's share of step time, and HBM peak vs the remat policy.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    from bench import count_params
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.ops.fused_ce import model_fused_ce
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.training.trainer import Trainer
+
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/dla_trace"
+    on_accel = jax.devices()[0].platform != "cpu"
+    if on_accel:  # the shipped bench config (bench.py run_bench)
+        cfg = ModelConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=24, num_heads=8, num_kv_heads=4,
+            max_seq_length=2048, remat="dots", attention="flash")
+        micro, seq = 8, 2048
+    else:
+        cfg = ModelConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=384,
+            num_layers=4, num_heads=8, num_kv_heads=8,
+            max_seq_length=256, remat="none", dtype="float32",
+            param_dtype="float32")
+        micro, seq = 2, 256
+
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    print(f"[profile] {count_params(params)/1e6:.0f}M params, "
+          f"micro {micro}, seq {seq}", flush=True)
+
+    def loss_fn(p, frozen, batch, rng):
+        del frozen, rng
+        loss, _ = model_fused_ce(model, p, batch)
+        return loss, {}
+
+    config = {
+        "experiment_name": "profile",
+        "optimization": {
+            "total_batch_size": micro * mesh.devices.size,
+            "micro_batch_size": micro, "learning_rate": 1e-4,
+            "max_train_steps": 8, "lr_scheduler": "constant",
+            "max_grad_norm": 1.0, "adam_moment_dtype": "bfloat16",
+        },
+        "logging": {"output_dir": "/tmp/dla_profile_ckpt", "log_dir": None},
+        "hardware": {"gradient_accumulation_steps": 1},
+    }
+    with jax.sharding.set_mesh(mesh):
+        trainer = Trainer(config=config, mesh=mesh, loss_fn=loss_fn,
+                          params=params, param_specs=model.partition_specs())
+        rs = np.random.RandomState(0)
+        bs = micro * mesh.devices.size
+        batch = {
+            "input_ids": rs.randint(1, cfg.vocab_size, (bs, seq)
+                                    ).astype(np.int32),
+            "attention_mask": np.ones((bs, seq), np.int32),
+            "labels": rs.randint(1, cfg.vocab_size, (bs, seq)
+                                 ).astype(np.int32),
+        }
+        for i in range(2):  # compile + settle
+            trainer.step_on_batch(batch, jax.random.key(i))
+        jax.profiler.start_trace(trace_dir)
+        t0 = time.perf_counter()
+        for i in range(3):
+            trainer.step_on_batch(batch, jax.random.key(10 + i))
+        dt = time.perf_counter() - t0
+        jax.profiler.stop_trace()
+    print(f"[profile] 3 traced steps in {dt:.2f}s "
+          f"({dt/3*1000:.0f} ms/step); trace -> {trace_dir}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
