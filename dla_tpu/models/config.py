@@ -42,10 +42,12 @@ class ModelConfig:
     attention_bias: bool = False
     # mistral-style sliding-window attention (HF ``sliding_window``):
     # each token attends kv positions in (pos - window, pos]. None/0 =
-    # full causal. Applies to every attention path (flash, xla, decode);
-    # incompatible with an active sequence mesh axis — Transformer.__init__
-    # raises when both are set under the ambient mesh (the mesh isn't
-    # known here, and context_parallel is a harmless default otherwise).
+    # full causal. Applies to every attention path: flash, xla, decode,
+    # and ring context parallelism (absolute-position mask term rotates
+    # with kv). Ulysses CP is the one refusal — Transformer.__init__
+    # raises when both are set under an active sequence mesh (the mesh
+    # isn't known here, and context_parallel is a harmless default
+    # otherwise).
     sliding_window: Optional[int] = None
     # numerics
     dtype: str = "bfloat16"             # activation dtype

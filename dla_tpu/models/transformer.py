@@ -40,6 +40,13 @@ Params = Dict[str, Any]
 ACT_SPEC = P(("data", "fsdp"), "sequence", None)
 
 
+_ULYSSES_WINDOW_ERROR = (
+    "sliding-window attention is not supported under ulysses context "
+    "parallelism (it gathers full-length kv per head slice and its flash "
+    "path reasons by global index); use context_parallel: ring "
+    "(window-aware) or unset model.sliding_window")
+
+
 def _flash_tileable(t: int) -> bool:
     """Whether the Pallas flash kernel may take sequence length T.
 
@@ -105,16 +112,13 @@ class Transformer:
         self.cfg = cfg
         self.adtype = jnp.dtype(cfg.dtype)
         self.pdtype = jnp.dtype(cfg.param_dtype)
-        if (cfg.sliding_window and cfg.context_parallel != "none"
+        if (cfg.sliding_window and cfg.context_parallel == "ulysses"
                 and _sequence_axis_size() > 1):
             # fail at model construction (trainers build models under the
-            # ambient mesh, before checkpoint load or compile), not at the
-            # first jit trace deep in _attention
-            raise NotImplementedError(
-                "sliding-window attention is not supported under context "
-                "parallelism (ring/ulysses shard the kv rotation on "
-                "full-causal assumptions); unset model.sliding_window or "
-                "the sequence mesh axis")
+            # ambient mesh, before checkpoint load or compile); the same
+            # refusal backstops at trace time in _attention for models
+            # built outside the mesh
+            raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
 
     # ------------------------------------------------------------------ init
 
@@ -466,13 +470,9 @@ class Transformer:
         ulysses context-parallel."""
         t, s = q.shape[1], k.shape[1]
         if cp is not None:
-            if self.cfg.sliding_window:
-                raise NotImplementedError(
-                    "sliding-window attention is not supported under "
-                    "context parallelism (ring/ulysses shard the kv "
-                    "rotation on full-causal assumptions); unset "
-                    "model.sliding_window or the sequence mesh axis")
             mode, kv_valid, seg = cp
+            if self.cfg.sliding_window and mode == "ulysses":
+                raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
             if mode == "ulysses":
                 from dla_tpu.ops.ulysses import ulysses_causal_attention
                 return ulysses_causal_attention(
@@ -486,7 +486,8 @@ class Transformer:
             from dla_tpu.ops.ring_attention import ring_causal_attention
             return ring_causal_attention(
                 q, k, v, q_positions=q_positions, kv_positions=kv_positions,
-                kv_valid=kv_valid, segment_ids=seg)
+                kv_valid=kv_valid, segment_ids=seg,
+                window=self.cfg.sliding_window or None)
         if (self.cfg.attention == "flash" and allow_flash and t == s
                 and _flash_tileable(t)):
             return self._flash(q, k, v, flash_segs)

@@ -41,7 +41,8 @@ SEQ_AXIS = "sequence"
 
 
 def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
-                *, axis_name: str, scale: float):
+                *, axis_name: str, scale: float,
+                window: Optional[int] = None):
     """Per-device ring attention. All args are local shards:
 
     q [B, Tl, H, D]; k/v [B, Sl, K, D]; q_pos/q_seg [B, Tl];
@@ -63,9 +64,14 @@ def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
         m, l, acc, k_c, v_c, pos_c, valid_c, seg_c = carry
         s = jnp.einsum("btkgd,bskd->bkgts", qg,
                        k_c.astype(jnp.float32)) * scale     # [B,K,G,Tl,Sl]
-        mask = ((q_pos[:, :, None] >= pos_c[:, None, :])
+        delta = q_pos[:, :, None] - pos_c[:, None, :]        # [B,Tl,Sl]
+        mask = ((delta >= 0)
                 & valid_c[:, None, :].astype(bool)
                 & (q_seg[:, :, None] == seg_c[:, None, :]))  # [B,Tl,Sl]
+        if window is not None:
+            # mistral sliding window on ABSOLUTE positions — correct no
+            # matter which ring slot the kv chunk currently occupies
+            mask = mask & (delta < window)
         s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -101,11 +107,15 @@ def ring_causal_attention(
     segment_ids: Optional[jnp.ndarray] = None,   # [B, T] packed-segment ids
     mesh: Optional[jax.sharding.Mesh] = None,
     softmax_scale: Optional[float] = None,
+    window: Optional[int] = None,   # sliding window (mistral): (q-w, q]
 ) -> jnp.ndarray:
     """Causal (GQA) self-attention with the sequence dim ring-sharded.
 
     Drop-in for ops.attention.causal_attention when the ambient mesh has
     ``sequence > 1``; also correct (just pointless) at sequence == 1.
+    ``window`` restricts attention to the last ``window`` positions
+    (absolute-position math, so it composes with the rotation) — the
+    long-context mode mistral-family models need under CP.
     """
     b, t, h, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
@@ -123,7 +133,8 @@ def ring_causal_attention(
     sspec = P(batch, SEQ_AXIS)
 
     fn = jax.shard_map(
-        functools.partial(_ring_local, axis_name=SEQ_AXIS, scale=scale),
+        functools.partial(_ring_local, axis_name=SEQ_AXIS, scale=scale,
+                          window=window),
         mesh=mesh,
         in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec, sspec),
         out_specs=qspec,
