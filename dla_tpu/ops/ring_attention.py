@@ -88,9 +88,24 @@ def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
         return (m_new, l, acc, rot(k_c), rot(v_c), rot(pos_c),
                 rot(valid_c), rot(seg_c)), None
 
+    # With a sliding window the scan truncates to just the chunks the
+    # window can reach: the (i, i+1) rotation delivers chunks to device
+    # j in the order j, j-1, j-2, ... — causality masks every later
+    # chunk and the window masks everything farther back than
+    # ceil((window-1)/Sl) chunks, so the remaining ring steps would
+    # compute fully-masked scores (and their ppermute traffic) for
+    # nothing. Positions are contiguous within a segment (packing
+    # appends segments physically in order; cross-segment pairs are
+    # segment-masked), so physical chunk distance bounds position
+    # distance and the truncation is exact, not approximate.
+    steps = n
+    if window is not None:
+        # chunks needed = ceil((window-1)/Sl) + 1 (own chunk + how far
+        # back the window's oldest position can reach from a chunk start)
+        steps = min(n, (max(window, 1) + sl - 2) // sl + 1)
     (m, l, acc, *_), _ = jax.lax.scan(
         step, (m0, l0, acc0, k, v, kv_pos, kv_valid, kv_seg), None,
-        length=n)
+        length=steps)
     out = acc / jnp.where(l == 0.0, 1.0, l)          # [B, K, G, Tl, D]
     out = out.transpose(0, 3, 1, 2, 4)               # [B, Tl, K, G, D]
     return out.reshape(b, tl, h, d).astype(q.dtype)

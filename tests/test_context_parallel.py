@@ -255,3 +255,19 @@ def test_model_sliding_window_under_ring_cp(seq_mesh):
     with jax.sharding.set_mesh(seq_mesh):
         with pytest.raises(NotImplementedError, match="ulysses"):
             Transformer(cfg_u)
+
+
+@pytest.mark.parametrize("window", [1, 8, 9, 17, 32])
+def test_ring_window_truncated_scan_parity(seq_mesh, window):
+    """The windowed ring truncates its scan to ceil((w-1)/Sl)+1 chunks;
+    parity must hold at every boundary: w == Sl, w == Sl+1, multi-chunk,
+    and w covering the whole sequence (no truncation)."""
+    q, k, v, pos = _mk(seed=21)
+
+    with jax.sharding.set_mesh(seq_mesh):
+        got = ring_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos, window=window)
+    want = causal_attention(q, k, v, q_positions=pos, kv_positions=pos,
+                            window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)

@@ -98,3 +98,41 @@ def test_hf_config_partial_sliding_window_rejected():
     # mwl == 0: SWA on every layer — exactly the global window
     cfg["max_window_layers"] = 0
     assert hf_config_to_model_config(cfg).sliding_window == 4096
+
+
+def test_hub_snapshot_opt_in_and_fallback(tiny_hf_dir, monkeypatch):
+    """DLA_HF_HUB_DOWNLOAD gates the hub path: off -> never called; on ->
+    snapshot_download's directory imports through the local-dir path; a
+    failing fetch falls back to preset init loudly instead of raising."""
+    import jax
+
+    from dla_tpu.training import model_io
+
+    d, _ = tiny_hf_dir
+    calls = []
+
+    def fake_snapshot(repo_id, **kw):
+        calls.append(repo_id)
+        return str(d)
+
+    import sys, types
+    fake_mod = types.SimpleNamespace(snapshot_download=fake_snapshot)
+    monkeypatch.setitem(sys.modules, "huggingface_hub", fake_mod)
+
+    # flag off: hub never consulted, name falls through to the registry
+    monkeypatch.delenv("DLA_HF_HUB_DOWNLOAD", raising=False)
+    assert model_io._try_hub_snapshot("org/name") is None
+    assert calls == []
+
+    # flag on: the snapshot dir loads through the HF import path
+    monkeypatch.setenv("DLA_HF_HUB_DOWNLOAD", "1")
+    bundle = model_io.load_causal_lm(
+        "org/tiny-llama", {"tokenizer": "byte"}, jax.random.key(0))
+    assert calls == ["org/tiny-llama"]
+    assert bundle.config.num_layers == 2  # the hf dir's architecture
+
+    # failing fetch: loud fallback, no exception
+    def broken(repo_id, **kw):
+        raise OSError("no egress")
+    fake_mod.snapshot_download = broken
+    assert model_io._try_hub_snapshot("org/other") is None
