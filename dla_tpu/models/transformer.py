@@ -470,7 +470,7 @@ class Transformer:
         ulysses context-parallel."""
         t, s = q.shape[1], k.shape[1]
         if cp is not None:
-            mode, kv_valid, seg = cp
+            mode, kv_valid, seg, gapped = cp
             if self.cfg.sliding_window and mode == "ulysses":
                 raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
             if mode == "ulysses":
@@ -487,7 +487,8 @@ class Transformer:
             return ring_causal_attention(
                 q, k, v, q_positions=q_positions, kv_positions=kv_positions,
                 kv_valid=kv_valid, segment_ids=seg,
-                window=self.cfg.sliding_window or None)
+                window=self.cfg.sliding_window or None,
+                window_truncate=not gapped)
         if (self.cfg.attention == "flash" and allow_flash and t == s
                 and _flash_tileable(t)):
             return self._flash(q, k, v, flash_segs)
@@ -633,7 +634,10 @@ class Transformer:
                         else jnp.ones((b, t), jnp.int32))
             seg = (segment_ids if segment_ids is not None
                    else jnp.zeros((b, t), jnp.int32))
-            cp = (cfg.context_parallel, kv_valid, seg)
+            # gapped masks derive positions from cumsum(mask), so
+            # physical chunk distance no longer bounds position distance
+            # — the windowed ring must not truncate its scan then
+            cp = (cfg.context_parallel, kv_valid, seg, gapped_mask)
 
         # Flash eligibility decided up front so the packed path skips the
         # [B, T, T] mask materialization entirely (round-2 verdict item 1:

@@ -42,7 +42,8 @@ SEQ_AXIS = "sequence"
 
 def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
                 *, axis_name: str, scale: float,
-                window: Optional[int] = None):
+                window: Optional[int] = None,
+                window_truncate: bool = True):
     """Per-device ring attention. All args are local shards:
 
     q [B, Tl, H, D]; k/v [B, Sl, K, D]; q_pos/q_seg [B, Tl];
@@ -94,12 +95,14 @@ def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
     # chunk and the window masks everything farther back than
     # ceil((window-1)/Sl) chunks, so the remaining ring steps would
     # compute fully-masked scores (and their ppermute traffic) for
-    # nothing. Positions are contiguous within a segment (packing
-    # appends segments physically in order; cross-segment pairs are
-    # segment-masked), so physical chunk distance bounds position
-    # distance and the truncation is exact, not approximate.
+    # nothing. EXACT only when positions are physically contiguous
+    # (per segment): right-padded or packed rows qualify; positions
+    # derived from a GAPPED mask (cumsum) do not — there a query can sit
+    # physically many chunks past an in-window key, so the caller must
+    # pass window_truncate=False and the full ring runs (the window
+    # still applies as a mask term).
     steps = n
-    if window is not None:
+    if window is not None and window_truncate:
         # chunks needed = ceil((window-1)/Sl) + 1 (own chunk + how far
         # back the window's oldest position can reach from a chunk start)
         steps = min(n, (max(window, 1) + sl - 2) // sl + 1)
@@ -123,6 +126,7 @@ def ring_causal_attention(
     mesh: Optional[jax.sharding.Mesh] = None,
     softmax_scale: Optional[float] = None,
     window: Optional[int] = None,   # sliding window (mistral): (q-w, q]
+    window_truncate: bool = True,
 ) -> jnp.ndarray:
     """Causal (GQA) self-attention with the sequence dim ring-sharded.
 
@@ -131,6 +135,11 @@ def ring_causal_attention(
     ``window`` restricts attention to the last ``window`` positions
     (absolute-position math, so it composes with the rotation) — the
     long-context mode mistral-family models need under CP.
+    ``window_truncate`` (default on) shortens the ring scan to only the
+    chunks the window can reach; it REQUIRES positions that are
+    physically contiguous per segment (right-padded / packed rows). Pass
+    False when positions come from a gapped mask (cumsum) — the window
+    then applies purely as a mask term over the full ring.
     """
     b, t, h, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
@@ -149,7 +158,7 @@ def ring_causal_attention(
 
     fn = jax.shard_map(
         functools.partial(_ring_local, axis_name=SEQ_AXIS, scale=scale,
-                          window=window),
+                          window=window, window_truncate=window_truncate),
         mesh=mesh,
         in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec, sspec),
         out_specs=qspec,

@@ -271,3 +271,57 @@ def test_ring_window_truncated_scan_parity(seq_mesh, window):
                             window=window)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_ring_window_gapped_positions_no_truncation(seq_mesh):
+    """Gapped masks break the physical-distance bound the truncation
+    relies on (positions = cumsum(mask)-1, so a query physically chunks
+    away can be only a few POSITIONS past an in-window key). With
+    window_truncate=False the windowed ring must stay exact; the model
+    path passes that flag whenever it built positions from a gapped
+    mask."""
+    q, k, v, _ = _mk(seed=23)
+    b, t = q.shape[0], q.shape[1]
+    mask = np.ones((b, t), np.int32)
+    mask[:, 4:24] = 0  # a 20-token hole spanning whole chunks
+    valid = jnp.asarray(mask)
+    pos = jnp.cumsum(valid, axis=1) - 1  # the gapped_mask=True recipe
+    window = 8
+
+    win_mask = ((pos[:, :, None] - pos[:, None, :]) < window)
+    ref = causal_attention(
+        q, k, v, q_positions=pos, kv_positions=pos,
+        kv_segment_mask=(valid[:, None, :].astype(bool)
+                         & jnp.broadcast_to(win_mask, (b, t, t))))
+    with jax.sharding.set_mesh(seq_mesh):
+        out = ring_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos, kv_valid=valid,
+            window=window, window_truncate=False)
+    err = np.abs(np.asarray(out) - np.asarray(ref))
+    assert err[np.asarray(valid).astype(bool)].max() < 2e-5
+
+
+def test_model_gapped_mask_window_under_ring(seq_mesh):
+    """Whole-model check: a windowed model fed a gapped mask under ring
+    CP matches the no-mesh forward (the model disables truncation for
+    gapped-position batches)."""
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+
+    cfg = get_model_config("tiny-gqa", sliding_window=6,
+                           context_parallel="ring")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(5)
+    ids = jnp.asarray(rs.randint(1, 100, (2, 32)), jnp.int32)
+    mask = np.ones((2, 32), np.int32)
+    mask[:, 6:20] = 0
+    mask = jnp.asarray(mask)
+
+    want = model.apply(params, ids, attention_mask=mask, gapped_mask=True)
+    with jax.sharding.set_mesh(seq_mesh):
+        got = jax.jit(lambda p: model.apply(
+            p, ids, attention_mask=mask, gapped_mask=True))(params)
+    m = np.asarray(mask).astype(bool)
+    err = np.abs(np.asarray(got) - np.asarray(want))
+    assert err[m].max() < 2e-4

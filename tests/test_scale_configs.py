@@ -33,6 +33,19 @@ def test_longcontext_32k_config():
     assert sizes["sequence"] == 8
     assert cfg["model"]["max_seq_length"] == 32768
     assert cfg["model"]["context_parallel"] == "ring"
+    # the mistral preset carries sliding_window: 4096; ring CP is
+    # window-aware, so this config must construct under a sequence mesh
+    # (a blanket window-under-CP refusal would kill the flagship
+    # long-context config at model build time)
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+    mc = get_model_config(cfg["model"]["model_name_or_path"],
+                          context_parallel="ring")
+    assert mc.sliding_window == 4096
+    mesh = build_mesh(MeshConfig(data=1, fsdp=2, model=1, sequence=4),
+                      devices=jax.devices()[:8])
+    with jax.sharding.set_mesh(mesh):
+        Transformer(mc)  # must not raise
 
 
 def test_70b_mesh_builds_on_virtual_devices():
