@@ -75,3 +75,27 @@ def test_relay_child_timeout_is_wedge(monkeypatch):
     monkeypatch.setattr(bench, "_child_env", lambda mode: {})
     result, status = bench._relay_child("accel", 10)
     assert result is None and status == "timeout"
+
+
+def test_sweep_variants_bind_to_run_variant():
+    """Every variant BASELINE.md points at as a reproduction command must
+    bind cleanly to run_variant's signature (a typo'd kwarg would only
+    surface on the TPU, mid-measurement)."""
+    import importlib.util
+    import inspect
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "sweep_bench.py")
+    spec = importlib.util.spec_from_file_location("sweep_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    sig = inspect.signature(mod.run_variant)
+    assert mod.VARIANTS, "sweep has no variants"
+    for name, kw in mod.VARIANTS.items():
+        sig.bind(name, **kw)  # raises TypeError on a bad kwarg
+    # the exact reproduction commands BASELINE.md cites must resolve
+    for cited in ("kv4_micro8_packed", "kv4_seq32k_micro1",
+                  "kv4_micro8_b256", "hd128_kv4_micro8_bf16m"):
+        assert cited in mod.VARIANTS, f"BASELINE.md cites {cited}"
