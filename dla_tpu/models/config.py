@@ -21,6 +21,11 @@ class ModelConfig:
     num_kv_heads: int
     head_dim: Optional[int] = None      # defaults to hidden_size // num_heads
     rope_theta: float = 10000.0
+    # HF ``rope_scaling`` dict for extended-context checkpoints:
+    # {"rope_type": "llama3", factor, low_freq_factor, high_freq_factor,
+    #  original_max_position_embeddings} (llama-3.1/3.2) or
+    # {"rope_type": "linear", factor} — ops/rotary.py:_scale_inv_freq.
+    rope_scaling: Optional[Dict[str, Any]] = None
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     max_seq_length: int = 2048
@@ -200,6 +205,13 @@ register_model("llama3-8b", ModelConfig(
     vocab_size=128256, hidden_size=4096, intermediate_size=14336,
     num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
     max_seq_length=8192))  # HF meta-llama/Meta-Llama-3-8B config.json
+register_model("llama3.1-8b", ModelConfig(
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+    max_seq_length=131072,
+    rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                  "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                  "original_max_position_embeddings": 8192}))
 register_model("llama3-70b", ModelConfig(
     vocab_size=128256, hidden_size=8192, intermediate_size=28672,
     num_layers=80, num_heads=64, num_kv_heads=8, rope_theta=500000.0,
@@ -242,6 +254,7 @@ register_model("tiny-moe", ModelConfig(
 register_model("google/gemma-2b", _REGISTRY["gemma-2b"])
 register_model("google/gemma-7b", _REGISTRY["gemma-7b"])
 register_model("meta-llama/Meta-Llama-3-8B", _REGISTRY["llama3-8b"])
+register_model("meta-llama/Llama-3.1-8B", _REGISTRY["llama3.1-8b"])
 register_model("meta-llama/Meta-Llama-3-70B", _REGISTRY["llama3-70b"])
 register_model("meta-llama/Llama-2-7b-hf", _REGISTRY["llama2-7b"])
 register_model("meta-llama/Llama-2-13b-hf", _REGISTRY["llama2-13b"])

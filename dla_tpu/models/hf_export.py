@@ -79,6 +79,8 @@ def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
     }
     if cfg.attention_bias:
         out["attention_bias"] = True
+    if cfg.rope_scaling:
+        out["rope_scaling"] = dict(cfg.rope_scaling)
     if cfg.sliding_window:
         out["sliding_window"] = int(cfg.sliding_window)
         if _hf_model_type(cfg) == "qwen2":

@@ -20,6 +20,13 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from dla_tpu.models.config import ModelConfig
+from dla_tpu.ops.rotary import validate_rope_scaling
+
+
+def _validated_rope_scaling(hf_cfg):
+    """rope_scaling from a config.json, normalized/refused by the one
+    whitelist ops/rotary.py implements (None for default-type dicts)."""
+    return validate_rope_scaling(hf_cfg.get("rope_scaling"))
 
 
 def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfig:
@@ -50,6 +57,9 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
         attention_bias=bool(hf_cfg.get("attention_bias",
                                        model_type == "qwen2")),
     )
+    rs = _validated_rope_scaling(hf_cfg)
+    if rs:
+        fields["rope_scaling"] = rs
     if model_type == "gemma":
         # gated GELU MLP, sqrt(hidden)-scaled embeddings, (1+w) norms
         # (folded into the stored weights at import), tied unembedding
@@ -105,6 +115,9 @@ def _phi_config(hf_cfg: Dict[str, Any], overrides) -> ModelConfig:
         arch="phi",
         rotary_pct=float(hf_cfg.get("partial_rotary_factor", 0.5)),
     )
+    rs = _validated_rope_scaling(hf_cfg)
+    if rs:
+        fields["rope_scaling"] = rs
     fields.update(overrides)
     return ModelConfig(**fields)
 

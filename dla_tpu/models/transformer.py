@@ -684,7 +684,8 @@ class Transformer:
                 kv_mask = same_seg if kv_mask is None else (kv_mask & same_seg)
 
         x = _constrain(self._embed(params, input_ids), ACT_SPEC)
-        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
 
         layers = params["layers"]
         keys = None
@@ -895,7 +896,8 @@ class Transformer:
         kv_mask = None if flash_ok else jnp.broadcast_to(
             attention_mask[:, None, :].astype(bool), (b, t, t))
         x = self._embed(params, input_ids)
-        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
 
         def body(carry, layer):
             h, kv, _ = self._block(layer, carry, cos, sin, kv_mask,
@@ -939,7 +941,8 @@ class Transformer:
 
         positions = write_idx[:, None]                     # [B, 1]
         x = self._embed(params, tokens[:, None])
-        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
 
         # Physical write slot: prompts are right-padded to a uniform width T,
         # so every row writes decode step s at the same column T + s. Rotary

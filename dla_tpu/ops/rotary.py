@@ -6,15 +6,75 @@ dynamic values inside a jitted loop.
 """
 from __future__ import annotations
 
-from typing import Tuple
+import math
+from typing import Any, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
 
+def validate_rope_scaling(scaling: Optional[Dict[str, Any]]
+                          ) -> Optional[Dict[str, Any]]:
+    """Normalize an HF ``rope_scaling`` dict: None/default-type -> None,
+    supported types pass through, anything else raises. The single
+    source of truth for what _scale_inv_freq implements — importers call
+    this instead of keeping their own whitelist."""
+    if not scaling:
+        return None
+    rope_type = str(scaling.get("rope_type")
+                    or scaling.get("type") or "default").lower()
+    if rope_type in ("default", "none"):
+        return None
+    if rope_type not in ("llama3", "linear"):
+        raise NotImplementedError(
+            f"rope_scaling type '{rope_type}' is not supported "
+            "(implemented: llama3, linear)")
+    return dict(scaling)
+
+
+def _scale_inv_freq(inv_freq: jnp.ndarray,
+                    scaling: Dict[str, Any]) -> jnp.ndarray:
+    """Frequency remapping for extended-context checkpoints.
+
+    ``llama3`` (llama-3.1/3.2, HF modeling_rope_utils
+    _compute_llama3_parameters): wavelengths shorter than the
+    high-frequency cutoff keep their frequency, longer than the
+    low-frequency cutoff divide by ``factor``, and the band between
+    interpolates smoothly. ``linear`` divides every frequency by
+    ``factor`` (position-interpolation scaling).
+    """
+    rope_type = str(scaling.get("rope_type")
+                    or scaling.get("type") or "default").lower()
+    if rope_type in ("default", "none"):
+        return inv_freq
+    factor = float(scaling.get("factor", 1.0))
+    if rope_type == "linear":
+        return inv_freq / factor
+    if rope_type != "llama3":
+        raise NotImplementedError(
+            f"rope_scaling type '{rope_type}' is not supported "
+            "(implemented: llama3, linear)")
+    low = float(scaling.get("low_freq_factor", 1.0))
+    high = float(scaling.get("high_freq_factor", 4.0))
+    old_ctx = float(scaling.get("original_max_position_embeddings", 8192))
+    wavelen = 2.0 * math.pi / inv_freq
+    smooth = (old_ctx / wavelen - low) / (high - low)
+    interpolated = ((1.0 - smooth) * inv_freq / factor
+                    + smooth * inv_freq)
+    out = jnp.where(wavelen > old_ctx / low, inv_freq / factor,
+                    interpolated)
+    return jnp.where(wavelen < old_ctx / high, inv_freq, out)
+
+
 def rotary_angles(positions: jnp.ndarray, head_dim: int,
-                  theta: float = 10000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """positions [..., T] int -> (cos, sin) each [..., T, head_dim//2], fp32."""
+                  theta: float = 10000.0,
+                  scaling: Optional[Dict[str, Any]] = None,
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """positions [..., T] int -> (cos, sin) each [..., T, head_dim//2], fp32.
+    ``scaling``: HF ``rope_scaling`` dict (llama3 / linear), see
+    _scale_inv_freq."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling:
+        inv_freq = _scale_inv_freq(inv_freq, scaling)
     ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
     return jnp.cos(ang), jnp.sin(ang)
 

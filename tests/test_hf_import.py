@@ -136,3 +136,61 @@ def test_hub_snapshot_opt_in_and_fallback(tiny_hf_dir, monkeypatch):
         raise OSError("no egress")
     fake_mod.snapshot_download = broken
     assert model_io._try_hub_snapshot("org/other") is None
+
+
+def test_llama31_rope_scaling_logits_parity(tmp_path):
+    """llama3-type rope_scaling (llama-3.1/3.2): imported weights +
+    scaled frequencies must reproduce transformers' logits, with
+    positions past original_max_position_embeddings in play."""
+    import jax.numpy as jnp
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from dla_tpu.models.hf_import import (
+        hf_config_to_model_config,
+        import_hf_weights,
+        read_hf_config,
+    )
+    from dla_tpu.models.transformer import Transformer
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 4.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 16})
+    torch.manual_seed(1)
+    hf_model = LlamaForCausalLM(cfg).eval()
+    d = tmp_path / "hf31"
+    hf_model.save_pretrained(str(d), safe_serialization=True)
+
+    mc = hf_config_to_model_config(
+        read_hf_config(d), dtype="float32", param_dtype="float32",
+        remat="none")
+    assert mc.rope_scaling and mc.rope_scaling["factor"] == 4.0
+    params = import_hf_weights(d, mc)
+    model = Transformer(mc)
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 128, (2, 40))  # well past the original 16 ctx
+    ours = np.asarray(model.apply(params, jnp.asarray(ids, jnp.int32)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-4)
+
+
+def test_unknown_rope_scaling_refused():
+    import pytest
+    from dla_tpu.models.hf_import import hf_config_to_model_config
+
+    base = dict(model_type="llama", vocab_size=128, hidden_size=32,
+                intermediate_size=64, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2)
+    with pytest.raises(NotImplementedError, match="yarn"):
+        hf_config_to_model_config(
+            {**base, "rope_scaling": {"rope_type": "yarn", "factor": 2.0}})
+    # default-type scaling dicts are a no-op, not an error
+    assert hf_config_to_model_config(
+        {**base, "rope_scaling": {"rope_type": "default"}}
+    ).rope_scaling is None
