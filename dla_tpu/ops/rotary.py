@@ -44,15 +44,12 @@ def _scale_inv_freq(inv_freq: jnp.ndarray,
     """
     rope_type = str(scaling.get("rope_type")
                     or scaling.get("type") or "default").lower()
-    if rope_type in ("default", "none"):
-        return inv_freq
     factor = float(scaling.get("factor", 1.0))
     if rope_type == "linear":
         return inv_freq / factor
-    if rope_type != "llama3":
-        raise NotImplementedError(
-            f"rope_scaling type '{rope_type}' is not supported "
-            "(implemented: llama3, linear)")
+    # validate_rope_scaling is the one whitelist; anything else reaching
+    # here is a programming error, not a user-config error
+    assert rope_type == "llama3", rope_type
     low = float(scaling.get("low_freq_factor", 1.0))
     high = float(scaling.get("high_freq_factor", 4.0))
     old_ctx = float(scaling.get("original_max_position_embeddings", 8192))
@@ -73,6 +70,7 @@ def rotary_angles(positions: jnp.ndarray, head_dim: int,
     ``scaling``: HF ``rope_scaling`` dict (llama3 / linear), see
     _scale_inv_freq."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    scaling = validate_rope_scaling(scaling)  # the ONE whitelist
     if scaling:
         inv_freq = _scale_inv_freq(inv_freq, scaling)
     ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
