@@ -21,14 +21,23 @@ Robustness contract: the bench PREFERS the real accelerator, falls back
 to forced CPU when no accelerator comes up, and emits its JSON line with
 exit code 0 on EVERY path. Backend init through the TPU tunnel has been
 observed to *hang* (not raise) — so the parent process NEVER initializes
-jax itself: every jax touch happens in a bounded child. The accelerator
-attempt is a descent ladder over micro batch sizes (8 -> 6 -> 4, or just
-the operator-set DLA_BENCH_MICRO), each in a FRESH child because an HBM
-OOM can poison a live TPU client; a child that times out (wedged tunnel)
-or reports no backend ends the ladder immediately, then a forced-CPU
-child guarantees the line. Worst case wall time is
-len(ladder) * DLA_BENCH_ACCEL_TIMEOUT (default 900s each, crash-only
-path) + DLA_BENCH_CPU_TIMEOUT (default 600s).
+jax itself: every jax touch happens in a bounded child. The ladder is:
+
+  1. PROBE child (DLA_BENCH_PROBE_TIMEOUT, default 90s): devices-up +
+     one tiny jit, nothing else. A wedged tunnel costs ~90s here
+     instead of burning a 900s compile+measure budget (round-3
+     post-mortem: one wedged 900s rung ate the driver's window before
+     the CPU fallback could run).
+  2. Accelerator measure children, a descent ladder over micro batch
+     sizes (8 -> 6 -> 4, or just the operator-set DLA_BENCH_MICRO),
+     each in a FRESH child because an HBM OOM can poison a live TPU
+     client; a child that times out or reports no backend ends the
+     ladder immediately.
+  3. Forced-CPU child guarantees the line.
+
+Worst case wall time is DLA_BENCH_PROBE_TIMEOUT (wedged tunnel) +
+DLA_BENCH_CPU_TIMEOUT (default 600s); healthy-tunnel worst case adds
+len(ladder) * DLA_BENCH_ACCEL_TIMEOUT (default 900s each).
 """
 from __future__ import annotations
 
@@ -82,6 +91,20 @@ def _try_devices(retries: int = 2, delay_s: float = 5.0):
             time.sleep(delay_s)
     print(f"[bench] no accelerator backend: {last}", file=sys.stderr)
     return None
+
+
+def run_probe() -> dict:
+    """Tunnel-health probe: devices up + one tiny jit. Cheap enough that
+    a wedged tunnel only burns the probe timeout, not a measure budget."""
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    return {"metric": "probe", "value": 1, "unit": "ok",
+            "detail": {"platform": dev.platform,
+                       "device_kind": dev.device_kind,
+                       "n_devices": jax.device_count()}}
 
 
 def run_bench() -> dict:
@@ -429,6 +452,16 @@ def main() -> int:
         force_cpu_platform()
         _emit_and_maybe_extra()
         return 0
+    if mode == "probe":
+        # Probe child: devices-up + tiny jit only; parent bounds us with
+        # the short probe timeout. rc=1 = no backend (same as accel).
+        # Keep the default retry policy: the tunnel's documented
+        # transient first-contact UNAVAILABLE must not demote a healthy
+        # TPU run to the CPU fallback (retries fit the 90s budget).
+        if _try_devices() is None:
+            return 1
+        print(json.dumps(run_probe()))
+        return 0
     if mode == "accel":
         # Accelerator child: may hang in tunnel init — parent bounds us.
         if _try_devices() is None:
@@ -443,6 +476,7 @@ def main() -> int:
     # RESOURCE_EXHAUSTED), so each retry gets a clean process.
     if "--extra" in sys.argv:
         os.environ["DLA_BENCH_EXTRA"] = "1"
+    probe_t = float(os.environ.get("DLA_BENCH_PROBE_TIMEOUT", "90"))
     accel_t = float(os.environ.get("DLA_BENCH_ACCEL_TIMEOUT", "900"))
     cpu_t = float(os.environ.get("DLA_BENCH_CPU_TIMEOUT", "600"))
     preset = os.environ.get("DLA_BENCH_MICRO")
@@ -452,14 +486,30 @@ def main() -> int:
         print(f"[bench] ignoring malformed DLA_BENCH_MICRO={preset!r}",
               file=sys.stderr)
         ladder = (8, 6, 4)
+    # Rung 1: fail-fast tunnel-health probe. Only a healthy probe opens
+    # the expensive measure ladder; a hung/failed probe sends us straight
+    # to the CPU fallback at ~probe_t cost instead of n*accel_t.
+    probe, probe_status = _relay_child("probe", probe_t)
     result = None
-    for micro in ladder:
-        os.environ["DLA_BENCH_MICRO"] = str(micro)
-        result, status = _relay_child("accel", accel_t)
-        if result is not None or status in ("timeout", "no_backend"):
-            break
-        print(f"[bench] accel attempt at micro={micro} produced no "
-              f"result; retrying smaller", file=sys.stderr)
+    # A probe that emitted its line but then wedged (timeout during
+    # teardown) still demonstrated a wedge-class tunnel — gate on status,
+    # not just on having parsed a line.
+    if probe is None or probe_status != "ok":
+        print(f"[bench] tunnel probe unhealthy ({probe_status}); "
+              f"skipping accelerator ladder", file=sys.stderr)
+    elif probe.get("detail", {}).get("platform") == "cpu":
+        print("[bench] probe came up on CPU only; skipping accelerator "
+              "ladder", file=sys.stderr)
+    else:
+        print(f"[bench] tunnel probe healthy: {probe.get('detail')}",
+              file=sys.stderr)
+        for micro in ladder:
+            os.environ["DLA_BENCH_MICRO"] = str(micro)
+            result, status = _relay_child("accel", accel_t)
+            if result is not None or status in ("timeout", "no_backend"):
+                break
+            print(f"[bench] accel attempt at micro={micro} produced no "
+                  f"result; retrying smaller", file=sys.stderr)
     if result is None:
         result, _ = _relay_child("cpu", cpu_t)
     if result is None:  # last resort: the line must still be emitted

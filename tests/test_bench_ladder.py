@@ -77,6 +77,91 @@ def test_relay_child_timeout_is_wedge(monkeypatch):
     assert result is None and status == "timeout"
 
 
+def _run_parent(monkeypatch, capsys, script):
+    """Drive bench.main() with a scripted _relay_child; returns
+    (modes-called list, emitted JSON line)."""
+    calls = []
+
+    def fake_relay(mode, timeout_s):
+        calls.append(mode)
+        assert script, f"unexpected extra child call: {mode}"
+        want, ret = script.pop(0)
+        assert want == mode, f"expected {want} child, got {mode}"
+        return ret
+
+    monkeypatch.setattr(bench, "_relay_child", fake_relay)
+    monkeypatch.delenv("DLA_BENCH_PLATFORM", raising=False)
+    monkeypatch.delenv("DLA_BENCH_MICRO", raising=False)
+    assert bench.main() == 0
+    out = capsys.readouterr().out
+    return calls, bench._extract_json_line(out)
+
+
+def test_probe_timeout_skips_accel_ladder(monkeypatch, capsys):
+    """A wedged tunnel (probe timeout) must cost one probe budget, not
+    len(ladder) * accel budget: no accel child may run."""
+    cpu_line = ({"metric": "sft_tokens_per_sec_per_chip", "value": 1.0,
+                 "detail": {"platform": "cpu"}}, "ok")
+    calls, got = _run_parent(monkeypatch, capsys, [
+        ("probe", (None, "timeout")), ("cpu", cpu_line)])
+    assert calls == ["probe", "cpu"]
+    assert got["value"] == 1.0
+
+
+def test_probe_cpu_only_skips_accel_ladder(monkeypatch, capsys):
+    """Probe succeeding on CPU means no accelerator exists — measuring
+    the accel config on host CPU would burn the window for nothing."""
+    probe = ({"metric": "probe", "value": 1,
+              "detail": {"platform": "cpu"}}, "ok")
+    cpu_line = ({"metric": "sft_tokens_per_sec_per_chip", "value": 2.0},
+                "ok")
+    calls, got = _run_parent(monkeypatch, capsys, [
+        ("probe", probe), ("cpu", cpu_line)])
+    assert calls == ["probe", "cpu"]
+    assert got["value"] == 2.0
+
+
+def test_probe_line_with_timeout_still_skips_ladder(monkeypatch, capsys):
+    """A probe child that printed its line but then wedged (timeout
+    during teardown) demonstrated a wedge-class tunnel: the gate must
+    look at status, not just at having parsed a line."""
+    probe = ({"metric": "probe", "value": 1,
+              "detail": {"platform": "tpu", "device_kind": "v5e"}},
+             "timeout")
+    cpu_line = ({"metric": "sft_tokens_per_sec_per_chip", "value": 4.0},
+                "ok")
+    calls, got = _run_parent(monkeypatch, capsys, [
+        ("probe", probe), ("cpu", cpu_line)])
+    assert calls == ["probe", "cpu"]
+    assert got["value"] == 4.0
+
+
+def test_probe_healthy_opens_ladder(monkeypatch, capsys):
+    probe = ({"metric": "probe", "value": 1,
+              "detail": {"platform": "tpu", "device_kind": "v5e"}}, "ok")
+    accel = ({"metric": "sft_tokens_per_sec_per_chip", "value": 31000.0,
+              "vs_baseline": 1.05}, "ok")
+    calls, got = _run_parent(monkeypatch, capsys, [
+        ("probe", probe), ("accel", accel)])
+    assert calls == ["probe", "accel"]
+    assert got["vs_baseline"] == 1.05
+
+
+def test_probe_healthy_accel_oom_descends_then_cpu(monkeypatch, capsys):
+    """OOM-class failures descend the micro ladder; exhausting it falls
+    back to CPU."""
+    probe = ({"metric": "probe", "value": 1,
+              "detail": {"platform": "tpu", "device_kind": "v5e"}}, "ok")
+    cpu_line = ({"metric": "sft_tokens_per_sec_per_chip", "value": 3.0},
+                "ok")
+    calls, got = _run_parent(monkeypatch, capsys, [
+        ("probe", probe),
+        ("accel", (None, "failed")), ("accel", (None, "failed")),
+        ("accel", (None, "failed")), ("cpu", cpu_line)])
+    assert calls == ["probe", "accel", "accel", "accel", "cpu"]
+    assert got["value"] == 3.0
+
+
 def test_sweep_variants_bind_to_run_variant():
     """Every variant BASELINE.md points at as a reproduction command must
     bind cleanly to run_variant's signature (a typo'd kwarg would only
