@@ -23,9 +23,11 @@ exit code 0 on EVERY path. Backend init through the TPU tunnel has been
 observed to *hang* (not raise) — so the parent process NEVER initializes
 jax itself: every jax touch happens in a bounded child. The ladder is:
 
-  1. PROBE child (DLA_BENCH_PROBE_TIMEOUT, default 90s): devices-up +
-     one tiny jit, nothing else. A wedged tunnel costs ~90s here
-     instead of burning a 900s compile+measure budget (round-3
+  1. PROBE child (DLA_BENCH_PROBE_TIMEOUT, default 180s): devices-up +
+     one tiny jit, nothing else. The budget is sized ~4x the healthy
+     tunnel's observed cold-init time (tens of seconds) so a slow but
+     healthy init is not misclassified as a wedge, while a real wedge
+     costs ~180s instead of a 900s compile+measure budget (round-3
      post-mortem: one wedged 900s rung ate the driver's window before
      the CPU fallback could run).
   2. Accelerator measure children, a descent ladder over micro batch
@@ -457,7 +459,7 @@ def main() -> int:
         # the short probe timeout. rc=1 = no backend (same as accel).
         # Keep the default retry policy: the tunnel's documented
         # transient first-contact UNAVAILABLE must not demote a healthy
-        # TPU run to the CPU fallback (retries fit the 90s budget).
+        # TPU run to the CPU fallback (retries fit the probe budget).
         if _try_devices() is None:
             return 1
         print(json.dumps(run_probe()))
@@ -476,7 +478,7 @@ def main() -> int:
     # RESOURCE_EXHAUSTED), so each retry gets a clean process.
     if "--extra" in sys.argv:
         os.environ["DLA_BENCH_EXTRA"] = "1"
-    probe_t = float(os.environ.get("DLA_BENCH_PROBE_TIMEOUT", "90"))
+    probe_t = float(os.environ.get("DLA_BENCH_PROBE_TIMEOUT", "180"))
     accel_t = float(os.environ.get("DLA_BENCH_ACCEL_TIMEOUT", "900"))
     cpu_t = float(os.environ.get("DLA_BENCH_CPU_TIMEOUT", "600"))
     preset = os.environ.get("DLA_BENCH_MICRO")

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dla_tpu.models.config import ModelConfig
-from dla_tpu.ops.attention import causal_attention
+from dla_tpu.ops.attention import causal_attention, decode_attention
 from dla_tpu.ops.norms import layer_norm, rms_norm
 from dla_tpu.ops.rotary import apply_rotary, rotary_angles
 
@@ -952,7 +952,13 @@ class Transformer:
         col = cache["prompt_width"] + cache["step"]
         kv_pos = cache["pos"]
 
-        # Write new k/v into the cache at `col`, then attend over the cache.
+        # Attend over the UN-updated cache plus this token's fresh k/v via
+        # decode_attention (score concatenation — no [B,S,K,D] copy inside
+        # the layer loop); the scan emits only the new [B,1,K,D] columns,
+        # written into the cache ONCE below. The round-3 path re-emitted
+        # the full [L,B,S,K,D] cache through the scan each step, ~4x the
+        # necessary HBM traffic on the decode hot loop (the PPO bottleneck,
+        # reference src/training/train_rlhf.py:123-124).
         def body2(carry, xs):
             layer, k_cache, v_cache = xs
             h_in = carry
@@ -977,35 +983,40 @@ class Transformer:
             v = proj("wv", hn).reshape(b, 1, cfg.num_kv_heads, dh)
             q = apply_rotary(q, cos, sin, rotary_dim=rd)
             k = apply_rotary(k, cos, sin, rotary_dim=rd)
-            k_cache = jax.lax.dynamic_update_slice_in_dim(
-                k_cache, k, col, axis=1)
-            v_cache = jax.lax.dynamic_update_slice_in_dim(
-                v_cache, v, col, axis=1)
-            attn = causal_attention(
-                q, k_cache, v_cache,
-                kv_segment_mask=kv_mask_next[:, None, :],
-                q_positions=positions, kv_positions=kv_pos_next,
+            attn = decode_attention(
+                q, k_cache, v_cache, k, v,
+                kv_valid=cache["valid"],
+                q_positions=positions, kv_positions=kv_pos,
                 window=cfg.sliding_window or None)
             attn = attn.reshape(b, 1, cfg.num_heads * dh)
             if cfg.arch == "phi":
                 ff = jax.nn.gelu(proj("fc1", hn), approximate=True)
                 x2 = h_in + proj("wo", attn) + proj("fc2", ff)
-                return x2, (k_cache, v_cache)
+                return x2, (k, v)
             x1 = h_in + proj("wo", attn)
             hn2 = rms_norm(x1, layer["mlp_norm"], cfg.rms_norm_eps)
             x2 = x1 + self._mlp(layer, hn2, proj)[0]  # aux unused at decode
-            return x2, (k_cache, v_cache)
+            return x2, (k, v)
+
+        x, (k_cols, v_cols) = jax.lax.scan(
+            body2, x, (params["layers"], cache["k"], cache["v"]))
+        h = self._final_norm(params, x)
+        logits = self.unembed(params, h[:, 0])
+
+        # Single cache write for the whole step: the stacked [L,B,1,K,D]
+        # new columns land at physical column `col`. Inside the decode
+        # scan/while carry XLA aliases the cache buffers, so this is an
+        # in-place column write, not a cache copy.
+        zero = jnp.zeros((), jnp.int32)
+        k_all = jax.lax.dynamic_update_slice(
+            cache["k"], k_cols, (zero, zero, col, zero, zero))
+        v_all = jax.lax.dynamic_update_slice(
+            cache["v"], v_cols, (zero, zero, col, zero, zero))
 
         # validity/positions after writing this token
         onehot_col = jax.nn.one_hot(col, max_len, dtype=jnp.int32)[None, :]
         valid_next = cache["valid"] | (onehot_col > 0)
         kv_pos_next = jnp.where(onehot_col > 0, write_idx[:, None], kv_pos)
-        kv_mask_next = valid_next
-
-        x, (k_all, v_all) = jax.lax.scan(
-            body2, x, (params["layers"], cache["k"], cache["v"]))
-        h = self._final_norm(params, x)
-        logits = self.unembed(params, h[:, 0])
 
         new_cache = {
             "k": k_all, "v": v_all,

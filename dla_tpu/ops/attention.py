@@ -75,3 +75,62 @@ def causal_attention(
     weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-30)
     out = jnp.einsum("bkgts,bskd->btkgd", weights.astype(v.dtype), v)
     return out.reshape(b, t, h, d)
+
+
+def decode_attention(
+    q: jnp.ndarray,       # [B, 1, H, D]  the current token's query
+    k_cache: jnp.ndarray,  # [B, S, K, D]  cache BEFORE this step's write
+    v_cache: jnp.ndarray,  # [B, S, K, D]
+    k_new: jnp.ndarray,    # [B, 1, K, D]  this token's key (rotary applied)
+    v_new: jnp.ndarray,    # [B, 1, K, D]
+    *,
+    kv_valid: jnp.ndarray,        # [B, S] valid cache columns (1=attend)
+    q_positions: jnp.ndarray,     # [B, 1] absolute position of the token
+    kv_positions: jnp.ndarray,    # [B, S] logical position per cache column
+    softmax_scale: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Single-token attention over an un-updated KV cache plus the
+    just-computed key/value, WITHOUT writing the cache.
+
+    The decode hot loop is HBM-bound; inserting ``k_new`` into the cache
+    before attending forces a [B, S, K, D] copy per layer per step (the
+    round-3 decode path paid this twice: once for the in-loop
+    dynamic_update_slice, once re-emitting the cache through the layer
+    scan). Instead the new token's score column is concatenated to the
+    *score* matrix — [B, K, G, 1, S+1] floats, not KV bytes — and the
+    output is the jointly-softmaxed mix of the cache values and
+    ``v_new``. The caller writes the cache once, outside the layer loop.
+
+    The new token always attends to itself (delta 0: causal and inside
+    any window); cache columns are masked by validity, causality, and the
+    optional sliding window on logical positions. Returns [B, 1, H, D].
+    """
+    b, t, h, d = q.shape
+    assert t == 1, "decode_attention is single-token by construction"
+    _, s, kheads, _ = k_cache.shape
+    groups = h // kheads
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    qg = q.reshape(b, kheads, groups, d)
+    # [B, K, G, S] scores against the existing cache
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache)
+    scores = scores.astype(jnp.float32) * scale
+    delta = q_positions - kv_positions            # [B, S]
+    mask = kv_valid.astype(bool) & (delta >= 0)
+    if window is not None:
+        mask = mask & (delta < window)
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    # [B, K, G, 1] the new token's self-score
+    self_score = jnp.einsum("bkgd,bkd->bkg", qg, k_new[:, 0]
+                            )[..., None].astype(jnp.float32) * scale
+
+    joint = jnp.concatenate([scores, self_score], axis=-1)  # [B,K,G,S+1]
+    joint = joint - jnp.max(joint, axis=-1, keepdims=True)
+    weights = jnp.exp(joint)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    w_cache = weights[..., :s].astype(v_cache.dtype)
+    w_self = weights[..., s:].astype(v_new.dtype)           # [B,K,G,1]
+    out = jnp.einsum("bkgs,bskd->bkgd", w_cache, v_cache)
+    out = out + w_self * v_new[:, 0][:, :, None, :]
+    return out.reshape(b, 1, h, d)

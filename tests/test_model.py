@@ -103,6 +103,45 @@ def test_decode_matches_full_forward(tiny_model):
     np.testing.assert_array_equal(cached_tokens, want)
 
 
+def test_swa_decode_matches_full_forward():
+    """Greedy KV-cache decode under a sliding window (mistral-style) ==
+    full forward re-runs with the same window — exercises the windowed
+    mask in the no-copy decode attention path."""
+    import dataclasses
+    cfg = dataclasses.replace(get_model_config("tiny"), sliding_window=4)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(5))
+    rs = np.random.RandomState(6)
+    lens = [6, 3]
+    width = 7
+    ids = np.zeros((2, width), np.int32)
+    mask = np.zeros((2, width), np.int32)
+    for i, L in enumerate(lens):
+        ids[i, :L] = rs.randint(1, 100, (L,))
+        mask[i, :L] = 1
+    ids, mask = jnp.asarray(ids), jnp.asarray(mask)
+    n_new = 5  # runs past the window so old keys must drop out
+
+    logits, cache = model.start_decode(params, ids, mask, n_new)
+    cached_tokens = []
+    for _ in range(n_new):
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        cached_tokens.append(np.asarray(tok))
+        logits, cache = model.decode_step(params, cache, tok)
+    cached_tokens = np.stack(cached_tokens, axis=1)
+
+    want = np.zeros_like(cached_tokens)
+    for i, L in enumerate(lens):
+        seq = list(np.asarray(ids[i, :L]))
+        for s in range(n_new):
+            arr = jnp.asarray(np.asarray(seq)[None, :], jnp.int32)
+            full = model.apply(params, arr)
+            nxt = int(np.argmax(np.asarray(full[0, -1])))
+            want[i, s] = nxt
+            seq.append(nxt)
+    np.testing.assert_array_equal(cached_tokens, want)
+
+
 def test_sharded_forward_matches_single_device(mesh8, tiny_model):
     model, params = tiny_model
     rs = np.random.RandomState(4)

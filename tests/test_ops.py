@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from dla_tpu.ops.attention import causal_attention
+from dla_tpu.ops.attention import causal_attention, decode_attention
 from dla_tpu.ops.losses import (
     IGNORE_INDEX,
     cross_entropy_loss,
@@ -75,6 +75,40 @@ def test_gqa_matches_repeated_kv():
     v_full = jnp.repeat(v, h // kh, axis=2)
     want = causal_attention(q, k_full, v_full)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+@pytest.mark.parametrize("window", [None, 3])
+def test_decode_attention_matches_concat_cache(window):
+    """decode_attention over (un-updated cache + new k/v) must equal
+    causal_attention over the cache with the new column appended — GQA,
+    ragged validity holes, and sliding window included. This is the
+    no-copy decode hot path's correctness contract."""
+    rs = np.random.RandomState(0)
+    b, s, h, kh, d = 2, 6, 4, 2, 8
+    k_cache = jnp.asarray(rs.randn(b, s, kh, d).astype(np.float32))
+    v_cache = jnp.asarray(rs.randn(b, s, kh, d).astype(np.float32))
+    q = jnp.asarray(rs.randn(b, 1, h, d).astype(np.float32))
+    k_new = jnp.asarray(rs.randn(b, 1, kh, d).astype(np.float32))
+    v_new = jnp.asarray(rs.randn(b, 1, kh, d).astype(np.float32))
+    # ragged: row 0 has 4 real columns, row 1 has 6, with a mid-row hole
+    valid = jnp.asarray([[1, 1, 0, 1, 1, 0], [1, 1, 1, 1, 1, 1]], jnp.int32)
+    kv_pos = jnp.asarray([[0, 1, 9, 2, 3, 9], [0, 1, 2, 3, 4, 5]], jnp.int32)
+    q_pos = jnp.asarray([[4], [6]], jnp.int32)
+
+    got = decode_attention(q, k_cache, v_cache, k_new, v_new,
+                           kv_valid=valid, q_positions=q_pos,
+                           kv_positions=kv_pos, window=window)
+
+    cat_k = jnp.concatenate([k_cache, k_new], axis=1)
+    cat_v = jnp.concatenate([v_cache, v_new], axis=1)
+    cat_valid = jnp.concatenate([valid, jnp.ones((b, 1), jnp.int32)], axis=1)
+    cat_pos = jnp.concatenate([kv_pos, q_pos], axis=1)
+    want = causal_attention(q, cat_k, cat_v,
+                            kv_segment_mask=cat_valid[:, None, :],
+                            q_positions=q_pos, kv_positions=cat_pos,
+                            window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_token_logprobs_vs_log_softmax():
