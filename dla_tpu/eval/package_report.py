@@ -116,15 +116,32 @@ def write_report(metrics_path, eval_dir, samples_path, out_dir,
         if results.is_file():
             lines += ["## Alignment eval", ""]
             data = json.loads(results.read_text())
-            lines += ["| model | benchmark | avg_length | refusal_rate "
-                      "| toxicity_proxy |", "|---|---|---|---|---|"]
-            for model, benches in data.items():
-                for bench, s in benches.items():
+            # perplexity benchmarks share results.json but have none of
+            # the heuristic fields — render them in their own table
+            # (mirrors eval_alignment.py's summary.md) instead of rows
+            # of literal None cells (round-3 advisor finding)
+            heur = [(m, b, s) for m, benches in data.items()
+                    for b, s in benches.items() if "perplexity" not in s]
+            ppl = [(m, b, s) for m, benches in data.items()
+                   for b, s in benches.items() if "perplexity" in s]
+            if heur:
+                lines += ["| model | benchmark | avg_length | refusal_rate "
+                          "| toxicity_proxy |", "|---|---|---|---|---|"]
+                for model, bench, s in heur:
                     lines.append(
                         f"| {model} | {bench} | {_fmt(s.get('avg_length'))}"
                         f" | {_fmt(s.get('refusal_rate'))} | "
                         f"{_fmt(s.get('toxicity_proxy'))} |")
-            lines.append("")
+                lines.append("")
+            if ppl:
+                lines += ["| model | benchmark | perplexity | nll "
+                          "| n_tokens |", "|---|---|---|---|---|"]
+                for model, bench, s in ppl:
+                    lines.append(
+                        f"| {model} | {bench} | {_fmt(s.get('perplexity'))}"
+                        f" | {_fmt(s.get('nll'))} | "
+                        f"{_fmt(s.get('n_tokens'))} |")
+                lines.append("")
         latency = ed / "latency.json"
         if latency.is_file():
             data = json.loads(latency.read_text())

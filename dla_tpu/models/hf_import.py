@@ -71,18 +71,27 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
         fields["num_experts"] = int(hf_cfg.get("num_local_experts", 8))
         fields["num_experts_per_token"] = int(
             hf_cfg.get("num_experts_per_tok", 2))
-    # mistral sliding-window attention; qwen2 ships sliding_window with
-    # use_sliding_window: false, which must stay full-causal
+    # mistral sliding-window attention; qwen2 ships sliding_window but
+    # HF Qwen2Config defaults use_sliding_window to FALSE — an absent
+    # key must follow the per-model-type transformers default (round-3
+    # advisor finding). Whitelist the families whose HF configs apply a
+    # set sliding_window unconditionally (no use_sliding_window knob);
+    # any other type with the key absent stays full-causal rather than
+    # silently windowing.
     sw = hf_cfg.get("sliding_window")
-    if sw and hf_cfg.get("use_sliding_window", True):
+    sw_default_on = model_type in ("mistral", "mixtral")
+    if sw and hf_cfg.get("use_sliding_window", sw_default_on):
         # qwen2's max_window_layers: the FIRST mwl layers run full
         # attention, SWA applies to layers i >= mwl (transformers
         # configuration_qwen2.py layer_types derivation). This
         # architecture's window is all-layers, so only mwl == 0 (SWA
         # everywhere) is representable; mwl >= L means SWA is disabled
         # entirely; anything between is per-layer — refuse rather than
-        # silently windowing the full-attention layers.
+        # silently windowing the full-attention layers. An absent key
+        # means the HF default (28), not 0.
         mwl = hf_cfg.get("max_window_layers")
+        if mwl is None and model_type == "qwen2":
+            mwl = 28
         n_layers = int(hf_cfg["num_hidden_layers"])
         if mwl is None or int(mwl) == 0:
             fields["sliding_window"] = int(sw)

@@ -240,6 +240,33 @@ def test_flash_shard_map_under_mesh():
             rtol=2e-3, atol=2e-4)
 
 
+def test_flash_replicated_fallback_logs_once(capsys):
+    """An odd batch (not divisible by the dp shard count) takes the bare
+    pallas_call, which GSPMD runs replicated — correct but unpartitioned.
+    That silent degradation must announce itself in the logs, once per
+    shape (VERDICT r3 weak-item 4)."""
+    from dla_tpu.models import transformer as tf_mod
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, sequence=1))
+    cfg = get_model_config("tiny-gqa", attention="flash")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    ids = jnp.asarray(
+        np.random.RandomState(7).randint(1, 100, (3, 16)), jnp.int32)
+
+    tf_mod._REPLICATED_FLASH_LOGGED.clear()
+    with jax.sharding.set_mesh(mesh):
+        model.apply(params, ids)   # batch 3 % 4 shards != 0
+        model.apply(params, ids)   # same shape: no second line
+    err = capsys.readouterr().err
+    assert err.count("runs REPLICATED") == 1, err
+
+
 # ------------------------------------------------- sliding window (mistral)
 
 

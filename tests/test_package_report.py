@@ -24,7 +24,9 @@ def test_report_end_to_end(tmp_path):
     eval_dir.mkdir()
     (eval_dir / "results.json").write_text(json.dumps({
         "base": {"helpfulness": {"avg_length": 12.5, "refusal_rate": 0.1,
-                                 "toxicity_proxy": 0.0}}}))
+                                 "toxicity_proxy": 0.0},
+                 "wikitext": {"perplexity": 17.25, "nll": 2.848,
+                              "n_tokens": 4096}}}))
     (eval_dir / "summary.md").write_text("| col |\n|---|\n")
     (eval_dir / "latency.json").write_text(json.dumps(
         {"results": [{"batch": 1, "tokens_per_second": 100.0}]}))
@@ -40,6 +42,10 @@ def test_report_end_to_end(tmp_path):
     assert "train/loss" in text
     assert "helpfulness" in text
     assert "samples.md" in text
+    # perplexity benchmarks get their own table, not None-celled rows in
+    # the heuristics table (round-3 advisor finding)
+    assert "17.25" in text and "wikitext" in text
+    assert "None" not in text
     assert (out / "metrics_train_loss.png").is_file()
     assert (out / "metrics_tokens_per_sec_per_chip.png").is_file()
     assert "hello" in (out / "samples.md").read_text()

@@ -37,6 +37,40 @@ def test_qwen2_config_mapping(tiny_qwen_dir):
     assert cfg.rope_theta == 1e6 and cfg.num_kv_heads == 2
 
 
+def test_qwen2_swa_defaults_follow_hf():
+    """Absent use_sliding_window must follow Qwen2Config's default
+    (False), and an absent max_window_layers means the HF default 28
+    (full attention on early layers), not 0 — a config.json relying on
+    HF defaults must not import with SWA silently enabled (round-3
+    advisor finding)."""
+    from dla_tpu.models.hf_import import hf_config_to_model_config
+
+    base = dict(
+        model_type="qwen2", vocab_size=160, hidden_size=32,
+        intermediate_size=96, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=1024)
+    # neither use_sliding_window nor max_window_layers present: HF
+    # defaults say no SWA at all
+    assert hf_config_to_model_config(dict(base)).sliding_window is None
+    # opted in, but absent max_window_layers = 28 >= 2 layers: all
+    # layers stay full-attention
+    cfg = hf_config_to_model_config(
+        dict(base, use_sliding_window=True))
+    assert cfg.sliding_window is None
+    # opted in with mwl=0: SWA everywhere
+    cfg = hf_config_to_model_config(
+        dict(base, use_sliding_window=True, max_window_layers=0))
+    assert cfg.sliding_window == 1024
+    # mistral semantics unchanged: absent use_sliding_window -> SWA on
+    mcfg = hf_config_to_model_config(dict(
+        model_type="mistral", vocab_size=160, hidden_size=32,
+        intermediate_size=96, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=1024))
+    assert mcfg.sliding_window == 1024
+
+
 def test_qwen2_import_matches_hf_logits(tiny_qwen_dir):
     d, hf_model = tiny_qwen_dir
     import jax.numpy as jnp

@@ -80,6 +80,18 @@ def test_rlhf_ppo_minibatch_mode(tmp_path):
     assert "train/kl_coef" in recs[-1]
 
 
+def test_rollout_rows_round_down_logs(capsys):
+    """The per-host round-down of ppo.batch_size is a silent size
+    degradation unless announced (VERDICT r3)."""
+    from dla_tpu.training.train_rlhf import compute_rollout_rows
+    assert compute_rollout_rows(64, 1) == 64
+    assert compute_rollout_rows(64, 4) == 64
+    assert capsys.readouterr().out == ""
+    assert compute_rollout_rows(65, 4) == 64
+    out = capsys.readouterr().out
+    assert "65" in out and "64 rows" in out and "1 dropped" in out
+
+
 def test_gae_advantages_match_naive_loop():
     """GAE reverse scan == the textbook per-row python recursion, with a
     contiguous action region and terminal bootstrap V := 0."""
