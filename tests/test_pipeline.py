@@ -90,10 +90,10 @@ def test_pipeline_with_packing_and_mask(tiny_setup):
 
 
 def test_pipeline_flash_config_keeps_packed_mask(tiny_setup):
-    """Regression: with attention='flash' and a flash-ELIGIBLE packed
-    batch, the pipeline must still build and apply the segment mask
-    (flash is forced off under stage>1; deciding that after the mask
-    gate once dropped the mask entirely — cross-segment attention)."""
+    """Regression: with attention='flash' but a flash-INELIGIBLE batch
+    (gapped_mask), the pipeline's XLA path must still build and apply
+    the segment mask (deciding flash eligibility after the mask gate
+    once dropped the mask entirely — cross-segment attention)."""
     import dataclasses
     model, params, _ = tiny_setup
     model_f = Transformer(dataclasses.replace(model.cfg, attention="flash"))
@@ -103,12 +103,13 @@ def test_pipeline_flash_config_keeps_packed_mask(tiny_setup):
     seg[:, :7] = 1
     seg[:, 7:14] = 2
     seg = jnp.asarray(seg)
-    want = model_f.apply(params, seg * 0 + ids, segment_ids=seg)
+    want = model_f.apply(params, ids, segment_ids=seg, gapped_mask=True)
     mesh = _stage_mesh()
     with jax.sharding.set_mesh(mesh):
         sp = jax.device_put(params, sharding_tree(model_f.partition_specs(),
                                                   mesh))
-        got = jax.jit(lambda p: model_f.apply(p, ids, segment_ids=seg))(sp)
+        got = jax.jit(lambda p: model_f.apply(
+            p, ids, segment_ids=seg, gapped_mask=True))(sp)
     m = np.asarray(seg) > 0
     for bi in range(4):
         np.testing.assert_allclose(
@@ -150,6 +151,97 @@ def test_pipeline_degrades_microbatches_for_odd_batches(tiny_setup):
         got = jax.jit(lambda p: model4.apply(p, ids))(sp)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_flash_engages_and_matches(monkeypatch):
+    """Under PP the Pallas flash kernel must actually ENGAGE (nested
+    partial-manual shard_map inside the stage shard_map) and match the
+    plain forward — round-3 verdict item 5 pinned PP to XLA attention."""
+    import dataclasses
+
+    import dla_tpu.ops.flash_attention as fa
+
+    cfg = dataclasses.replace(get_model_config("tiny"), attention="flash")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(5)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    want = model.apply(params, ids)
+
+    calls = []
+    real = fa.flash_causal_attention
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fa, "flash_causal_attention", counting)
+    from dla_tpu.models import transformer as tf_mod
+    tf_mod._REPLICATED_FLASH_LOGGED.clear()
+    mesh = _stage_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sp)
+    assert calls, "flash kernel was not traced under pipeline parallelism"
+    # and through the NESTED shard_map path, not the replicated fallback
+    # (the exact degradation this feature removes)
+    assert not tf_mod._REPLICATED_FLASH_LOGGED, (
+        "flash under PP took the replicated fallback: "
+        f"{tf_mod._REPLICATED_FLASH_LOGGED}")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_flash_packed_matches(tiny_setup):
+    """flash x packing x PP: segment ids ride the aux shift register into
+    the kernel (no [B,T,T] mask under flash)."""
+    import dataclasses
+
+    model0, params, _ = tiny_setup
+    cfg = dataclasses.replace(model0.cfg, attention="flash")
+    model = Transformer(cfg)
+    rs = np.random.RandomState(6)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    seg = np.zeros((4, 16), np.int32)
+    for i in range(4):
+        seg[i, :6] = 1
+        seg[i, 6:16] = 2
+    seg = jnp.asarray(seg)
+    want = model.apply(params, ids, segment_ids=seg)
+    mesh = _stage_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(p, ids, segment_ids=seg))(sp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_flash_grads_match(tiny_setup):
+    """Backward through flash-in-PP: remat'd kernel bwd nests under the
+    stage shard_map's reverse schedule."""
+    import dataclasses
+
+    model0, params, _ = tiny_setup
+    cfg = dataclasses.replace(model0.cfg, attention="flash")
+    model = Transformer(cfg)
+    rs = np.random.RandomState(7)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    batch = {"input_ids": ids, "labels": jnp.where(ids % 5 == 0, -100, ids)}
+
+    def loss(p):
+        return model_fused_ce(model, p, batch)[0]
+
+    g_ref = jax.grad(loss)(params)
+    mesh = _stage_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        g_pp = jax.jit(jax.grad(loss))(sp)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
 
 
 def test_pipeline_rejects_bad_combos(tiny_setup):
