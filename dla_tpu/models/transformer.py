@@ -803,19 +803,23 @@ class Transformer:
         over the still-auto batch/head axes (`stage` stays manual in the
         enclosing scope), so the 70B PP path keeps the kernel that set
         the single-chip headline (round-3 verdict item 5)."""
-        from dla_tpu.ops.pipeline import gpipe, microbatch
+        from dla_tpu.ops.pipeline import gpipe, microbatch, \
+            resolve_microbatches
         cfg = self.cfg
         n_layers = cfg.num_layers
         if n_layers % n_stages:
             raise ValueError(
                 f"pipeline needs num_layers ({n_layers}) divisible by the "
                 f"stage axis ({n_stages})")
-        import math as _math
-        m = cfg.pipeline_microbatches or n_stages
-        # degrade gracefully on batches the configured M doesn't divide
-        # (a last partial eval batch, a small rollout): the largest
-        # divisor still pipelines; worst case M=1 runs stages serially
-        m = _math.gcd(m, x.shape[0]) or 1
+        mesh = _ambient_mesh()
+        manual = set(getattr(mesh, "manual_axes", ()) or ()) if mesh else ()
+        dp_shards = 1
+        if mesh is not None:
+            for a in ("data", "fsdp"):
+                if a in mesh.shape and a not in manual:
+                    dp_shards *= mesh.shape[a]
+        m = resolve_microbatches(x.shape[0], cfg.pipeline_microbatches,
+                                 n_stages, dp_shards=dp_shards)
         stage_layers = jax.tree.map(
             lambda l: l.reshape((n_stages, n_layers // n_stages)
                                 + l.shape[1:]), layers)

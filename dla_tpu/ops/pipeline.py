@@ -34,6 +34,7 @@ automatically — no hand-written backward schedule.
 """
 from __future__ import annotations
 
+import sys
 from typing import Any, Callable, Optional
 
 import jax
@@ -128,3 +129,68 @@ def microbatch(x: Optional[jnp.ndarray], n_micro: int):
         raise ValueError(
             f"pipeline needs batch {b} divisible by microbatches {n_micro}")
     return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+# (requested, batch, dp) triples already warned about — trace-time, once
+# per distinct degradation, not per step
+_DEGRADE_WARNED: set = set()
+
+
+def _warn_once(key, msg: str) -> None:
+    if key in _DEGRADE_WARNED:
+        return
+    _DEGRADE_WARNED.add(key)
+    if jax.process_index() == 0:   # 64 hosts must not print 64 copies
+        print(msg, file=sys.stderr, flush=True)
+
+
+def resolve_microbatches(batch: int, requested: Optional[int],
+                         n_stages: int, dp_shards: int = 1) -> int:
+    """Pick the pipeline microbatch count M for a batch of ``batch`` rows.
+
+    Bubble fraction is (S-1)/(M+S-1), so M drives pipeline efficiency:
+    the default targets M = 4*S (bubble <= (S-1)/(5S-1), under 20%),
+    preferring divisors of ``batch`` whose microbatch (batch/M rows)
+    still divides over the ``dp_shards`` batch shards — otherwise the
+    flash kernel's shard_map wrap drops to the replicated fallback and
+    activations lose their batch sharding. An explicitly configured
+    ``pipeline_microbatches`` is honored when it divides the batch;
+    otherwise the best divisor below it is used. EVERY degradation is
+    announced (the round-3 silent gcd degrade could quietly run stages
+    serially on a batch the configured M didn't divide), including
+    serial-stage fallback on the default path and microbatches that
+    break batch sharding."""
+    target = requested or 4 * n_stages
+    divisors = [d for d in range(1, min(target, batch) + 1)
+                if batch % d == 0]
+    dp_ok = [d for d in divisors if (batch // d) % dp_shards == 0]
+    # prefer a dp-compatible split, EXCEPT when its only option is M=1:
+    # replicated attention (correct, unpartitioned) beats serializing
+    # every stage
+    best = max(dp_ok) if dp_ok and (max(dp_ok) > 1 or max(divisors) == 1) \
+        else max(divisors)
+    key = (requested, batch, dp_shards, n_stages)
+    if requested and batch % requested == 0:
+        m = requested
+    else:
+        m = best
+        if requested:
+            bubble = (n_stages - 1) / (m + n_stages - 1)
+            _warn_once(key + ("degrade",), f"[dla_tpu][pipeline] WARNING: "
+                       f"pipeline_microbatches={requested} does not divide "
+                       f"batch {batch}; degraded to M={m} ({n_stages} "
+                       f"stages -> bubble fraction {bubble:.0%})"
+                       + (" — stages run SERIALLY" if m == 1 else ""))
+    if m == 1 and n_stages > 1 and not requested:
+        _warn_once(key + ("serial",), f"[dla_tpu][pipeline] WARNING: batch {batch} has "
+                   f"no usable microbatch split; {n_stages} stages run "
+                   f"SERIALLY (bubble {(n_stages - 1) / n_stages:.0%}) — "
+                   "size the per-step batch to a multiple of "
+                   f"{dp_shards * 2} rows")
+    if dp_shards > 1 and (batch // m) % dp_shards != 0:
+        _warn_once(key + ("dp",), f"[dla_tpu][pipeline] WARNING: pipeline "
+                   f"microbatches of {batch // m} rows do not divide the "
+                   f"{dp_shards} batch shards; attention falls back to "
+                   "the replicated path and activations lose batch "
+                   "sharding for this shape")
+    return m

@@ -28,6 +28,23 @@ def test_70b_v5e256_config():
     assert cfg["model"]["model_name_or_path"] == "meta-llama/Llama-2-70b-hf"
 
 
+def test_70b_v5e256_pp_config():
+    cfg, sizes = _check("config/sft_llama2_70b_v5e256_pp.yaml", 256)
+    assert sizes == {"stage": 4, "data": 1, "fsdp": 8, "model": 8,
+                     "sequence": 1, "expert": 1}
+    # 80 layers split 4 stages; the configured M must divide the
+    # per-step global rows and hit the M >= 4S bubble target with
+    # microbatches that still split over the dp shards
+    from dla_tpu.ops.pipeline import resolve_microbatches
+    opt = cfg["optimization"]
+    rows = opt["micro_batch_size"] * sizes["fsdp"] * sizes["data"]
+    m = resolve_microbatches(rows, cfg["model"]["pipeline_microbatches"],
+                             sizes["stage"], dp_shards=sizes["fsdp"])
+    assert m == cfg["model"]["pipeline_microbatches"] == 16
+    assert m >= 4 * sizes["stage"]
+    assert (rows // m) % sizes["fsdp"] == 0
+
+
 def test_longcontext_32k_config():
     cfg, sizes = _check("config/sft_longcontext_32k.yaml", 32)
     assert sizes["sequence"] == 8
