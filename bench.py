@@ -59,7 +59,51 @@ PEAK_BF16_FLOPS = {
     "v4": 275e12, "v6": 918e12, "trillium": 918e12,
     "cpu": 5e11,
 }
+PEAK_HBM_BW = {
+    # per-chip HBM bandwidth, bytes/s (same substring match)
+    "v5 lite": 819e9, "v5e": 819e9, "v5p": 2765e9,
+    "v4": 1228e9, "v6": 1640e9, "trillium": 1640e9,
+    "cpu": 50e9,
+}
 BASELINE_MFU = 0.8 * 0.40  # 0.8x of a 40%-MFU H100-class DeepSpeed baseline
+# PPO baseline efficiency factors (BASELINE.md "PPO vs_baseline"): an
+# H100-class trl/DeepSpeed rollout+update loop modeled at 40% MFU on the
+# compute-bound phases (scoring forwards, update fwd+bwd) and 60% of HBM
+# bandwidth on the decode phase — generous to the baseline: the
+# reference's actual loop host-bounces between decode and scoring
+# (src/training/train_rlhf.py:123-147) and uses HF generate.
+PPO_BASELINE_MFU = 0.40
+PPO_BASELINE_BW_EFF = 0.60
+
+
+def hbm_bw(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_HBM_BW.items():
+        if key in kind:
+            return val
+    return 819e9 if device.platform != "cpu" else PEAK_HBM_BW["cpu"]
+
+
+def ppo_baseline_samples_per_sec(n_params: int, batch: int, prompt: int,
+                                 new_tokens: int, peak: float, bw: float,
+                                 lora: bool, epochs: int = 1) -> float:
+    """Hardware-normalized PPO rollout+update baseline, samples/s/chip.
+
+    Per-sample cost model of the reference loop's phases on THIS chip
+    with H100-class efficiency (the PPO analog of the SFT MFU bar):
+      decode  — bandwidth-bound: new_tokens param reads amortized over
+                the rollout batch,
+      score   — 3 forwards (policy logp, ref logp, RM) at 2*N FLOPs/tok,
+      update  — fwd+bwd at 6*N FLOPs/tok (4*N with LoRA: no base dW).
+    """
+    total_len = prompt + new_tokens
+    p_bytes = 2.0 * n_params  # bf16 weights
+    decode_s = new_tokens * p_bytes / (PPO_BASELINE_BW_EFF * bw * batch)
+    score_s = 3 * 2.0 * n_params * total_len / (PPO_BASELINE_MFU * peak)
+    upd_factor = 4.0 if lora else 6.0
+    update_s = (upd_factor * n_params * total_len * epochs
+                / (PPO_BASELINE_MFU * peak))
+    return 1.0 / (decode_s + score_s + update_s)
 
 
 def peak_flops(device) -> float:
@@ -221,10 +265,13 @@ def run_bench() -> dict:
 def run_ppo_bench() -> dict:
     """PPO rollout+update throughput, samples/sec — the second north-star
     metric BASELINE.json names ('PPO rollout+update samples/sec @7B'),
-    measured at bench scale: policy + frozen ref + reward model colocated
-    on the chip, jitted scan-decode rollout, on-device reinforce update.
-    Reported per chip (the v5e-256 number is this x utilization scaling,
-    not measured here)."""
+    measured at representative scale: a ~1.3B-param policy with LoRA
+    adapters (the HBM-fitting RLHF setup: frozen bf16 base ALIASED as
+    the reference model — one tree serves both — plus a 1.3B reward
+    model), jitted scan-decode rollout over merged weights, on-device
+    reinforce update of the adapters. vs_baseline normalizes against an
+    H100-class trl/DeepSpeed loop modeled on this chip's peak specs
+    (ppo_baseline_samples_per_sec)."""
     import jax
     import jax.numpy as jnp
     from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
@@ -241,10 +288,14 @@ def run_ppo_bench() -> dict:
 
     on_accel = jax.devices()[0].platform != "cpu"
     if on_accel:
+        # ~1.3B llama-shaped policy (2048 x 24L, GQA 16q/8kv, hd 128).
+        # bf16 base (frozen, shared policy/ref) + bf16 RM + one merged
+        # rollout copy + KV cache ~ 9.5G of a v5e's 16G HBM.
         cfg = ModelConfig(
-            vocab_size=32000, hidden_size=768, intermediate_size=2048,
-            num_layers=12, num_heads=6, num_kv_heads=3,
-            max_seq_length=512, remat="dots", attention="flash")
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=24, num_heads=16, num_kv_heads=8,
+            max_seq_length=512, remat="dots", attention="flash",
+            param_dtype="bfloat16", lora_r=16)
         # rollout batch 64 = the reference's own scale
         # (config/rlhf_config.yaml rollout_batch_size)
         batch, prompt_w, new_tokens, rollouts, warmup = 64, 128, 128, 3, 1
@@ -253,18 +304,17 @@ def run_ppo_bench() -> dict:
             vocab_size=512, hidden_size=64, intermediate_size=192,
             num_layers=2, num_heads=4, num_kv_heads=4,
             max_seq_length=128, remat="none", dtype="float32",
-            param_dtype="float32")
+            param_dtype="float32", lora_r=4)
         batch, prompt_w, new_tokens, rollouts, warmup = 4, 16, 16, 2, 1
 
     mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
     policy = Transformer(cfg)
-    ref = Transformer(cfg)
     rm = RewardModel(cfg)
     with jax.sharding.set_mesh(mesh):
-        params = policy.init(jax.random.key(0))
-        ref_params = jax.device_put(
-            ref.init(jax.random.key(1)),
-            sharding_tree(ref.partition_specs(), mesh))
+        specs = policy.partition_specs()
+        base = jax.device_put(policy.init(jax.random.key(0)),
+                              sharding_tree(specs, mesh))
+        adapters = policy.init_lora(jax.random.key(1))
         rm_params = jax.device_put(
             rm.init(jax.random.key(2)),
             sharding_tree(rm.partition_specs(), mesh))
@@ -280,13 +330,17 @@ def run_ppo_bench() -> dict:
         }
         trainer = Trainer(
             config=config, mesh=mesh,
-            loss_fn=make_policy_gradient_loss(policy, "reinforce", 0.2),
-            params=params, param_specs=policy.partition_specs())
+            loss_fn=make_policy_gradient_loss(policy, "reinforce", 0.2,
+                                              lora=True),
+            params=adapters, param_specs=policy.lora_partition_specs(),
+            frozen={"base": base}, frozen_specs={"base": specs})
         gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=True,
                                temperature=1.0, top_p=1.0,
                                eos_token_id=-1, pad_token_id=0)
         generate_fn = jax.jit(build_generate_fn(policy, gen))
-        score_fn = make_score_fn(policy, ref, rm)
+        # ref == frozen base (LoRA aliasing, train_rlhf.py:283-285)
+        score_fn = make_score_fn(policy, policy, rm)
+        merge_fn = jax.jit(policy.merge_lora)
 
         rs = np.random.RandomState(0)
         ids = rs.randint(1, cfg.vocab_size, (batch, prompt_w)).astype(np.int32)
@@ -295,9 +349,9 @@ def run_ppo_bench() -> dict:
         mask_d = jax.device_put(jnp.asarray(mask))
 
         def one_rollout(i):
-            out = generate_fn(trainer.params, ids_d, mask_d,
-                              jax.random.key(i))
-            scores = score_fn(trainer.params, ref_params, rm_params,
+            merged = merge_fn(base, trainer.params)
+            out = generate_fn(merged, ids_d, mask_d, jax.random.key(i))
+            scores = score_fn(merged, base, rm_params,
                               out["sequences"], out["sequence_mask"],
                               jnp.float32(0.1))
             up = {"sequences": out["sequences"],
@@ -313,14 +367,22 @@ def run_ppo_bench() -> dict:
             one_rollout(10 + i)
         dt = time.perf_counter() - t0
 
-    samples_s = batch * rollouts / dt
+    n_params = count_params(base)
+    samples_s = batch * rollouts / dt / jax.device_count()
+    dev = jax.devices()[0]
+    baseline = ppo_baseline_samples_per_sec(
+        n_params, batch, prompt_w, new_tokens,
+        peak_flops(dev), hbm_bw(dev), lora=True)
     return {
         "metric": "ppo_rollout_update_samples_per_sec_per_chip",
-        "value": round(samples_s / jax.device_count(), 3),
+        "value": round(samples_s, 3),
         "unit": "samples/s/chip",
+        "vs_baseline": round(samples_s / (0.8 * baseline), 4),
         "detail": {"batch": batch, "prompt_len": prompt_w,
-                   "new_tokens": new_tokens,
-                   "params_m": round(count_params(params) / 1e6)},
+                   "new_tokens": new_tokens, "lora_r": cfg.lora_r,
+                   "params_m": round(n_params / 1e6),
+                   "baseline_samples_s_chip": round(baseline, 2),
+                   "platform": dev.device_kind},
     }
 
 
@@ -442,7 +504,12 @@ def _emit_and_maybe_extra() -> None:
             res = {"metric": fn.__name__, "error": f"{type(e).__name__}: {e}"}
         print(json.dumps(res), file=sys.stderr)
         extra.append(res)
-    with open(os.path.join(_REPO_ROOT, "BENCH_extra.json"), "w") as fh:
+    # BENCH_extra.json is the on-chip evidence artifact BASELINE.md
+    # cites — a forced-CPU fallback run must not clobber it
+    import jax
+    name = ("BENCH_extra.json" if jax.devices()[0].platform != "cpu"
+            else "BENCH_extra_cpu.json")
+    with open(os.path.join(_REPO_ROOT, name), "w") as fh:
         json.dump(extra, fh, indent=1)
 
 

@@ -132,7 +132,9 @@ def evaluate_perplexity(bundle, bench_cfg: Dict, batch_size: int,
         h, _ = bundle.model.hidden_states_with_aux(
             p, b["input_ids"], attention_mask=b["attention_mask"])
         w, bias = bundle.model.unembed_params(p)
-        return fused_cross_entropy_loss(h, w, b["labels"], bias=bias)
+        return fused_cross_entropy_loss(
+            h, w, b["labels"], bias=bias,
+            softcap=bundle.model.cfg.final_logit_softcap)
 
     step = jax.jit(ce_only)
     total_nll, total_tok = 0.0, 0

@@ -39,6 +39,10 @@ class ModelConfig:
     #            scaled by sqrt(hidden) on read, tied unembedding, and
     #            (1+w) RMSNorm — the +1 folds into the stored weights at
     #            import/init so the norm path stays shared (gemma-1)
+    #   "gemma2" gemma plus: post-attention and post-feedforward norms
+    #            (four RMSNorms per block), attention-score and final
+    #            -logit softcapping, query_pre_attn_scalar softmax scale,
+    #            and alternating-layer sliding window (pattern 2)
     arch: str = "llama"
     # fraction of head_dim that rotates (phi-2: 0.4); 1.0 = full RoPE
     rotary_pct: float = 1.0
@@ -54,6 +58,19 @@ class ModelConfig:
     # isn't known here, and context_parallel is a harmless default
     # otherwise).
     sliding_window: Optional[int] = None
+    # Alternating-layer SWA (gemma-2/-3): layer l uses the sliding
+    # window iff (l + 1) % pattern != 0 — pattern 2 = every other layer
+    # windowed starting at layer 0 (HF Gemma2's is_sliding), pattern 1 =
+    # uniform (every layer windowed when sliding_window is set).
+    sliding_window_pattern: int = 1
+    # gemma-2 softcaps: scores <- cap * tanh(scores / cap) before the
+    # softmax (attn) / at the unembedding (final). 0 = off.
+    attn_logit_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
+    # gemma-2 attention scale: softmax scale = query_pre_attn_scalar
+    # ** -0.5 (HF Gemma2Config; 27B uses hidden/num_heads != head_dim).
+    # None = the usual head_dim ** -0.5.
+    query_pre_attn_scalar: Optional[int] = None
     # numerics
     dtype: str = "bfloat16"             # activation dtype
     param_dtype: str = "float32"        # master param dtype
@@ -201,6 +218,20 @@ register_model("gemma-7b", ModelConfig(
     num_layers=28, num_heads=16, num_kv_heads=16, head_dim=256,
     rms_norm_eps=1e-6, tie_embeddings=True, max_seq_length=8192,
     arch="gemma"))
+register_model("gemma2-2b", ModelConfig(
+    vocab_size=256000, hidden_size=2304, intermediate_size=9216,
+    num_layers=26, num_heads=8, num_kv_heads=4, head_dim=256,
+    rms_norm_eps=1e-6, tie_embeddings=True, max_seq_length=8192,
+    arch="gemma2", sliding_window=4096, sliding_window_pattern=2,
+    attn_logit_softcap=50.0, final_logit_softcap=30.0,
+    query_pre_attn_scalar=256))  # HF google/gemma-2-2b config.json
+register_model("gemma2-9b", ModelConfig(
+    vocab_size=256000, hidden_size=3584, intermediate_size=14336,
+    num_layers=42, num_heads=16, num_kv_heads=8, head_dim=256,
+    rms_norm_eps=1e-6, tie_embeddings=True, max_seq_length=8192,
+    arch="gemma2", sliding_window=4096, sliding_window_pattern=2,
+    attn_logit_softcap=50.0, final_logit_softcap=30.0,
+    query_pre_attn_scalar=256))
 register_model("llama3-8b", ModelConfig(
     vocab_size=128256, hidden_size=4096, intermediate_size=14336,
     num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
@@ -253,6 +284,8 @@ register_model("tiny-moe", ModelConfig(
 # HF repo-id aliases so reference configs keep working verbatim
 register_model("google/gemma-2b", _REGISTRY["gemma-2b"])
 register_model("google/gemma-7b", _REGISTRY["gemma-7b"])
+register_model("google/gemma-2-2b", _REGISTRY["gemma2-2b"])
+register_model("google/gemma-2-9b", _REGISTRY["gemma2-9b"])
 register_model("meta-llama/Meta-Llama-3-8B", _REGISTRY["llama3-8b"])
 register_model("meta-llama/Llama-3.1-8B", _REGISTRY["llama3.1-8b"])
 register_model("meta-llama/Meta-Llama-3-70B", _REGISTRY["llama3-70b"])

@@ -36,6 +36,8 @@ def _hf_model_type(cfg: ModelConfig) -> str:
         return "phi"
     if cfg.arch == "gemma":
         return "gemma"
+    if cfg.arch == "gemma2":
+        return "gemma2"
     if cfg.num_experts > 0:
         return "mixtral"
     # attention_bias wins over sliding_window: MistralForCausalLM defines
@@ -60,6 +62,7 @@ def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
                            "mistral": "MistralForCausalLM",
                            "qwen2": "Qwen2ForCausalLM",
                            "gemma": "GemmaForCausalLM",
+                           "gemma2": "Gemma2ForCausalLM",
                            "llama": "LlamaForCausalLM"}[_hf_model_type(cfg)]],
         "model_type": _hf_model_type(cfg),
         "vocab_size": cfg.vocab_size,
@@ -73,10 +76,18 @@ def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
         "rms_norm_eps": cfg.rms_norm_eps,
         "tie_word_embeddings": cfg.tie_embeddings,
         "max_position_embeddings": cfg.max_seq_length,
-        "hidden_act": ("gelu_pytorch_tanh" if cfg.arch == "gemma"
-                       else "silu"),
+        "hidden_act": ("gelu_pytorch_tanh"
+                       if cfg.arch in ("gemma", "gemma2") else "silu"),
         "torch_dtype": "float32",
     }
+    if cfg.arch == "gemma2":
+        # Gemma2Config reads hidden_activation (hidden_act is the
+        # legacy key other families use)
+        out["hidden_activation"] = "gelu_pytorch_tanh"
+        out["attn_logit_softcapping"] = cfg.attn_logit_softcap or None
+        out["final_logit_softcapping"] = cfg.final_logit_softcap or None
+        if cfg.query_pre_attn_scalar:
+            out["query_pre_attn_scalar"] = int(cfg.query_pre_attn_scalar)
     if cfg.attention_bias:
         out["attention_bias"] = True
     if cfg.rope_scaling:
@@ -117,7 +128,8 @@ def export_hf_weights(params: Dict[str, Any], cfg: ModelConfig,
     # gemma stores norms centered at 0 (runtime computes x * (1 + w));
     # this framework folds the +1 into the weights at import/init, so
     # export subtracts it back out
-    off = np.float32(1.0) if cfg.arch == "gemma" else np.float32(0.0)
+    off = np.float32(1.0) if cfg.arch in ("gemma", "gemma2") \
+        else np.float32(0.0)
 
     def norm(x) -> np.ndarray:
         return host(x) - off
@@ -137,8 +149,16 @@ def export_hf_weights(params: Dict[str, Any], cfg: ModelConfig,
             sd[p + "self_attn.q_proj.bias"] = host(layers["wq_bias"][i])
             sd[p + "self_attn.k_proj.bias"] = host(layers["wk_bias"][i])
             sd[p + "self_attn.v_proj.bias"] = host(layers["wv_bias"][i])
-        sd[p + "post_attention_layernorm.weight"] = norm(
-            layers["mlp_norm"][i])
+        if cfg.arch == "gemma2":
+            sd[p + "post_attention_layernorm.weight"] = norm(
+                layers["attn_post_norm"][i])
+            sd[p + "pre_feedforward_layernorm.weight"] = norm(
+                layers["mlp_norm"][i])
+            sd[p + "post_feedforward_layernorm.weight"] = norm(
+                layers["mlp_post_norm"][i])
+        else:
+            sd[p + "post_attention_layernorm.weight"] = norm(
+                layers["mlp_norm"][i])
         if moe:
             m = p + "block_sparse_moe."
             sd[m + "gate.weight"] = linear(layers["router"][i])

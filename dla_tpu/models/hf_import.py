@@ -35,10 +35,6 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
     model_type = str(hf_cfg.get("model_type", "")).lower()
     if model_type == "phi":
         return _phi_config(hf_cfg, overrides)
-    if model_type == "gemma2":
-        raise NotImplementedError(
-            "gemma-2 (logit softcapping, alternating-layer SWA, pre+post "
-            "norms) is not supported; gemma-1 is (model_type 'gemma')")
     n_heads = int(hf_cfg["num_attention_heads"])
     fields = dict(
         vocab_size=int(hf_cfg["vocab_size"]),
@@ -67,6 +63,26 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
         fields["arch"] = "gemma"
         fields["tie_embeddings"] = bool(
             hf_cfg.get("tie_word_embeddings", True))
+    if model_type == "gemma2":
+        # gemma plus: post-attn/post-ffw norms (4 RMSNorms per block),
+        # attention + final logit softcapping, query_pre_attn_scalar
+        # softmax scale, and alternating-layer SWA (even layers slide —
+        # HF Gemma2's is_sliding = not layer_idx % 2 == pattern 2 with
+        # the (l+1) % pattern != 0 rule). Gemma2Config has no
+        # use_sliding_window knob: a set sliding_window always applies.
+        fields["arch"] = "gemma2"
+        fields["tie_embeddings"] = bool(
+            hf_cfg.get("tie_word_embeddings", True))
+        fields["attn_logit_softcap"] = float(
+            hf_cfg.get("attn_logit_softcapping") or 0.0)
+        fields["final_logit_softcap"] = float(
+            hf_cfg.get("final_logit_softcapping") or 0.0)
+        qpas = hf_cfg.get("query_pre_attn_scalar")
+        if qpas:
+            fields["query_pre_attn_scalar"] = int(qpas)
+        if hf_cfg.get("sliding_window"):
+            fields["sliding_window"] = int(hf_cfg["sliding_window"])
+            fields["sliding_window_pattern"] = 2
     if model_type == "mixtral" or "num_local_experts" in hf_cfg:
         fields["num_experts"] = int(hf_cfg.get("num_local_experts", 8))
         fields["num_experts_per_token"] = int(
@@ -186,9 +202,13 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
 
     L = cfg.num_layers
     moe = cfg.num_experts > 0
+    gemma2 = cfg.arch == "gemma2"
     stacked: Dict[str, list] = {k: [] for k in (
         "attn_norm", "wq", "wk", "wv", "wo",
         "mlp_norm", "w_gate", "w_up", "w_down")}
+    if gemma2:
+        stacked["attn_post_norm"] = []
+        stacked["mlp_post_norm"] = []
     if moe:
         stacked["router"] = []
     if cfg.attention_bias:
@@ -208,8 +228,19 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
             stacked["wv_bias"].append(
                 take(p + "self_attn.v_proj.bias").astype(pdtype))
         stacked["wo"].append(linear(p + "self_attn.o_proj.weight"))
-        stacked["mlp_norm"].append(
-            take(p + "post_attention_layernorm.weight").astype(pdtype))
+        if gemma2:
+            # gemma-2 norm names: post_attention_layernorm normalizes the
+            # attention OUTPUT (pre-residual); the MLP's pre-norm is
+            # pre_feedforward_layernorm
+            stacked["attn_post_norm"].append(
+                take(p + "post_attention_layernorm.weight").astype(pdtype))
+            stacked["mlp_norm"].append(
+                take(p + "pre_feedforward_layernorm.weight").astype(pdtype))
+            stacked["mlp_post_norm"].append(
+                take(p + "post_feedforward_layernorm.weight").astype(pdtype))
+        else:
+            stacked["mlp_norm"].append(
+                take(p + "post_attention_layernorm.weight").astype(pdtype))
         if moe:
             # Mixtral MoE layout: block_sparse_moe.gate -> router,
             # experts.j.{w1,w3,w2} -> per-expert gate/up/down, stacked
@@ -235,10 +266,12 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
         "layers": {k: np.stack(v) for k, v in stacked.items()},
         "final_norm": take("norm.weight").astype(pdtype),
     }
-    if cfg.arch == "gemma":
+    if cfg.arch in ("gemma", "gemma2"):
         # HF gemma RMSNorm computes x * (1 + w); fold the +1 here so the
         # model's shared rms_norm path needs no arch branch
-        for k in ("attn_norm", "mlp_norm"):
+        norm_keys = ("attn_norm", "mlp_norm") if cfg.arch == "gemma" else (
+            "attn_norm", "mlp_norm", "attn_post_norm", "mlp_post_norm")
+        for k in norm_keys:
             params["layers"][k] = params["layers"][k] + np.asarray(1, pdtype)
         params["final_norm"] = params["final_norm"] + np.asarray(1, pdtype)
     if not cfg.tie_embeddings:

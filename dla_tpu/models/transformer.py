@@ -123,6 +123,11 @@ class Transformer:
         self.cfg = cfg
         self.adtype = jnp.dtype(cfg.dtype)
         self.pdtype = jnp.dtype(cfg.param_dtype)
+        # gemma-2 scales attention by query_pre_attn_scalar**-0.5 (which
+        # differs from head_dim**-0.5 on the 27B); None = op default
+        self._softmax_scale = (
+            cfg.query_pre_attn_scalar ** -0.5
+            if cfg.query_pre_attn_scalar else None)
         if (cfg.sliding_window and cfg.context_parallel == "ulysses"
                 and _sequence_axis_size() > 1):
             # fail at model construction (trainers build models under the
@@ -203,6 +208,9 @@ class Transformer:
             },
             "final_norm": jnp.ones((D,), self.pdtype),
         }
+        if cfg.arch == "gemma2":  # post-attn / post-ffw norms (4 per block)
+            params["layers"]["attn_post_norm"] = jnp.ones((L, D), self.pdtype)
+            params["layers"]["mlp_post_norm"] = jnp.ones((L, D), self.pdtype)
         if cfg.attention_bias:  # qwen2-style q/k/v biases
             params["layers"]["wq_bias"] = jnp.zeros((L, qdim), self.pdtype)
             params["layers"]["wk_bias"] = jnp.zeros((L, kvdim), self.pdtype)
@@ -372,6 +380,9 @@ class Transformer:
             },
             "final_norm": P(None),
         }
+        if self.cfg.arch == "gemma2":
+            specs["layers"]["attn_post_norm"] = P("stage", None)
+            specs["layers"]["mlp_post_norm"] = P("stage", None)
         if self.cfg.attention_bias:
             specs["layers"]["wq_bias"] = P("stage", "model")
             specs["layers"]["wk_bias"] = P("stage", "model")
@@ -428,7 +439,8 @@ class Transformer:
             k, v = kv_override
         attn = self._attention(q, k, v, kv_segment_mask,
                                q_positions, kv_positions, allow_flash, cp,
-                               flash_segs=flash_segs)
+                               flash_segs=flash_segs,
+                               window=self._layer_window(layer))
         attn = attn.reshape(b, t, cfg.num_heads * dh)
 
         if cfg.arch == "phi":
@@ -439,9 +451,16 @@ class Transformer:
             mlp_out = _constrain(proj("fc2", ff), ACT_SPEC)
             return x + attn_out + mlp_out, new_kv, None
 
-        x = x + _constrain(proj("wo", attn), ACT_SPEC)
+        attn_out = proj("wo", attn)
+        if cfg.arch == "gemma2":  # post-attn norm BEFORE the residual add
+            attn_out = rms_norm(attn_out, layer["attn_post_norm"],
+                                cfg.rms_norm_eps)
+        x = x + _constrain(attn_out, ACT_SPEC)
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         mlp_out, moe_aux = self._mlp(layer, h, proj, token_valid)
+        if cfg.arch == "gemma2":
+            mlp_out = rms_norm(mlp_out, layer["mlp_post_norm"],
+                               cfg.rms_norm_eps)
         x = x + _constrain(mlp_out, ACT_SPEC)
         return x, new_kv, moe_aux
 
@@ -460,7 +479,7 @@ class Transformer:
                 capacity_factor=self.cfg.moe_capacity_factor,
                 valid=token_valid, group_size=self.cfg.moe_group_size)
             return out, aux
-        if self.cfg.arch == "gemma":
+        if self.cfg.arch in ("gemma", "gemma2"):
             gate = jax.nn.gelu(proj("w_gate", h), approximate=True)
         else:
             gate = jax.nn.silu(proj("w_gate", h))
@@ -468,9 +487,47 @@ class Transformer:
         ff = _constrain(gate * up, P(("data", "fsdp"), "sequence", "model"))
         return proj("w_down", ff), None
 
+    def _flash_eligible(self, t: int) -> bool:
+        """Whether the Pallas flash kernel may serve a full-sequence
+        forward of length t for THIS config: the kernel speaks neither
+        softcapping, per-layer windows, nor a non-default softmax scale
+        (gemma-2) — those take the XLA path. One predicate shared by
+        apply() and prefill() so the two gates cannot diverge."""
+        cfg = self.cfg
+        return (cfg.attention == "flash" and _flash_tileable(t)
+                and not cfg.attn_logit_softcap
+                and cfg.sliding_window_pattern == 1
+                and cfg.query_pre_attn_scalar is None)
+
+    def _with_layer_windows(self, layers: Params) -> Params:
+        """Inject the per-layer SWA flag into the scan stream for
+        alternating-window archs (gemma-2: layer l slides iff
+        (l+1) % pattern != 0, HF Gemma2's is_sliding). Not a param —
+        rides the scan xs like the LoRA dropout keys."""
+        cfg = self.cfg
+        if not (cfg.sliding_window and cfg.sliding_window_pattern > 1):
+            return layers
+        win = ((jnp.arange(cfg.num_layers) + 1)
+               % cfg.sliding_window_pattern != 0)
+        return {**layers, "swa_on": win}
+
+    def _layer_window(self, layer: Params):
+        """Effective window for a layer: the static config window, or —
+        when the per-layer ``swa_on`` flag rides the scan (gemma-2
+        alternating SWA) — a TRACED scalar that is the window on sliding
+        layers and an unreachable bound on full-attention layers (one
+        code path, no lax.cond in the scan body)."""
+        cfg = self.cfg
+        swa_on = layer.get("swa_on") if isinstance(layer, dict) else None
+        if swa_on is None:
+            return cfg.sliding_window or None
+        return jnp.where(swa_on, jnp.int32(cfg.sliding_window),
+                         jnp.int32(2 ** 30))
+
     def _attention(self, q, k, v, kv_segment_mask, q_positions, kv_positions,
                    allow_flash: bool = False, cp: Optional[Tuple] = None,
-                   flash_segs: Optional[jnp.ndarray] = None):
+                   flash_segs: Optional[jnp.ndarray] = None,
+                   window=None):
         """Pick the attention backend. The pallas flash kernel handles the
         full-sequence causal path on contiguous right-padded batches whose
         length tiles its blocks — including packed batches, whose segment
@@ -487,6 +544,12 @@ class Transformer:
             mode, kv_valid, seg, gapped = cp
             if self.cfg.sliding_window and mode == "ulysses":
                 raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
+            if self.cfg.sliding_window_pattern > 1:
+                raise NotImplementedError(
+                    "alternating-layer sliding window (gemma-2) under "
+                    "context parallelism is not supported yet; run "
+                    "without a sequence axis or use max_seq within one "
+                    "chip's attention")
             if mode == "ulysses":
                 from dla_tpu.ops.ulysses import ulysses_causal_attention
                 return ulysses_causal_attention(
@@ -509,7 +572,10 @@ class Transformer:
         return causal_attention(
             q, k, v, kv_segment_mask=kv_segment_mask,
             q_positions=q_positions, kv_positions=kv_positions,
-            window=self.cfg.sliding_window or None)
+            window=window if window is not None
+            else (self.cfg.sliding_window or None),
+            softmax_scale=self._softmax_scale,
+            logit_softcap=self.cfg.attn_logit_softcap)
 
     def _flash(self, q, k, v, segs: Optional[Tuple]):
         """Invoke the pallas flash kernel, shard_map-wrapped when the
@@ -693,8 +759,8 @@ class Transformer:
         # partial-manual shard_map over the still-auto batch/head axes
         # (round-3 verdict item 5 — PP no longer forces XLA attention).
         n_stages = _stage_axis_size()
-        allow_flash = (cfg.attention == "flash" and not gapped_mask
-                       and cp is None and _flash_tileable(t))
+        allow_flash = (not gapped_mask and cp is None
+                       and self._flash_eligible(t))
         flash_segs = None
         if allow_flash and segment_ids is not None:
             # broadcast to the kernel's tileable layouts ONCE, outside the
@@ -728,6 +794,7 @@ class Transformer:
             layers = {**layers, **lora["layers"]}
             if dropout_rng is not None and cfg.lora_dropout > 0:
                 keys = jax.random.split(dropout_rng, cfg.num_layers)
+        layers = self._with_layer_windows(layers)
 
         if n_stages > 1:
             # pipeline parallelism: layer stack sharded over `stage`,
@@ -860,7 +927,7 @@ class Transformer:
         unembedding stays unscaled."""
         x = jnp.take(params["embed"]["embedding"], ids, axis=0
                      ).astype(self.adtype)
-        if self.cfg.arch == "gemma":
+        if self.cfg.arch in ("gemma", "gemma2"):
             x = x * jnp.asarray(self.cfg.hidden_size ** 0.5, self.adtype)
         return x
 
@@ -878,11 +945,18 @@ class Transformer:
         return w, None if bias is None else bias.astype(self.adtype)
 
     def unembed(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
-        """[..., D] -> [..., V] logits (activation dtype; cast at the loss)."""
+        """[..., D] -> [..., V] logits (activation dtype; cast at the loss).
+        gemma-2 softcaps final logits: cap * tanh(logits / cap) — applied
+        here AND in the chunked fused-CE path (ops.fused_ce reads
+        cfg.final_logit_softcap through model.cfg)."""
         w, bias = self.unembed_params(params)
         logits = hidden @ w
         if bias is not None:
             logits = logits + bias
+        cap = self.cfg.final_logit_softcap
+        if cap:
+            logits = (jnp.tanh(logits / jnp.asarray(cap, logits.dtype))
+                      * jnp.asarray(cap, logits.dtype))
         return logits
 
     def apply(self, params: Params, input_ids: jnp.ndarray,
@@ -942,7 +1016,7 @@ class Transformer:
         cfg = self.cfg
         b, t = input_ids.shape
         positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
-        flash_ok = cfg.attention == "flash" and _flash_tileable(t)
+        flash_ok = self._flash_eligible(t)
         kv_mask = None if flash_ok else jnp.broadcast_to(
             attention_mask[:, None, :].astype(bool), (b, t, t))
         x = self._embed(params, input_ids)
@@ -956,7 +1030,8 @@ class Transformer:
                                    token_valid=attention_mask)
             return h, kv
 
-        x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+        x, (ks, vs) = jax.lax.scan(
+            body, x, self._with_layer_windows(params["layers"]))
         h = self._final_norm(params, x)
 
         lengths = attention_mask.astype(jnp.int32).sum(axis=1)
@@ -1037,19 +1112,30 @@ class Transformer:
                 q, k_cache, v_cache, k, v,
                 kv_valid=cache["valid"],
                 q_positions=positions, kv_positions=kv_pos,
-                window=cfg.sliding_window or None)
+                window=self._layer_window(layer),
+                softmax_scale=self._softmax_scale,
+                logit_softcap=cfg.attn_logit_softcap)
             attn = attn.reshape(b, 1, cfg.num_heads * dh)
             if cfg.arch == "phi":
                 ff = jax.nn.gelu(proj("fc1", hn), approximate=True)
                 x2 = h_in + proj("wo", attn) + proj("fc2", ff)
                 return x2, (k, v)
-            x1 = h_in + proj("wo", attn)
+            attn_out = proj("wo", attn)
+            if cfg.arch == "gemma2":
+                attn_out = rms_norm(attn_out, layer["attn_post_norm"],
+                                    cfg.rms_norm_eps)
+            x1 = h_in + attn_out
             hn2 = rms_norm(x1, layer["mlp_norm"], cfg.rms_norm_eps)
-            x2 = x1 + self._mlp(layer, hn2, proj)[0]  # aux unused at decode
+            mlp_out = self._mlp(layer, hn2, proj)[0]  # aux unused at decode
+            if cfg.arch == "gemma2":
+                mlp_out = rms_norm(mlp_out, layer["mlp_post_norm"],
+                                   cfg.rms_norm_eps)
+            x2 = x1 + mlp_out
             return x2, (k, v)
 
         x, (k_cols, v_cols) = jax.lax.scan(
-            body2, x, (params["layers"], cache["k"], cache["v"]))
+            body2, x, (self._with_layer_windows(params["layers"]),
+                       cache["k"], cache["v"]))
         h = self._final_norm(params, x)
         logits = self.unembed(params, h[:, 0])
 

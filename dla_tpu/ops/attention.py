@@ -31,6 +31,7 @@ def causal_attention(
     causal: bool = True,
     softmax_scale: Optional[float] = None,
     window: Optional[int] = None,  # sliding window: attend (q-window, q]
+    logit_softcap: float = 0.0,    # gemma-2: cap*tanh(scores/cap) pre-mask
 ) -> jnp.ndarray:
     """Grouped-query causal attention. Returns [B, T, H, D].
 
@@ -39,7 +40,9 @@ def causal_attention(
     single-token decode against a KV cache (q_positions = current step).
     ``window`` adds mistral-style sliding-window attention (HF
     ``sliding_window``): token q attends only kv positions in
-    (q - window, q]. Position-based, so it is decode-correct too.
+    (q - window, q]. Position-based, so it is decode-correct too —
+    and it may be a TRACED scalar (gemma-2's alternating-layer window
+    rides the layer scan as data).
     """
     b, t, h, d = q.shape
     _, s, kheads, _ = k.shape
@@ -49,6 +52,8 @@ def causal_attention(
     qg = q.reshape(b, t, kheads, groups, d)
     # scores [B, K, G, T, S]
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
+    if logit_softcap:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
 
     if window is not None and not causal:
         raise ValueError("window implements causal sliding-window "
@@ -89,6 +94,7 @@ def decode_attention(
     kv_positions: jnp.ndarray,    # [B, S] logical position per cache column
     softmax_scale: Optional[float] = None,
     window: Optional[int] = None,
+    logit_softcap: float = 0.0,
 ) -> jnp.ndarray:
     """Single-token attention over an un-updated KV cache plus the
     just-computed key/value, WITHOUT writing the cache.
@@ -116,6 +122,8 @@ def decode_attention(
     # [B, K, G, S] scores against the existing cache
     scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache)
     scores = scores.astype(jnp.float32) * scale
+    if logit_softcap:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
     delta = q_positions - kv_positions            # [B, S]
     mask = kv_valid.astype(bool) & (delta >= 0)
     if window is not None:
@@ -124,6 +132,8 @@ def decode_attention(
     # [B, K, G, 1] the new token's self-score
     self_score = jnp.einsum("bkgd,bkd->bkg", qg, k_new[:, 0]
                             )[..., None].astype(jnp.float32) * scale
+    if logit_softcap:
+        self_score = logit_softcap * jnp.tanh(self_score / logit_softcap)
 
     joint = jnp.concatenate([scores, self_score], axis=-1)  # [B,K,G,S+1]
     joint = joint - jnp.max(joint, axis=-1, keepdims=True)

@@ -39,22 +39,25 @@ def _pad_rows(x: jnp.ndarray, chunk: int) -> jnp.ndarray:
     return jnp.pad(x, widths)
 
 
-def _logits_tile(h, w, bias):
-    """[chunk, D] @ [D, V] in the input dtype with fp32 accumulation."""
+def _logits_tile(h, w, bias, softcap=0.0):
+    """[chunk, D] @ [D, V] in the input dtype with fp32 accumulation.
+    ``softcap`` applies gemma-2's cap * tanh(logits / cap)."""
     logits = jax.lax.dot_general(
         h, w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
+    if softcap:
+        logits = softcap * jnp.tanh(logits / softcap)
     return logits
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _fused_logprobs(hidden2d, w, bias, targets1d, chunk):
-    return _fused_fwd(hidden2d, w, bias, targets1d, chunk)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_logprobs(hidden2d, w, bias, targets1d, chunk, softcap=0.0):
+    return _fused_fwd(hidden2d, w, bias, targets1d, chunk, softcap)[0]
 
 
-def _fused_fwd(hidden2d, w, bias, targets1d, chunk):
+def _fused_fwd(hidden2d, w, bias, targets1d, chunk, softcap=0.0):
     n = hidden2d.shape[0]
     chunk = min(chunk, n) if n else 1
     hp = _pad_rows(hidden2d, chunk)
@@ -65,7 +68,7 @@ def _fused_fwd(hidden2d, w, bias, targets1d, chunk):
 
     def body(_, xs):
         h, t = xs
-        logits = _logits_tile(h, w, bias)                 # [chunk, V] fp32
+        logits = _logits_tile(h, w, bias, softcap)        # [chunk, V] fp32
         m = jnp.max(logits, axis=-1)
         lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
         picked = jnp.take_along_axis(logits, t[:, None], axis=1)[:, 0]
@@ -77,7 +80,7 @@ def _fused_fwd(hidden2d, w, bias, targets1d, chunk):
     return logp, (hidden2d, w, bias, targets1d, lse)
 
 
-def _fused_bwd(chunk, res, g):
+def _fused_bwd(chunk, softcap, res, g):
     hidden2d, w, bias, targets1d, lse = res
     n, d = hidden2d.shape
     v = w.shape[1]
@@ -95,10 +98,13 @@ def _fused_bwd(chunk, res, g):
     def body(carry, xs):
         dw_acc, db_acc = carry
         h, t, gg, ls = xs
-        logits = _logits_tile(h, w, bias)                 # recompute tile
+        logits = _logits_tile(h, w, bias, softcap)        # recompute tile
         p = jnp.exp(logits - ls[:, None])                 # softmax, fp32
         onehot = jax.nn.one_hot(t, v, dtype=jnp.float32)
         dl = (onehot - p) * gg[:, None]                   # [chunk, V] fp32
+        if softcap:
+            # chain through z = cap*tanh(raw/cap): dz/draw = 1 - (z/cap)^2
+            dl = dl * (1.0 - jnp.square(logits / softcap))
         dlc = dl.astype(w.dtype)                          # MXU dtype
         dh = jax.lax.dot_general(                         # [chunk, D]
             dlc, w, (((1,), (1,)), ((), ())),
@@ -137,8 +143,9 @@ def model_fused_ce(model, params, batch, lora=None, dropout_rng=None,
         segment_ids=batch.get("segment_ids"),
         lora=lora, dropout_rng=dropout_rng)
     w, bias = model.unembed_params(params)
-    loss, n = fused_cross_entropy_loss(h, w, batch["labels"], bias=bias,
-                                       chunk=chunk)
+    loss, n = fused_cross_entropy_loss(
+        h, w, batch["labels"], bias=bias, chunk=chunk,
+        softcap=model.cfg.final_logit_softcap)
     return loss + weighted_moe_aux(model, moe_aux), n
 
 
@@ -170,8 +177,9 @@ def model_fused_sequence_logprob(model, params, input_ids, attention_mask,
         params, input_ids, attention_mask=attention_mask,
         lora=lora, dropout_rng=dropout_rng)
     w, bias = model.unembed_params(params)
-    logp = fused_sequence_logprob_mean(h, w, input_ids, attention_mask,
-                                       bias=bias, chunk=chunk)
+    logp = fused_sequence_logprob_mean(
+        h, w, input_ids, attention_mask, bias=bias, chunk=chunk,
+        softcap=model.cfg.final_logit_softcap)
     return (logp, moe_aux) if with_aux else logp
 
 
@@ -181,6 +189,7 @@ def fused_token_logprobs(
     targets: jnp.ndarray,         # [B, T] int
     bias: Optional[jnp.ndarray] = None,  # [V]
     chunk: int = DEFAULT_CHUNK,
+    softcap: float = 0.0,         # gemma-2 final-logit softcap
 ) -> jnp.ndarray:
     """log p(target) per token, [B, T] fp32 — equal to
     ``token_logprobs(hidden @ w + bias, targets)`` without ever holding
@@ -189,7 +198,7 @@ def fused_token_logprobs(
     b, t, d = hidden.shape
     logp = _fused_logprobs(
         hidden.reshape(b * t, d), w, bias,
-        jnp.clip(targets, 0).reshape(b * t), chunk)
+        jnp.clip(targets, 0).reshape(b * t), chunk, softcap)
     return logp.reshape(b, t)
 
 
@@ -199,6 +208,7 @@ def fused_cross_entropy_loss(
     labels: jnp.ndarray,          # [B, T] with IGNORE_INDEX masking
     bias: Optional[jnp.ndarray] = None,
     chunk: int = DEFAULT_CHUNK,
+    softcap: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Token-mean next-token CE from hidden states (SFT objective):
     drop-in for ``cross_entropy_loss(unembed(hidden), labels)`` with the
@@ -208,7 +218,7 @@ def fused_cross_entropy_loss(
     hidden_s = hidden[:, :-1, :]
     labels_s = labels[:, 1:]
     valid = labels_s != IGNORE_INDEX
-    logp = fused_token_logprobs(hidden_s, w, labels_s, bias, chunk)
+    logp = fused_token_logprobs(hidden_s, w, labels_s, bias, chunk, softcap)
     n = jnp.sum(valid)
     loss = -jnp.sum(logp * valid) / jnp.maximum(n, 1)
     return loss, n
@@ -224,6 +234,8 @@ def fused_kl_distill_loss(
     student_bias: Optional[jnp.ndarray] = None,
     teacher_biases=None,                  # list of [V] or None
     chunk: int = DEFAULT_CHUNK,
+    student_softcap: float = 0.0,         # gemma-2 final-logit softcaps
+    teacher_softcaps=None,                # list of float or None
 ) -> jnp.ndarray:
     """Forward KL(mean-of-teachers || student), token-masked mean, from
     hidden states — sequence-chunked so no [B, T, V] fp32 probability
@@ -240,6 +252,8 @@ def fused_kl_distill_loss(
     b, t, d_s = student_hidden.shape
     if teacher_biases is None:
         teacher_biases = [None] * len(teacher_hiddens)
+    if teacher_softcaps is None:
+        teacher_softcaps = [0.0] * len(teacher_hiddens)
     n = b * (t - 1)
     chunk = min(chunk, n) if n else 1
     m = _pad_rows(mask[:, 1:].reshape(n).astype(jnp.float32), chunk)
@@ -253,11 +267,13 @@ def fused_kl_distill_loss(
     def body(carry, xs):
         kl_sum, w_sum = carry
         h_s, m_c, h_ts = xs
-        s_logits = _logits_tile(h_s, student_w, student_bias) / temperature
+        s_logits = _logits_tile(h_s, student_w, student_bias,
+                                student_softcap) / temperature
         s_logp = jax.nn.log_softmax(s_logits, axis=-1)
         t_prob = None
-        for h_t, tw, tb in zip(h_ts, teacher_ws, teacher_biases):
-            p = jax.nn.softmax(_logits_tile(h_t, tw, tb) / temperature,
+        for h_t, tw, tb, tc in zip(h_ts, teacher_ws, teacher_biases,
+                                   teacher_softcaps):
+            p = jax.nn.softmax(_logits_tile(h_t, tw, tb, tc) / temperature,
                                axis=-1)
             t_prob = p if t_prob is None else t_prob + p
         t_prob = t_prob / len(teacher_ws)
@@ -278,6 +294,7 @@ def fused_sequence_logprob_mean(
     mask: jnp.ndarray,            # [B, T] 1 = real token
     bias: Optional[jnp.ndarray] = None,
     chunk: int = DEFAULT_CHUNK,
+    softcap: float = 0.0,
 ) -> jnp.ndarray:
     """Length-normalized mean per-token sequence logp, [B] fp32 — the
     DPO/RLHF objective (reference train_dpo.py:31-39 math) computed
@@ -285,5 +302,5 @@ def fused_sequence_logprob_mean(
     hidden_s = hidden[:, :-1, :]
     targets = input_ids[:, 1:]
     m = mask[:, 1:].astype(jnp.float32)
-    logp = fused_token_logprobs(hidden_s, w, targets, bias, chunk)
+    logp = fused_token_logprobs(hidden_s, w, targets, bias, chunk, softcap)
     return jnp.sum(logp * m, axis=-1) / (jnp.sum(m, axis=-1) + 1e-8)

@@ -181,12 +181,19 @@ def resolve_microbatches(batch: int, requested: Optional[int],
                        f"batch {batch}; degraded to M={m} ({n_stages} "
                        f"stages -> bubble fraction {bubble:.0%})"
                        + (" — stages run SERIALLY" if m == 1 else ""))
-    if m == 1 and n_stages > 1 and not requested:
-        _warn_once(key + ("serial",), f"[dla_tpu][pipeline] WARNING: batch {batch} has "
-                   f"no usable microbatch split; {n_stages} stages run "
-                   f"SERIALLY (bubble {(n_stages - 1) / n_stages:.0%}) — "
-                   "size the per-step batch to a multiple of "
-                   f"{dp_shards * 2} rows")
+    # default path: announce any materially bad bubble (> 1/3 of pipeline
+    # time, i.e. m < 2S - 2), not just full serialization — a mis-sized
+    # batch quietly running a 60% bubble is the same silent-degrade class
+    # as the round-3 gcd issue
+    if n_stages > 1 and not requested and m < 2 * n_stages - 2:
+        bubble = (n_stages - 1) / (m + n_stages - 1)
+        _warn_once(key + ("serial",), f"[dla_tpu][pipeline] WARNING: batch {batch} only "
+                   f"splits into M={m} pipeline microbatches over "
+                   f"{dp_shards} batch shards; {n_stages} stages run at a "
+                   f"{bubble:.0%} bubble"
+                   + (" (SERIALLY)" if m == 1 else "")
+                   + " — size the per-step batch toward "
+                   f"{4 * n_stages * max(1, dp_shards)} rows")
     if dp_shards > 1 and (batch // m) % dp_shards != 0:
         _warn_once(key + ("dp",), f"[dla_tpu][pipeline] WARNING: pipeline "
                    f"microbatches of {batch // m} rows do not divide the "

@@ -82,11 +82,15 @@ def make_distill_loss(student_model, teacher_models: List[Any],
                                 else jax.lax.stop_gradient(tb))
             loss = fused_kl_distill_loss(
                 h, sw, t_hiddens, t_ws, batch["attention_mask"],
-                temperature, student_bias=sbias, teacher_biases=t_biases)
+                temperature, student_bias=sbias, teacher_biases=t_biases,
+                student_softcap=student_model.cfg.final_logit_softcap,
+                teacher_softcaps=[tm.cfg.final_logit_softcap
+                                  for tm in teacher_models])
             metrics["kl"] = loss
         else:
             loss, _ = fused_cross_entropy_loss(
-                h, sw, batch["labels"], bias=sbias)  # h computed above
+                h, sw, batch["labels"], bias=sbias,
+                softcap=student_model.cfg.final_logit_softcap)
             metrics["ce"] = loss
         # MoE students: router regularization on the with-grad forward
         loss = loss + weighted_moe_aux(student_model, moe_aux)

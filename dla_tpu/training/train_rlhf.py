@@ -136,7 +136,8 @@ def _token_logps_and_values(model, params, seqs, mask, lora=None,
     h, moe_aux = model.hidden_states_with_aux(
         params, seqs, attention_mask=mask, lora=lora)
     w, bias = model.unembed_params(params)
-    lp = fused_token_logprobs(h[:, :-1, :], w, seqs[:, 1:], bias)
+    lp = fused_token_logprobs(h[:, :-1, :], w, seqs[:, 1:], bias,
+                              softcap=model.cfg.final_logit_softcap)
     v = None
     if value_head is not None:
         v = (h[:, :-1, :].astype(jnp.float32) @ value_head["w"]

@@ -1,0 +1,227 @@
+"""Gemma-2 architecture: logits parity with transformers'
+Gemma2ForCausalLM — attention + final logit softcapping, pre+post norms
+(four RMSNorms per block, (1+w) folded at import), alternating-layer
+sliding window (even layers slide), query_pre_attn_scalar softmax scale
+— plus decode parity and the fused-CE softcap path. Closes the one
+refused HF family from round 3 (VERDICT item 8)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_gemma2_dir(tmp_path_factory):
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+    cfg = Gemma2Config(
+        vocab_size=160, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        rope_theta=10000.0, hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        # small window + 10-token prompts make the alternation visible;
+        # query_pre_attn_scalar != head_dim pins the custom scale
+        sliding_window=4, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, query_pre_attn_scalar=8,
+        # sdpa silently ignores gemma-2 softcapping; eager implements it
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    model = Gemma2ForCausalLM(cfg).eval()
+    d = tmp_path_factory.mktemp("hf_gemma2")
+    model.save_pretrained(str(d), safe_serialization=True)
+    return d, model
+
+
+def _load(d):
+    from dla_tpu.models.hf_import import (
+        hf_config_to_model_config,
+        import_hf_weights,
+        read_hf_config,
+    )
+    cfg = hf_config_to_model_config(
+        read_hf_config(d), dtype="float32", param_dtype="float32",
+        remat="none")
+    return cfg, import_hf_weights(d, cfg)
+
+
+def test_gemma2_config_mapping(tiny_gemma2_dir):
+    d, _ = tiny_gemma2_dir
+    cfg, params = _load(d)
+    assert cfg.arch == "gemma2"
+    assert cfg.attn_logit_softcap == 50.0
+    assert cfg.final_logit_softcap == 30.0
+    assert cfg.query_pre_attn_scalar == 8
+    assert cfg.sliding_window == 4 and cfg.sliding_window_pattern == 2
+    assert cfg.tie_embeddings
+    for k in ("attn_norm", "attn_post_norm", "mlp_norm", "mlp_post_norm"):
+        assert k in params["layers"], k
+
+
+def test_gemma2_import_matches_hf_logits(tiny_gemma2_dir):
+    d, hf_model = tiny_gemma2_dir
+    import jax.numpy as jnp
+    from dla_tpu.models.transformer import Transformer
+
+    cfg, params = _load(d)
+    model = Transformer(cfg)
+    rs = np.random.RandomState(0)
+    # 10 tokens > window 4: positions past the window differ between the
+    # sliding (even) and full (odd) layers — parity proves alternation
+    ids = rs.randint(0, 160, (2, 10))
+    ours = np.asarray(model.apply(params, jnp.asarray(ids, jnp.int32)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-4)
+
+
+def test_gemma2_window_actually_alternates(tiny_gemma2_dir):
+    """Sanity: with the window forced UNIFORM (pattern=1) the logits must
+    DIFFER from HF (which alternates) — guards against a vacuous parity
+    test where the window never engages."""
+    d, hf_model = tiny_gemma2_dir
+    import dataclasses
+    import jax.numpy as jnp
+    from dla_tpu.models.transformer import Transformer
+
+    cfg, params = _load(d)
+    uni = Transformer(dataclasses.replace(cfg, sliding_window_pattern=1))
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 160, (2, 10))
+    ours = np.asarray(uni.apply(params, jnp.asarray(ids, jnp.int32)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.numpy()
+    assert not np.allclose(ours, theirs, rtol=2e-3, atol=2e-4)
+
+
+def test_gemma2_decode_matches_forward(tiny_gemma2_dir):
+    """Softcaps, alternating window, and post-norms reach the KV-cache
+    decode path; run past the window so old keys drop out on the
+    sliding layers."""
+    d, _ = tiny_gemma2_dir
+    import jax.numpy as jnp
+    from dla_tpu.models.transformer import Transformer
+
+    cfg, params = _load(d)
+    model = Transformer(cfg)
+    rs = np.random.RandomState(1)
+    ids = jnp.asarray(rs.randint(1, 160, (2, 6)), jnp.int32)
+    mask = jnp.ones((2, 6), jnp.int32)
+    n_new = 4
+    logits, cache = model.start_decode(params, ids, mask, n_new)
+    got = []
+    for _ in range(n_new):
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        got.append(np.asarray(tok))
+        logits, cache = model.decode_step(params, cache, tok)
+    got = np.stack(got, axis=1)
+
+    want = np.zeros_like(got)
+    for i in range(2):
+        seq = list(np.asarray(ids[i]))
+        for s in range(n_new):
+            full = model.apply(params, jnp.asarray([seq], jnp.int32))
+            nxt = int(np.argmax(np.asarray(full[0, -1])))
+            want[i, s] = nxt
+            seq.append(nxt)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gemma2_export_roundtrip(tmp_path, tiny_gemma2_dir):
+    """Export writes the 4-norm layout with the (1+w) fold undone and a
+    Gemma2Config transformers can load with identical logits."""
+    d, _ = tiny_gemma2_dir
+    import jax
+    import jax.numpy as jnp
+    from dla_tpu.models.hf_export import export_hf_weights
+    from dla_tpu.models.hf_import import (
+        hf_config_to_model_config,
+        import_hf_weights,
+        read_hf_config,
+    )
+    from dla_tpu.models.transformer import Transformer
+
+    cfg, params = _load(d)
+    out = export_hf_weights(params, cfg, tmp_path / "hf_gemma2_out")
+    hf_cfg2 = read_hf_config(out)
+    assert hf_cfg2["model_type"] == "gemma2"
+    assert hf_cfg2["attn_logit_softcapping"] == 50.0
+    params2 = import_hf_weights(out, hf_config_to_model_config(
+        hf_cfg2, dtype="float32", param_dtype="float32", remat="none"))
+    for a, b in zip(jax.tree.leaves(jax.tree.map(np.asarray, params)),
+                    jax.tree.leaves(params2)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+    from transformers import Gemma2ForCausalLM
+    model2 = Gemma2ForCausalLM.from_pretrained(
+        str(out), torch_dtype=torch.float32,
+        attn_implementation="eager").eval()
+    rs = np.random.RandomState(3)
+    ids = rs.randint(0, 160, (1, 9))
+    ours = np.asarray(Transformer(cfg).apply(
+        params, jnp.asarray(ids, jnp.int32)))
+    with torch.no_grad():
+        theirs = model2(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-4)
+
+
+def test_gemma2_token_logps_respect_softcap(tiny_gemma2_dir):
+    """The RLHF per-token logp path (_token_logps_and_values, the GAE
+    update/score math) must compute over CAPPED logits — regression for
+    the round-4 review finding where it skipped the softcap while every
+    other logprob path applied it."""
+    d, _ = tiny_gemma2_dir
+    import jax
+    import jax.numpy as jnp
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.training.train_rlhf import _token_logps_and_values
+
+    cfg, params = _load(d)
+    model = Transformer(cfg)
+    rs = np.random.RandomState(4)
+    seqs = jnp.asarray(rs.randint(1, 160, (2, 8)), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    lp, _, _ = _token_logps_and_values(model, params, seqs, mask)
+    logits = model.apply(params, seqs, attention_mask=mask)  # capped
+    want = jnp.take_along_axis(
+        jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1),
+        seqs[:, 1:, None], axis=-1)[..., 0]
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gemma2_fused_ce_matches_unfused(tiny_gemma2_dir):
+    """The chunked fused-CE path must apply the final-logit softcap —
+    loss and grads equal the unfused logits+CE computation."""
+    d, _ = tiny_gemma2_dir
+    import jax
+    import jax.numpy as jnp
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.ops.fused_ce import model_fused_ce
+    from dla_tpu.ops.losses import cross_entropy_loss
+
+    cfg, params = _load(d)
+    model = Transformer(cfg)
+    rs = np.random.RandomState(2)
+    batch = {
+        "input_ids": jnp.asarray(rs.randint(1, 160, (2, 12)), jnp.int32),
+        "attention_mask": jnp.ones((2, 12), jnp.int32),
+        "labels": jnp.asarray(
+            np.where(rs.rand(2, 12) < 0.2, -100,
+                     rs.randint(1, 160, (2, 12))), jnp.int32),
+    }
+
+    def fused(p):
+        return model_fused_ce(model, p, batch)[0]
+
+    def unfused(p):
+        logits = model.apply(p, batch["input_ids"],
+                             attention_mask=batch["attention_mask"])
+        return cross_entropy_loss(logits, batch["labels"])[0]
+
+    lf, gf = jax.value_and_grad(fused)(params)
+    lu, gu = jax.value_and_grad(unfused)(params)
+    np.testing.assert_allclose(float(lf), float(lu), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gu)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)

@@ -250,13 +250,16 @@ def test_resolve_microbatches_default_and_degrade(capsys):
     # default targets 4*S clipped to the largest divisor of the batch
     assert resolve_microbatches(32, None, 2) == 8
     assert resolve_microbatches(6, None, 2) == 6
-    assert resolve_microbatches(5, None, 4) == 5
     # each microbatch must still split over the dp shards: batch 4 on 2
     # shards caps M at 2 (4 microbatches of 1 row would force the
     # replicated-flash fallback)
     assert resolve_microbatches(4, None, 2, dp_shards=2) == 2
     assert resolve_microbatches(32, None, 2, dp_shards=4) == 8
-    assert capsys.readouterr().err == ""      # defaults degrade silently
+    assert capsys.readouterr().err == ""   # tolerable bubbles stay quiet
+    # a materially bad default bubble (> 1/3) announces itself: batch 5
+    # over 4 stages only splits M=5 (bubble 3/8)
+    assert resolve_microbatches(5, None, 4) == 5
+    assert "bubble" in capsys.readouterr().err
     # explicit config that divides: honored, quiet
     assert resolve_microbatches(8, 4, 2) == 4
     assert capsys.readouterr().err == ""
@@ -277,7 +280,8 @@ def test_resolve_microbatches_default_and_degrade(capsys):
     # when the only dp-compatible split is serial, pipelining wins and
     # the broken batch sharding is announced instead
     assert resolve_microbatches(7, None, 2, dp_shards=7) == 7
-    assert "replicated" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "replicated" in err and "SERIALLY" not in err
     # honored explicit M whose microbatches break batch sharding warns
     # about the replicated fallback
     _DEGRADE_WARNED.clear()
