@@ -1,0 +1,30 @@
+"""tools/convergence_run.py — the >=1B DPO convergence runner (VERDICT
+r3 item 6) must demonstrably converge at its CPU-validation scale, so
+the on-chip run is a scale-up, not a debug session."""
+import importlib.util
+import os
+
+import pytest
+
+
+def _load_tool():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "convergence_run.py")
+    spec = importlib.util.spec_from_file_location("convergence_run", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_convergence_run_tiny(tmp_path):
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mod = _load_tool()
+    summary = mod.main(steps=120, out_dir=str(tmp_path))
+    # DPO from ln(2): the loss must fall and fresh-sample preference
+    # must be essentially solved at this toy scale
+    assert summary["loss_last10_mean"] < 0.67
+    assert summary["preference_rate_last10_mean"] > 0.9
+    assert (tmp_path / "metrics.jsonl").is_file()
+    assert (tmp_path / "summary.md").is_file()

@@ -1,0 +1,187 @@
+"""Recorded DPO convergence run at >=1B params on one chip (VERDICT r3
+item 6: evidence toward the north star "Llama-2-7B DPO converges", not
+just tiny-model loss-falls tests).
+
+Zero-egress environment, so the preference data is synthetic but
+LEARNABLE — not fixed noise: prompts are random token sequences; the
+chosen response draws its tokens from the LOW half of the vocabulary,
+the rejected response from the HIGH half. A policy that learns the
+distributional preference assigns rising likelihood to chosen vs
+rejected, so the DPO loss falls below ln(2) and the preference margin
+(policy chosen-vs-rejected logp gap relative to the frozen reference)
+rises — the same convergence signature a real preference dataset
+produces, measured on FRESH samples every step (a distribution, not a
+memorized batch).
+
+Full-parameter DPO (not LoRA): the base is RANDOM in this environment,
+and an unconditional distribution shift is poorly expressible through
+low-rank adapters over RMSNorm'd hiddens of a random base — full DPO is
+both the stronger convergence evidence and the learnable setup. A 1.3B
+policy fits one v5e chip in bf16 end to end: params 2.6G + Adam m/v in
+bf16 (adam_moment_dtype) 5.2G + the frozen reference copy 2.6G. On CPU
+(validation) a tiny model runs the same loop.
+
+Run (on the TPU):
+  python tools/convergence_run.py [steps] [out_dir]
+Writes <out_dir>/metrics.jsonl + <out_dir>/summary.md (committed under
+docs/convergence_1b/ when run on chip).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def make_batch(rs: np.random.RandomState, bs: int, prompt_len: int,
+               vocab: int):
+    """Fresh preference batch with a LEARNABLE distributional signal:
+    shared random prompt; the chosen response draws tokens from the low
+    half of the vocabulary, the rejected response from the high half.
+    Full-parameter DPO learns this from a random init (shift the output
+    distribution toward the chosen range), so logp(chosen) -
+    logp(rejected) grows and the loss falls below ln(2) on fresh
+    samples."""
+    t = 2 * prompt_len
+    lo, hi = 3, vocab // 2
+    prompts = rs.randint(3, vocab, (bs, prompt_len)).astype(np.int32)
+    chosen = np.concatenate(
+        [prompts, rs.randint(lo, hi, (bs, prompt_len)).astype(np.int32)],
+        axis=1)
+    rejected = np.concatenate(
+        [prompts, rs.randint(hi, vocab, (bs, prompt_len)).astype(np.int32)],
+        axis=1)
+    mask = np.ones((bs, t), np.int32)
+    return ({"input_ids": chosen, "attention_mask": mask},
+            {"input_ids": rejected, "attention_mask": mask})
+
+
+def main(steps: int = 300, out_dir: str = None) -> dict:
+    import jax
+
+    from dla_tpu.models.config import ModelConfig, get_model_config
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.training.train_dpo import make_dpo_loss
+    from dla_tpu.training.trainer import Trainer
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    if on_accel:
+        # same ~1.3B shape as the PPO bench (2048 x 24L, GQA 16q/8kv)
+        cfg = ModelConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=24, num_heads=16, num_kv_heads=8,
+            max_seq_length=256, remat="dots", attention="flash",
+            dtype="bfloat16", param_dtype="bfloat16")
+        bs, prompt_len, lr = 16, 64, 1e-5
+    else:
+        cfg = get_model_config("tiny", max_seq_length=64)
+        bs, prompt_len, lr = 8, 8, 1e-3
+
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
+    model = Transformer(cfg)
+    out = out_dir or os.path.join(_REPO, "docs", "convergence_1b")
+    os.makedirs(out, exist_ok=True)
+
+    with jax.sharding.set_mesh(mesh):
+        t0 = time.perf_counter()
+        base = model.init(jax.random.key(0))
+        jax.block_until_ready(base)
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree.leaves(base))
+        print(f"[conv] base: {n_params/1e9:.2f}B params "
+              f"({time.perf_counter()-t0:.0f}s init) on "
+              f"{jax.devices()[0].device_kind}", flush=True)
+        from dla_tpu.parallel.mesh import data_parallel_size
+        dp = data_parallel_size(mesh)
+        config = {
+            "experiment_name": "convergence_1b",
+            "optimization": {
+                "total_batch_size": bs,
+                "micro_batch_size": max(1, bs // dp),
+                "learning_rate": lr, "max_train_steps": steps,
+                "lr_scheduler": "cosine", "warmup_steps": 10,
+                "max_grad_norm": 1.0,
+                # bf16 first moment: the 1.3B full-DPO HBM budget
+                "adam_moment_dtype": "bfloat16",
+            },
+            "logging": {"output_dir": os.path.join(out, "ckpt"),
+                        "log_dir": None},
+            "hardware": {"gradient_accumulation_steps": 1},
+        }
+        # frozen ref = the initial policy; Trainer detects the aliased
+        # leaves and copies them, so no second init is paid
+        trainer = Trainer(
+            config=config, mesh=mesh,
+            loss_fn=make_dpo_loss(model, model, beta=0.1),
+            params=base, param_specs=model.partition_specs(),
+            frozen=base, frozen_specs=model.partition_specs())
+
+        rs = np.random.RandomState(0)
+        rows = []
+        t_run = time.perf_counter()
+        for i in range(steps):
+            chosen, rejected = make_batch(rs, bs, prompt_len,
+                                          cfg.vocab_size)
+            loss, metrics = trainer.step_on_batch(
+                {"chosen": chosen, "rejected": rejected},
+                jax.random.key(100 + i))
+            row = {"step": i + 1, "loss": float(loss),
+                   **{k: float(v) for k, v in metrics.items()}}
+            rows.append(row)
+            if (i + 1) % 20 == 0 or i == 0:
+                print(f"[conv] step {i+1}/{steps}: loss {row['loss']:.4f} "
+                      f"pref_rate {row.get('preference_rate', 0):.3f}",
+                      flush=True)
+        wall = time.perf_counter() - t_run
+
+    with open(os.path.join(out, "metrics.jsonl"), "w") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+
+    first = np.mean([r["loss"] for r in rows[:10]])
+    last = np.mean([r["loss"] for r in rows[-10:]])
+    pref_last = np.mean([r.get("preference_rate", 0.0)
+                         for r in rows[-10:]])
+    summary = {
+        "params_b": round(n_params / 1e9, 2),
+        "platform": jax.devices()[0].device_kind,
+        "steps": steps, "batch": bs, "seq": 2 * prompt_len,
+        "loss_first10_mean": round(float(first), 4),
+        "loss_last10_mean": round(float(last), 4),
+        "preference_rate_last10_mean": round(float(pref_last), 4),
+        "wall_s": round(wall, 1),
+        "steps_per_s": round(steps / wall, 3),
+    }
+    with open(os.path.join(out, "summary.md"), "w") as fh:
+        fh.write(
+            f"# DPO convergence at {summary['params_b']}B "
+            f"({summary['platform']})\n\n"
+            "Full-parameter bf16 DPO against a frozen copy of the\n"
+            "initial policy, fresh synthetic-but-learnable preference\n"
+            "batches every step (chosen draws low-half vocab, rejected\n"
+            "high-half; tools/convergence_run.py).\n\n"
+            f"- steps: {steps}, batch {bs} x seq {summary['seq']}\n"
+            f"- loss: {summary['loss_first10_mean']} (first 10) -> "
+            f"{summary['loss_last10_mean']} (last 10); ln(2) = 0.6931 "
+            "is the no-preference starting point\n"
+            f"- preference rate (last 10 steps): "
+            f"{summary['preference_rate_last10_mean']}\n"
+            f"- wall: {summary['wall_s']}s "
+            f"({summary['steps_per_s']} steps/s)\n\n"
+            "Full per-step curve in metrics.jsonl.\n")
+    print(f"[conv] done: loss {first:.4f} -> {last:.4f}, "
+          f"pref_rate {pref_last:.3f}, {wall:.0f}s", flush=True)
+    return summary
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+    d = sys.argv[2] if len(sys.argv) > 2 else None
+    main(n, d)
