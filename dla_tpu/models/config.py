@@ -91,10 +91,18 @@ class ModelConfig:
     # ambient mesh has sequence > 1; decode paths always run unsharded.
     context_parallel: str = "ring"
     # GPipe microbatch count when the mesh has stage > 1 (pipeline
-    # parallelism). 0 = auto (one microbatch per stage). More microbatches
-    # shrink the (S-1)/(M+S-1) bubble at the cost of smaller per-stage
-    # matmuls; batch must be divisible by it.
+    # parallelism). 0 = auto (targets 4x the stage count, see
+    # ops.pipeline.resolve_microbatches). More microbatches shrink the
+    # (S-1)/(M+S-1) bubble at the cost of smaller per-stage matmuls;
+    # batch must be divisible by it.
     pipeline_microbatches: int = 0
+    # Interleaved/circular pipeline (virtual stages): each physical
+    # stage owns V round-robin layer blocks and microbatches traverse
+    # the ring V times — bubble (S-1)/(V*S + S - 1) with only S
+    # microbatches of activation in flight (vs needing M = V*S
+    # microbatches for the same bubble under plain GPipe). Requires
+    # num_layers % (stage * V) == 0; M is pinned to the stage count.
+    pipeline_interleave: int = 1
     # Mixture-of-Experts (beyond-reference capability; makes the
     # reserved `expert` mesh axis real — ops/moe.py). 0 = dense MLP.
     # llama arch only; top-k routing with GShard capacity dispatch.

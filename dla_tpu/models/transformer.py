@@ -874,10 +874,11 @@ class Transformer:
             resolve_microbatches
         cfg = self.cfg
         n_layers = cfg.num_layers
-        if n_layers % n_stages:
+        v = max(1, cfg.pipeline_interleave)
+        if n_layers % (n_stages * v):
             raise ValueError(
-                f"pipeline needs num_layers ({n_layers}) divisible by the "
-                f"stage axis ({n_stages})")
+                f"pipeline needs num_layers ({n_layers}) divisible by "
+                f"stage axis x interleave ({n_stages} x {v})")
         mesh = _ambient_mesh()
         manual = set(getattr(mesh, "manual_axes", ()) or ()) if mesh else ()
         dp_shards = 1
@@ -885,11 +886,49 @@ class Transformer:
             for a in ("data", "fsdp"):
                 if a in mesh.shape and a not in manual:
                     dp_shards *= mesh.shape[a]
-        m = resolve_microbatches(x.shape[0], cfg.pipeline_microbatches,
-                                 n_stages, dp_shards=dp_shards)
+        if v > 1:
+            # circular schedule: M is pinned to the stage count (the
+            # bufferless re-injection needs it); a batch that cannot
+            # split into S microbatches falls back to plain GPipe
+            from dla_tpu.ops.pipeline import _warn_once
+            if x.shape[0] % n_stages == 0:
+                m = n_stages
+                if dp_shards > 1 and (x.shape[0] // m) % dp_shards:
+                    _warn_once(
+                        ("interleave-dp", x.shape[0], n_stages, dp_shards),
+                        f"[dla_tpu][pipeline] WARNING: interleaved "
+                        f"microbatches of {x.shape[0] // m} rows do not "
+                        f"divide the {dp_shards} batch shards; attention "
+                        "falls back to the replicated path for this "
+                        "shape")
+            else:
+                _warn_once(("interleave", x.shape[0], n_stages, v),
+                           f"[dla_tpu][pipeline] WARNING: batch "
+                           f"{x.shape[0]} cannot split into {n_stages} "
+                           f"microbatches; pipeline_interleave={v} "
+                           "falls back to plain GPipe")
+                v = 1
+                m = resolve_microbatches(
+                    x.shape[0], cfg.pipeline_microbatches, n_stages,
+                    dp_shards=dp_shards)
+        else:
+            m = resolve_microbatches(x.shape[0], cfg.pipeline_microbatches,
+                                     n_stages, dp_shards=dp_shards)
+        # block b = p*S + s lives at stacked[s, p]: [L] -> [V, S, c]
+        # (natural block-major order) -> transpose -> [S, V, c].
+        # LAYOUT COST (v > 1 only): params are stored contiguously over
+        # `stage` (stage s owns layers s*L/S..), but the round-robin
+        # schedule needs the strided blocks {p*S+s} — GSPMD inserts a
+        # cross-stage reshard of ~(V-1)/V of the layer weights per step.
+        # Fine when weight bytes/stage << per-step activation compute
+        # (deep-but-thin stages, the schedule's niche: batches too small
+        # for M=4S GPipe); a storage-permuted layout that makes this
+        # shard-local couples param order to the mesh's stage count and
+        # is tracked as future work (docs/pp_bubble.md).
+        c = n_layers // (n_stages * v)
         stage_layers = jax.tree.map(
-            lambda l: l.reshape((n_stages, n_layers // n_stages)
-                                + l.shape[1:]), layers)
+            lambda l: l.reshape((v, n_stages, c) + l.shape[1:]
+                                ).swapaxes(0, 1), layers)
         aux = {"cos": microbatch(cos, m), "sin": microbatch(sin, m),
                "positions": microbatch(positions, m)}
         if kv_mask is not None:
@@ -910,7 +949,8 @@ class Transformer:
             h, _ = jax.lax.scan(self._maybe_remat(body), h, stage_params)
             return h
 
-        out = gpipe(stage_fn, stage_layers, microbatch(x, m), aux, n_stages)
+        out = gpipe(stage_fn, stage_layers, microbatch(x, m), aux,
+                    n_stages, passes=v)
         return out.reshape(x.shape)
 
     def _final_norm(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:

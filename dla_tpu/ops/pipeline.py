@@ -46,12 +46,15 @@ Pytree = Any
 
 def gpipe(
     stage_fn: Callable[[Pytree, jnp.ndarray, Pytree], jnp.ndarray],
-    stage_params: Pytree,       # leaves [S, L/S, ...], dim 0 sharded "stage"
+    stage_params: Pytree,       # leaves [S, V, L/(S*V), ...], dim 0 "stage"
     x_mb: jnp.ndarray,          # [M, mb, ...] microbatched activations
     aux_mb: Pytree,             # pytree of [M, mb, ...] per-microbatch aux
     n_stages: int,
+    passes: int = 1,
 ) -> jnp.ndarray:
-    """Run ``stage_fn`` (one stage's layer stack) as a GPipe pipeline.
+    """Run ``stage_fn`` (one pass's layer block) as a pipeline over the
+    ``stage`` mesh axis — plain GPipe (``passes=1``) or the interleaved
+    /circular schedule (``passes=V>1``, virtual stages).
 
     ``shard_map`` manual over ONLY the ``stage`` axis
     (``axis_names={"stage"}``; data/fsdp/model stay GSPMD-auto inside),
@@ -59,41 +62,64 @@ def gpipe(
     point-to-point schedule, and the ONLY per-tick cross-stage traffic:
     the aux stream (rotary phases, masks, positions) is replicated over
     ``stage`` already, so each stage just INDEXES it at its own offset
-    (stage s processes microbatch t - s at tick t) instead of shipping
-    multi-MB masks around the ring. Outputs are collected from the last
-    stage via a masked psum (the unembedding is replicated over ``stage``
-    anyway). Returns [M, mb, ...] in microbatch order.
+    (stage s processes microbatch (t - s) mod M on pass (t - s) // M at
+    tick t) instead of shipping multi-MB masks around the ring. Outputs
+    are collected from the last stage's final-pass emissions via a
+    masked psum. Returns [M, mb, ...] in microbatch order.
+
+    Interleaving: layer blocks are assigned round-robin — physical
+    stage s owns blocks {p*S + s}, so a microbatch traverses the ring V
+    times and the bubble shrinks to (S-1)/(V*M + S - 1) with only M
+    microbatches of activation in flight. ``passes > 1`` REQUIRES
+    M == n_stages: then stage S-1's pass-p output, permuted at tick t,
+    arrives at stage 0 exactly when it starts pass p+1 at tick t+1 —
+    the shift register needs no extra buffering (the maxtext
+    circ_storage degenerates away at M = S).
 
     Requires the ambient mesh to carry a ``stage`` axis of ``n_stages``
     (Transformer._pipeline_forward guarantees it; direct callers get a
     clear error).
     """
     m = x_mb.shape[0]
+    if passes > 1 and m != n_stages:
+        raise ValueError(
+            f"interleaved pipeline (passes={passes}) requires exactly "
+            f"M == n_stages microbatches (got M={m}, S={n_stages}): the "
+            "bufferless circular schedule re-injects each microbatch "
+            "into stage 0 one tick after stage S-1 emits it")
     pad = n_stages - 1
+    total_ticks = passes * m + pad
     _require_stage_mesh(n_stages)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def run(params_l, stream_x, stream_aux):
-        # per-shard view: params_l leaves [1, L/S, ...]; streams full
+        # per-shard view: params_l leaves [1, V, L/(S*V), ...]
         p_l = jax.tree.map(lambda a: jnp.squeeze(a, 0), params_l)
         s_idx = jax.lax.axis_index("stage")
         st_x = jnp.zeros(stream_x.shape[1:], stream_x.dtype)
 
         def tick(sx, t):
-            # microbatch index this stage works on at tick t (clipped
-            # during this stage's warmup/drain ticks, whose outputs are
-            # never collected)
-            idx = jnp.clip(t - s_idx, 0, m - 1)
+            # microbatch index and pass this stage works on at tick t
+            # (wrapped/clipped during warmup/drain ticks, whose outputs
+            # are never collected — NaN-free garbage by construction)
+            rel = t - s_idx
+            idx = jnp.clip(rel, 0, passes * m - 1) % m
+            p_idx = jnp.clip(rel // m, 0, passes - 1)
+            block = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, p_idx, 0, keepdims=False), p_l)
             aux_t = jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(
                     a, idx, 0, keepdims=False), stream_aux)
             inj = jax.lax.dynamic_index_in_dim(
                 stream_x, jnp.clip(t, 0, m - 1), 0, keepdims=False)
-            sx = jnp.where(s_idx == 0, inj, sx)
-            out = stage_fn(p_l, sx, aux_t)
+            # stage 0 injects fresh microbatches only on pass 0; later
+            # passes consume the ring input from stage S-1
+            sx = jnp.where((s_idx == 0) & (t < m), inj, sx)
+            out = stage_fn(block, sx, aux_t)
             return jax.lax.ppermute(out, "stage", perm), out
 
-        _, ys = jax.lax.scan(tick, st_x, jnp.arange(m + pad))
+        _, ys = jax.lax.scan(tick, st_x, jnp.arange(total_ticks))
         # only the last stage's emissions are the model output
         last = (s_idx == n_stages - 1).astype(ys.dtype)
         return jax.lax.psum(ys * last, "stage")
@@ -105,7 +131,10 @@ def gpipe(
         out_specs=P(),
         axis_names={"stage"}, check_vma=False)
     ys = fn(stage_params, x_mb, aux_mb)
-    return ys[pad:]                       # microbatch t exits at tick t+pad
+    # the last stage's FINAL-pass emissions: microbatch j exits at tick
+    # (passes-1)*m + (S-1) + j
+    start = (passes - 1) * m + pad
+    return ys[start:start + m]
 
 
 def _require_stage_mesh(n_stages: int) -> None:

@@ -65,9 +65,13 @@ def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
                             else "xla")
     for key in ("dtype", "param_dtype", "remat", "vocab_size", "attention",
                 "context_parallel", "arch", "rotary_pct", "attention_bias",
-                "sliding_window", "pipeline_microbatches", "num_experts",
-                "num_experts_per_token", "moe_capacity_factor",
-                "moe_group_size", "moe_aux_weight", "moe_z_weight"):
+                "sliding_window", "sliding_window_pattern",
+                "attn_logit_softcap", "final_logit_softcap",
+                "query_pre_attn_scalar",
+                "pipeline_microbatches", "pipeline_interleave",
+                "num_experts", "num_experts_per_token",
+                "moe_capacity_factor", "moe_group_size", "moe_aux_weight",
+                "moe_z_weight"):
         if key in model_cfg:
             out[key] = model_cfg[key]
     # reference model.lora block (config/distill_config.yaml:10-14; dead

@@ -194,3 +194,33 @@ def test_unknown_rope_scaling_refused():
     assert hf_config_to_model_config(
         {**base, "rope_scaling": {"rope_type": "default"}}
     ).rope_scaling is None
+
+
+def test_arch_overrides_cover_every_model_config_field():
+    """model.<key> YAML overrides flow to ModelConfig through a
+    whitelist in model_io._arch_overrides — a field missing from it is
+    SILENTLY dropped (round 4: --set model.pipeline_interleave=2 was a
+    no-op). Pin that every architecture-shaping ModelConfig field is
+    either whitelisted or deliberately excluded."""
+    import dataclasses
+
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.training.model_io import _arch_overrides
+
+    # fields set by structural/weight context, not per-run YAML keys
+    excluded = {
+        "vocab_size", "hidden_size", "intermediate_size", "num_layers",
+        "num_heads", "num_kv_heads", "head_dim", "rope_theta",
+        "rope_scaling", "rms_norm_eps", "tie_embeddings",
+        "max_seq_length",  # handled explicitly above the whitelist
+        "flash_block_q", "flash_block_k",
+        "lora_r", "lora_alpha", "lora_dropout", "lora_targets",  # lora block
+    }
+    fields = {f.name for f in dataclasses.fields(ModelConfig)}
+    candidates = fields - excluded
+    probe = {k: 1 for k in candidates}
+    got = _arch_overrides(probe)
+    missing = candidates - set(got)
+    assert not missing, (
+        f"ModelConfig fields silently dropped by _arch_overrides: "
+        f"{sorted(missing)}")

@@ -75,6 +75,31 @@ def main() -> None:
     pred = A @ np.array([a, c])
     err = float(np.max(np.abs(pred - t) / t))
 
+    # circular schedule: same bubble target with only M=S microbatches
+    # in flight (8-layer model so interleave 1/2/4 all divide)
+    circ_rows = []
+    base8 = dataclasses.replace(base, num_layers=8)
+    for v in (1, 2, 4):
+        cfg = dataclasses.replace(base8, pipeline_interleave=v,
+                                  pipeline_microbatches=stages)
+        model = Transformer(cfg)
+        params = model.init(jax.random.key(1))
+        with jax.sharding.set_mesh(mesh):
+            sp = jax.device_put(
+                params, sharding_tree(model.partition_specs(), mesh))
+            fwd = jax.jit(lambda p: model.apply(p, ids))
+            fwd(sp).block_until_ready()
+            reps = 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fwd(sp)
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / reps
+        ovh = 1 + (stages - 1) / (v * stages)
+        circ_rows.append((v, dt * 1000, ovh))
+        print(f"V={v}: {dt*1000:8.1f} ms/step   overhead "
+              f"1+(S-1)/(V*S) = {ovh:.3f}")
+
     out_path = os.path.join(_REPO, "docs", "pp_bubble.md")
     with open(out_path, "w") as fh:
         fh.write(
@@ -104,9 +129,33 @@ def main() -> None:
             "1F1B would NOT shrink this bubble (same S-1 warmup/drain "
             "ticks) — its win is peak activation memory, which the "
             "scan-over-ticks autodiff here already bounds differently "
-            "(residuals per tick, subject to remat policy). The next "
-            "bubble lever beyond M is interleaved/circular scheduling "
-            "(virtual stages), tracked as future work.\n")
+            "(residuals per tick, subject to remat policy).\n\n"
+            "## Interleaved/circular schedule (pipeline_interleave)\n\n"
+            "Virtual stages reach the same bubble with only M = S "
+            "microbatches in flight: stage s owns V round-robin layer "
+            "blocks, bubble (S-1)/(V*S + S - 1) "
+            "(8-layer model, M pinned to S):\n\n"
+            "| V | ms/step | schedule overhead 1+(S-1)/(V*S) |\n"
+            "|---|---|---|\n")
+        for v, ms, ovh in circ_rows:
+            fh.write(f"| {v} | {ms:.1f} | {ovh:.3f} |\n")
+        fh.write(
+            "\nUse `pipeline_interleave` when the per-step batch cannot "
+            "reach M = 4S microbatches (RLHF rollouts, eval batches). "
+            "At this toy scale V=2 realizes the predicted bubble win "
+            "while V=4 regresses — with 1-layer blocks the per-pass "
+            "fixed costs (block dispatch, V x ppermute hops) outweigh "
+            "the shrinking bubble, the same U-shape as the M sweep.\n\n"
+            "KNOWN LAYOUT COST: params are stored contiguously over the "
+            "stage axis, but the round-robin schedule needs strided "
+            "blocks, so GSPMD reshards ~(V-1)/V of the layer weights "
+            "across the stage ring every step (forward and backward). "
+            "The schedule therefore pays off only where per-step "
+            "activation compute dominates weight bytes per stage; at "
+            "70B weight scale prefer plain GPipe with M >= 4S. Making "
+            "the layout shard-local (storage-permuted layer order) "
+            "couples param storage to the mesh's stage count and is "
+            "future work.\n")
     print(f"fit: t = {a:.1f}*overhead + {c:.1f} ms (max resid {err:.1%})")
     print(f"wrote {out_path}")
 
