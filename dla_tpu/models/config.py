@@ -255,6 +255,27 @@ register_model("llama3-70b", ModelConfig(
     vocab_size=128256, hidden_size=8192, intermediate_size=28672,
     num_layers=80, num_heads=64, num_kv_heads=8, rope_theta=500000.0,
     max_seq_length=8192))
+register_model("llama3.2-1b", ModelConfig(
+    vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+    num_layers=16, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+    tie_embeddings=True, max_seq_length=131072,
+    rope_scaling={"rope_type": "llama3", "factor": 32.0,
+                  "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                  "original_max_position_embeddings": 8192}))
+register_model("llama3.2-3b", ModelConfig(
+    vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+    num_layers=28, num_heads=24, num_kv_heads=8, rope_theta=500000.0,
+    tie_embeddings=True, max_seq_length=131072,
+    rope_scaling={"rope_type": "llama3", "factor": 32.0,
+                  "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                  "original_max_position_embeddings": 8192}))
+register_model("phi3-mini", ModelConfig(
+    vocab_size=32064, hidden_size=3072, intermediate_size=8192,
+    num_layers=32, num_heads=32, num_kv_heads=32, rope_theta=10000.0,
+    max_seq_length=4096, sliding_window=2047,
+    # llama block shape: HF Phi3 fuses qkv/gate_up in storage only
+    # (hf_import splits them); microsoft/Phi-3-mini-4k-instruct
+    ))
 register_model("qwen2-7b", ModelConfig(
     vocab_size=152064, hidden_size=3584, intermediate_size=18944,
     num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1e6,
@@ -297,6 +318,9 @@ register_model("google/gemma-2-9b", _REGISTRY["gemma2-9b"])
 register_model("meta-llama/Meta-Llama-3-8B", _REGISTRY["llama3-8b"])
 register_model("meta-llama/Llama-3.1-8B", _REGISTRY["llama3.1-8b"])
 register_model("meta-llama/Meta-Llama-3-70B", _REGISTRY["llama3-70b"])
+register_model("meta-llama/Llama-3.2-1B", _REGISTRY["llama3.2-1b"])
+register_model("meta-llama/Llama-3.2-3B", _REGISTRY["llama3.2-3b"])
+register_model("microsoft/Phi-3-mini-4k-instruct", _REGISTRY["phi3-mini"])
 register_model("meta-llama/Llama-2-7b-hf", _REGISTRY["llama2-7b"])
 register_model("meta-llama/Llama-2-13b-hf", _REGISTRY["llama2-13b"])
 register_model("meta-llama/Llama-2-70b-hf", _REGISTRY["llama2-70b"])

@@ -95,7 +95,9 @@ def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfi
     # any other type with the key absent stays full-causal rather than
     # silently windowing.
     sw = hf_cfg.get("sliding_window")
-    sw_default_on = model_type in ("mistral", "mixtral")
+    # phi3 (like mistral/mixtral) has no use_sliding_window knob: a set
+    # sliding_window always applies
+    sw_default_on = model_type in ("mistral", "mixtral", "phi3")
     if sw and hf_cfg.get("use_sliding_window", sw_default_on):
         # qwen2's max_window_layers: the FIRST mwl layers run full
         # attention, SWA applies to layers i >= mwl (transformers
@@ -214,12 +216,23 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
     if cfg.attention_bias:
         for k in ("wq_bias", "wk_bias", "wv_bias"):
             stacked[k] = []
+    # phi-3 fuses q/k/v into qkv_proj and gate/up into gate_up_proj;
+    # detect by key (the config maps to the plain llama block otherwise)
+    fused_qkv = (pre + "layers.0.self_attn.qkv_proj.weight") in sd
+    qd = cfg.num_heads * cfg.head_dim_
+    kvd = cfg.num_kv_heads * cfg.head_dim_
     for i in range(L):
         p = f"layers.{i}."
         stacked["attn_norm"].append(take(p + "input_layernorm.weight").astype(pdtype))
-        stacked["wq"].append(linear(p + "self_attn.q_proj.weight"))
-        stacked["wk"].append(linear(p + "self_attn.k_proj.weight"))
-        stacked["wv"].append(linear(p + "self_attn.v_proj.weight"))
+        if fused_qkv:
+            qkv = take(p + "self_attn.qkv_proj.weight")  # [(H+2K)dh, D]
+            stacked["wq"].append(qkv[:qd].T.astype(pdtype))
+            stacked["wk"].append(qkv[qd:qd + kvd].T.astype(pdtype))
+            stacked["wv"].append(qkv[qd + kvd:].T.astype(pdtype))
+        else:
+            stacked["wq"].append(linear(p + "self_attn.q_proj.weight"))
+            stacked["wk"].append(linear(p + "self_attn.k_proj.weight"))
+            stacked["wv"].append(linear(p + "self_attn.v_proj.weight"))
         if cfg.attention_bias:
             stacked["wq_bias"].append(
                 take(p + "self_attn.q_proj.bias").astype(pdtype))
@@ -256,6 +269,12 @@ def import_hf_weights(model_dir, cfg: ModelConfig,
             stacked["w_down"].append(np.stack(
                 [linear(m + f"experts.{j}.w2.weight")
                  for j in range(cfg.num_experts)]))
+        elif fused_qkv:
+            gu = take(p + "mlp.gate_up_proj.weight")      # [2F, D]
+            f_dim = cfg.intermediate_size
+            stacked["w_gate"].append(gu[:f_dim].T.astype(pdtype))
+            stacked["w_up"].append(gu[f_dim:].T.astype(pdtype))
+            stacked["w_down"].append(linear(p + "mlp.down_proj.weight"))
         else:
             stacked["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
             stacked["w_up"].append(linear(p + "mlp.up_proj.weight"))

@@ -891,6 +891,13 @@ class Transformer:
             # bufferless re-injection needs it); a batch that cannot
             # split into S microbatches falls back to plain GPipe
             from dla_tpu.ops.pipeline import _warn_once
+            if cfg.pipeline_microbatches not in (0, n_stages):
+                _warn_once(
+                    ("interleave-m", cfg.pipeline_microbatches, n_stages),
+                    f"[dla_tpu][pipeline] WARNING: pipeline_microbatches="
+                    f"{cfg.pipeline_microbatches} is ignored under "
+                    f"pipeline_interleave={v}: the circular schedule pins "
+                    f"M to the stage count ({n_stages})")
             if x.shape[0] % n_stages == 0:
                 m = n_stages
                 if dp_shards > 1 and (x.shape[0] // m) % dp_shards:

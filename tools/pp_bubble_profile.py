@@ -43,25 +43,30 @@ def main() -> None:
     mesh = build_mesh(MeshConfig(stage=stages, fsdp=fsdp, model=model_ax,
                                  data=1, sequence=1))
     batch, seq = 32, 64
-    rows = []
     rs = np.random.RandomState(0)
     ids = jnp.asarray(rs.randint(1, 500, (batch, seq)), jnp.int32)
     base = get_model_config("tiny-gqa")
-    for m_req in (1, 2, 4, 8, 16):
-        cfg = dataclasses.replace(base, pipeline_microbatches=m_req)
+
+    def time_fwd(cfg, key, reps=5):
+        """One timing harness for BOTH sweeps so the two published
+        tables stay methodologically comparable."""
         model = Transformer(cfg)
-        params = model.init(jax.random.key(0))
+        params = model.init(jax.random.key(key))
         with jax.sharding.set_mesh(mesh):
             sp = jax.device_put(
                 params, sharding_tree(model.partition_specs(), mesh))
             fwd = jax.jit(lambda p: model.apply(p, ids))
             fwd(sp).block_until_ready()          # compile
-            reps = 5
             t0 = time.perf_counter()
             for _ in range(reps):
                 out = fwd(sp)
             out.block_until_ready()
-            dt = (time.perf_counter() - t0) / reps
+            return (time.perf_counter() - t0) / reps
+
+    rows = []
+    for m_req in (1, 2, 4, 8, 16):
+        dt = time_fwd(dataclasses.replace(
+            base, pipeline_microbatches=m_req), key=0)
         overhead = 1 + (stages - 1) / m_req
         rows.append((m_req, dt * 1000, overhead))
         print(f"M={m_req:3d}: {dt*1000:8.1f} ms/step   "
@@ -80,21 +85,9 @@ def main() -> None:
     circ_rows = []
     base8 = dataclasses.replace(base, num_layers=8)
     for v in (1, 2, 4):
-        cfg = dataclasses.replace(base8, pipeline_interleave=v,
-                                  pipeline_microbatches=stages)
-        model = Transformer(cfg)
-        params = model.init(jax.random.key(1))
-        with jax.sharding.set_mesh(mesh):
-            sp = jax.device_put(
-                params, sharding_tree(model.partition_specs(), mesh))
-            fwd = jax.jit(lambda p: model.apply(p, ids))
-            fwd(sp).block_until_ready()
-            reps = 5
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                out = fwd(sp)
-            out.block_until_ready()
-            dt = (time.perf_counter() - t0) / reps
+        dt = time_fwd(dataclasses.replace(
+            base8, pipeline_interleave=v,
+            pipeline_microbatches=stages), key=1)
         ovh = 1 + (stages - 1) / (v * stages)
         circ_rows.append((v, dt * 1000, ovh))
         print(f"V={v}: {dt*1000:8.1f} ms/step   overhead "
