@@ -295,7 +295,10 @@ def run_ppo_bench() -> dict:
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_layers=24, num_heads=16, num_kv_heads=8,
             max_seq_length=512, remat="dots", attention="flash",
-            param_dtype="bfloat16", lora_r=16)
+            param_dtype="bfloat16", lora_r=16,
+            # int8 KV cache halves the rollout's cache HBM traffic
+            # (~38% of decode bytes at this batch/seq)
+            kv_cache_dtype="int8")
         # rollout batch 64 = the reference's own scale
         # (config/rlhf_config.yaml rollout_batch_size)
         batch, prompt_w, new_tokens, rollouts, warmup = 64, 128, 128, 3, 1
@@ -318,10 +321,13 @@ def run_ppo_bench() -> dict:
         rm_params = jax.device_put(
             rm.init(jax.random.key(2)),
             sharding_tree(rm.partition_specs(), mesh))
+        from dla_tpu.parallel.mesh import data_parallel_size
+        dp = data_parallel_size(mesh)
         config = {
             "experiment_name": "bench_ppo",
             "optimization": {
-                "total_batch_size": batch, "micro_batch_size": batch,
+                "total_batch_size": batch,
+                "micro_batch_size": max(1, batch // dp),
                 "learning_rate": 1e-6, "max_train_steps": rollouts + warmup,
                 "lr_scheduler": "constant", "max_grad_norm": 1.0,
             },
@@ -400,7 +406,8 @@ def run_decode_bench() -> dict:
         cfg = ModelConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_layers=24, num_heads=8, num_kv_heads=4,
-            max_seq_length=2048, attention="flash", remat="none")
+            max_seq_length=2048, attention="flash", remat="none",
+            kv_cache_dtype="int8")
         b, prompt, new = 8, 128, 256
     else:
         cfg = ModelConfig(

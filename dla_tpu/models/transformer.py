@@ -891,14 +891,16 @@ class Transformer:
             # bufferless re-injection needs it); a batch that cannot
             # split into S microbatches falls back to plain GPipe
             from dla_tpu.ops.pipeline import _warn_once
-            if cfg.pipeline_microbatches not in (0, n_stages):
-                _warn_once(
-                    ("interleave-m", cfg.pipeline_microbatches, n_stages),
-                    f"[dla_tpu][pipeline] WARNING: pipeline_microbatches="
-                    f"{cfg.pipeline_microbatches} is ignored under "
-                    f"pipeline_interleave={v}: the circular schedule pins "
-                    f"M to the stage count ({n_stages})")
             if x.shape[0] % n_stages == 0:
+                if cfg.pipeline_microbatches not in (0, n_stages):
+                    _warn_once(
+                        ("interleave-m", cfg.pipeline_microbatches,
+                         n_stages),
+                        f"[dla_tpu][pipeline] WARNING: "
+                        f"pipeline_microbatches="
+                        f"{cfg.pipeline_microbatches} is ignored under "
+                        f"pipeline_interleave={v}: the circular schedule "
+                        f"pins M to the stage count ({n_stages})")
                 m = n_stages
                 if dp_shards > 1 and (x.shape[0] // m) % dp_shards:
                     _warn_once(
@@ -1024,25 +1026,55 @@ class Transformer:
 
     # ------------------------------------------------------------- KV cache
 
+    @property
+    def _kv_int8(self) -> bool:
+        return self.cfg.kv_cache_dtype == "int8"
+
+    def _quantize_kv(self, x: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """[..., D] -> (int8 values, fp32 scale [...]): symmetric
+        per-position per-head quantization (scale = absmax/127 along the
+        head dim). Dequantization (q * scale) fuses into the attention
+        einsum, so the cache's HBM read traffic halves on the
+        bandwidth-bound decode loop."""
+        ax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+        scale = ax / 127.0 + 1e-12
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                     -127, 127).astype(jnp.int8)
+        return q, scale
+
+    def _dequantize_kv(self, q: jnp.ndarray, scale: jnp.ndarray
+                       ) -> jnp.ndarray:
+        return q.astype(self.adtype) * scale[..., None].astype(self.adtype)
+
     def init_cache(self, batch: int, max_len: int) -> Params:
         cfg = self.cfg
         shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim_)
-        return {
-            "k": jnp.zeros(shape, self.adtype),
-            "v": jnp.zeros(shape, self.adtype),
+        kv_dtype = jnp.int8 if self._kv_int8 else self.adtype
+        cache = {
+            "k": jnp.zeros(shape, kv_dtype),
+            "v": jnp.zeros(shape, kv_dtype),
             "valid": jnp.zeros((batch, max_len), bool),
             "lengths": jnp.zeros((batch,), jnp.int32),  # next position per seq
             "step": jnp.zeros((), jnp.int32),           # decode steps taken
         }
+        if self._kv_int8:
+            cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+            cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        return cache
 
     def cache_partition_specs(self) -> Params:
-        return {
+        specs = {
             "k": P(None, ("data", "fsdp"), None, "model", None),
             "v": P(None, ("data", "fsdp"), None, "model", None),
             "valid": P(("data", "fsdp"), None),
             "lengths": P(("data", "fsdp")),
             "step": P(),
         }
+        if self._kv_int8:
+            specs["k_scale"] = P(None, ("data", "fsdp"), None, "model")
+            specs["v_scale"] = P(None, ("data", "fsdp"), None, "model")
+        return specs
 
     def prefill(self, params: Params, cache: Params,
                 input_ids: jnp.ndarray, attention_mask: jnp.ndarray,
@@ -1088,14 +1120,23 @@ class Transformer:
 
         max_len = cache["k"].shape[2]
         pad = max_len - t
-        cache = {
-            "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
-            "v": jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        pad5 = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+        new_cache = {
             "valid": jnp.pad(attention_mask.astype(bool), ((0, 0), (0, pad))),
             "lengths": lengths,
             "step": jnp.zeros((), jnp.int32),
         }
-        return logits, cache
+        if self._kv_int8:
+            kq, k_s = self._quantize_kv(ks)
+            vq, v_s = self._quantize_kv(vs)
+            new_cache["k"] = jnp.pad(kq, pad5)
+            new_cache["v"] = jnp.pad(vq, pad5)
+            new_cache["k_scale"] = jnp.pad(k_s, pad5[:-1])
+            new_cache["v_scale"] = jnp.pad(v_s, pad5[:-1])
+        else:
+            new_cache["k"] = jnp.pad(ks, pad5)
+            new_cache["v"] = jnp.pad(vs, pad5)
+        return logits, new_cache
 
     def decode_step(self, params: Params, cache: Params,
                     tokens: jnp.ndarray,  # [B] the tokens just sampled
@@ -1132,7 +1173,12 @@ class Transformer:
         # necessary HBM traffic on the decode hot loop (the PPO bottleneck,
         # reference src/training/train_rlhf.py:123-124).
         def body2(carry, xs):
-            layer, k_cache, v_cache = xs
+            if self._kv_int8:
+                layer, k_cache, v_cache, k_s, v_s = xs
+                k_cache = self._dequantize_kv(k_cache, k_s)
+                v_cache = self._dequantize_kv(v_cache, v_s)
+            else:
+                layer, k_cache, v_cache = xs
             h_in = carry
             dh = cfg.head_dim_
             rd = cfg.rotary_dim_
@@ -1180,9 +1226,11 @@ class Transformer:
             x2 = x1 + mlp_out
             return x2, (k, v)
 
-        x, (k_cols, v_cols) = jax.lax.scan(
-            body2, x, (self._with_layer_windows(params["layers"]),
-                       cache["k"], cache["v"]))
+        xs = (self._with_layer_windows(params["layers"]),
+              cache["k"], cache["v"])
+        if self._kv_int8:
+            xs = xs + (cache["k_scale"], cache["v_scale"])
+        x, (k_cols, v_cols) = jax.lax.scan(body2, x, xs)
         h = self._final_norm(params, x)
         logits = self.unembed(params, h[:, 0])
 
@@ -1191,10 +1239,11 @@ class Transformer:
         # scan/while carry XLA aliases the cache buffers, so this is an
         # in-place column write, not a cache copy.
         zero = jnp.zeros((), jnp.int32)
-        k_all = jax.lax.dynamic_update_slice(
-            cache["k"], k_cols, (zero, zero, col, zero, zero))
-        v_all = jax.lax.dynamic_update_slice(
-            cache["v"], v_cols, (zero, zero, col, zero, zero))
+
+        def write_col(buf, cols, rank5=True):
+            idx = (zero, zero, col, zero, zero) if rank5 else \
+                (zero, zero, col, zero)
+            return jax.lax.dynamic_update_slice(buf, cols, idx)
 
         # validity/positions after writing this token
         onehot_col = jax.nn.one_hot(col, max_len, dtype=jnp.int32)[None, :]
@@ -1202,13 +1251,24 @@ class Transformer:
         kv_pos_next = jnp.where(onehot_col > 0, write_idx[:, None], kv_pos)
 
         new_cache = {
-            "k": k_all, "v": v_all,
             "valid": valid_next,
             "lengths": cache["lengths"] + 1,
             "step": cache["step"] + 1,
             "prompt_width": cache["prompt_width"],
             "pos": kv_pos_next,
         }
+        if self._kv_int8:
+            kq, k_s = self._quantize_kv(k_cols)
+            vq, v_s = self._quantize_kv(v_cols)
+            new_cache["k"] = write_col(cache["k"], kq)
+            new_cache["v"] = write_col(cache["v"], vq)
+            new_cache["k_scale"] = write_col(cache["k_scale"], k_s,
+                                             rank5=False)
+            new_cache["v_scale"] = write_col(cache["v_scale"], v_s,
+                                             rank5=False)
+        else:
+            new_cache["k"] = write_col(cache["k"], k_cols)
+            new_cache["v"] = write_col(cache["v"], v_cols)
         return logits, new_cache
 
     def start_decode(self, params: Params, input_ids: jnp.ndarray,

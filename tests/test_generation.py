@@ -114,6 +114,59 @@ def test_engine_text_roundtrip(model_and_params):
     assert all(isinstance(t, str) for t in texts)
 
 
+def test_int8_kv_cache_decode_close_to_fp():
+    """kv_cache_dtype: int8 halves decode's cache HBM traffic; per-token
+    logits must track the full-precision cache closely and greedy
+    generations should agree on a tiny model."""
+    import dataclasses
+
+    import jax
+
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+
+    cfg_fp = get_model_config("tiny-gqa")
+    cfg_q = dataclasses.replace(cfg_fp, kv_cache_dtype="int8")
+    model_fp = Transformer(cfg_fp)
+    model_q = Transformer(cfg_q)
+    params = model_fp.init(jax.random.key(0))
+
+    rs = np.random.RandomState(11)
+    ids = jnp.asarray(rs.randint(1, 100, (2, 12)), jnp.int32)
+    mask = jnp.ones((2, 12), jnp.int32)
+    n_new = 6
+
+    lf, cf = model_fp.start_decode(params, ids, mask, n_new)
+    lq, cq = model_q.start_decode(params, ids, mask, n_new)
+    assert cq["k"].dtype == jnp.int8 and "k_scale" in cq
+    for _ in range(n_new):
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(lf),
+                                   rtol=0.05, atol=0.08)
+        tok = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+        tok_q = jnp.argmax(lq, axis=-1).astype(jnp.int32)
+        np.testing.assert_array_equal(np.asarray(tok), np.asarray(tok_q))
+        lf, cf = model_fp.decode_step(params, cf, tok)
+        lq, cq = model_q.decode_step(params, cq, tok)
+
+
+def test_quantize_kv_roundtrip_error_bound():
+    import jax
+
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+
+    model = Transformer(get_model_config("tiny", kv_cache_dtype="int8"))
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(3, 7, 2, 16).astype(np.float32)) * 3.0
+    q, s = model._quantize_kv(x)
+    back = model._dequantize_kv(q, s)
+    # symmetric int8: worst-case error is half a quantization step,
+    # scale = absmax/127 per (pos, head)
+    step = np.asarray(s)[..., None]
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert (err < 0.51 * step + 1e-6).all(), float((err / step).max())
+
+
 def test_flash_prefill_matches_xla_prefill():
     """Prefill through the blockwise flash kernel == XLA-mask prefill on
     right-padded prompts, for everything downstream consumes: last-real-
