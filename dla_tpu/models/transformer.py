@@ -128,13 +128,19 @@ class Transformer:
         self._softmax_scale = (
             cfg.query_pre_attn_scalar ** -0.5
             if cfg.query_pre_attn_scalar else None)
-        if (cfg.sliding_window and cfg.context_parallel == "ulysses"
-                and _sequence_axis_size() > 1):
+        if cfg.context_parallel == "ulysses" and _sequence_axis_size() > 1:
             # fail at model construction (trainers build models under the
             # ambient mesh, before checkpoint load or compile); the same
-            # refusal backstops at trace time in _attention for models
+            # refusals backstop at trace time in _attention for models
             # built outside the mesh
-            raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
+            if cfg.sliding_window:
+                raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
+            if cfg.attn_logit_softcap or cfg.query_pre_attn_scalar:
+                raise NotImplementedError(
+                    "gemma-2 attention (softcapping / "
+                    "query_pre_attn_scalar) is not supported under "
+                    "ulysses context parallelism; use "
+                    "context_parallel: ring")
 
     # ------------------------------------------------------------------ init
 
@@ -542,15 +548,16 @@ class Transformer:
         t, s = q.shape[1], k.shape[1]
         if cp is not None:
             mode, kv_valid, seg, gapped = cp
-            if self.cfg.sliding_window and mode == "ulysses":
-                raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
-            if self.cfg.sliding_window_pattern > 1:
-                raise NotImplementedError(
-                    "alternating-layer sliding window (gemma-2) under "
-                    "context parallelism is not supported yet; run "
-                    "without a sequence axis or use max_seq within one "
-                    "chip's attention")
             if mode == "ulysses":
+                if self.cfg.sliding_window:
+                    raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
+                if (self.cfg.attn_logit_softcap
+                        or self.cfg.query_pre_attn_scalar is not None):
+                    raise NotImplementedError(
+                        "gemma-2 attention (softcapping / "
+                        "query_pre_attn_scalar) is not supported under "
+                        "ulysses context parallelism; use "
+                        "context_parallel: ring")
                 from dla_tpu.ops.ulysses import ulysses_causal_attention
                 return ulysses_causal_attention(
                     q, k, v, q_positions=q_positions,
@@ -561,11 +568,16 @@ class Transformer:
                     flash_block_q=self.cfg.flash_block_q,
                     flash_block_k=self.cfg.flash_block_k)
             from dla_tpu.ops.ring_attention import ring_causal_attention
+            # `window` comes from _layer_window: a static int (uniform
+            # SWA — enables ring truncation), a traced per-layer scalar
+            # (gemma-2 alternating SWA — mask-only), or None
             return ring_causal_attention(
                 q, k, v, q_positions=q_positions, kv_positions=kv_positions,
                 kv_valid=kv_valid, segment_ids=seg,
-                window=self.cfg.sliding_window or None,
-                window_truncate=not gapped)
+                window=window,
+                window_truncate=not gapped,
+                softmax_scale=self._softmax_scale,
+                logit_softcap=self.cfg.attn_logit_softcap)
         if (self.cfg.attention == "flash" and allow_flash and t == s
                 and _flash_tileable(t)):
             return self._flash(q, k, v, flash_segs)

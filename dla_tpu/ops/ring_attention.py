@@ -40,14 +40,19 @@ NEG_INF = -1e30
 SEQ_AXIS = "sequence"
 
 
-def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
+def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg, win,
                 *, axis_name: str, scale: float,
                 window: Optional[int] = None,
-                window_truncate: bool = True):
+                window_truncate: bool = True,
+                logit_softcap: float = 0.0):
     """Per-device ring attention. All args are local shards:
 
     q [B, Tl, H, D]; k/v [B, Sl, K, D]; q_pos/q_seg [B, Tl];
-    kv_pos/kv_valid/kv_seg [B, Sl]. Returns [B, Tl, H, D].
+    kv_pos/kv_valid/kv_seg [B, Sl]; win is a replicated int32 scalar —
+    the effective window as DATA (2^30 = unwindowed), which lets a
+    per-layer traced window (gemma-2 alternating SWA) ride through;
+    the static ``window`` kwarg only drives the scan truncation.
+    Returns [B, Tl, H, D].
     """
     b, tl, h, d = q.shape
     _, sl, kh, _ = k.shape
@@ -65,14 +70,15 @@ def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
         m, l, acc, k_c, v_c, pos_c, valid_c, seg_c = carry
         s = jnp.einsum("btkgd,bskd->bkgts", qg,
                        k_c.astype(jnp.float32)) * scale     # [B,K,G,Tl,Sl]
+        if logit_softcap:
+            # gemma-2: cap * tanh(s / cap) on the scaled scores, pre-mask
+            s = logit_softcap * jnp.tanh(s / logit_softcap)
         delta = q_pos[:, :, None] - pos_c[:, None, :]        # [B,Tl,Sl]
-        mask = ((delta >= 0)
+        # sliding window on ABSOLUTE positions — correct no matter which
+        # ring slot the kv chunk currently occupies (win = 2^30 when off)
+        mask = ((delta >= 0) & (delta < win)
                 & valid_c[:, None, :].astype(bool)
                 & (q_seg[:, :, None] == seg_c[:, None, :]))  # [B,Tl,Sl]
-        if window is not None:
-            # mistral sliding window on ABSOLUTE positions — correct no
-            # matter which ring slot the kv chunk currently occupies
-            mask = mask & (delta < window)
         s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -102,9 +108,11 @@ def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg,
     # pass window_truncate=False and the full ring runs (the window
     # still applies as a mask term).
     steps = n
-    if window is not None and window_truncate:
+    if isinstance(window, int) and window_truncate:
         # chunks needed = ceil((window-1)/Sl) + 1 (own chunk + how far
         # back the window's oldest position can reach from a chunk start)
+        # — STATIC windows only; a traced per-layer window (gemma-2)
+        # runs the full ring and applies purely as a mask term
         steps = min(n, (max(window, 1) + sl - 2) // sl + 1)
     (m, l, acc, *_), _ = jax.lax.scan(
         step, (m0, l0, acc0, k, v, kv_pos, kv_valid, kv_seg), None,
@@ -125,8 +133,9 @@ def ring_causal_attention(
     segment_ids: Optional[jnp.ndarray] = None,   # [B, T] packed-segment ids
     mesh: Optional[jax.sharding.Mesh] = None,
     softmax_scale: Optional[float] = None,
-    window: Optional[int] = None,   # sliding window (mistral): (q-w, q]
+    window=None,   # sliding window (mistral): (q-w, q]; int OR traced
     window_truncate: bool = True,
+    logit_softcap: float = 0.0,     # gemma-2: cap*tanh(s/cap) pre-mask
 ) -> jnp.ndarray:
     """Causal (GQA) self-attention with the sequence dim ring-sharded.
 
@@ -134,7 +143,9 @@ def ring_causal_attention(
     ``sequence > 1``; also correct (just pointless) at sequence == 1.
     ``window`` restricts attention to the last ``window`` positions
     (absolute-position math, so it composes with the rotation) — the
-    long-context mode mistral-family models need under CP.
+    long-context mode mistral-family models need under CP. It may be a
+    TRACED scalar (gemma-2's per-layer alternating window); only a
+    static int enables the scan truncation.
     ``window_truncate`` (default on) shortens the ring scan to only the
     chunks the window can reach; it REQUIRES positions that are
     physically contiguous per segment (right-padded / packed rows). Pass
@@ -151,6 +162,9 @@ def ring_causal_attention(
         kv_valid = jnp.ones((b, k.shape[1]), jnp.int32)
     if segment_ids is None:
         segment_ids = jnp.zeros((b, t), jnp.int32)
+    # the window rides as DATA (replicated scalar) so per-layer traced
+    # values work; 2^30 disables it without a separate code path
+    win = jnp.asarray(2 ** 30 if window is None else window, jnp.int32)
 
     batch = ("data", "fsdp")
     qspec = P(batch, SEQ_AXIS, "model", None)
@@ -158,11 +172,14 @@ def ring_causal_attention(
 
     fn = jax.shard_map(
         functools.partial(_ring_local, axis_name=SEQ_AXIS, scale=scale,
-                          window=window, window_truncate=window_truncate),
+                          window=window if isinstance(window, int) else None,
+                          window_truncate=window_truncate,
+                          logit_softcap=logit_softcap),
         mesh=mesh,
-        in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec, sspec),
+        in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec, sspec,
+                  P()),
         out_specs=qspec,
         check_vma=False,
     )
     return fn(q, k, v, q_positions, kv_positions,
-              kv_valid.astype(jnp.int32), segment_ids, segment_ids)
+              kv_valid.astype(jnp.int32), segment_ids, segment_ids, win)

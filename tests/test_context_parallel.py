@@ -325,3 +325,77 @@ def test_model_gapped_mask_window_under_ring(seq_mesh):
     m = np.asarray(mask).astype(bool)
     err = np.abs(np.asarray(got) - np.asarray(want))
     assert err[m].max() < 2e-4
+
+
+def test_ring_softcap_and_scale_parity(seq_mesh):
+    """gemma-2 attention numerics under ring CP: score softcapping and a
+    non-default softmax scale must match the XLA path, forward and
+    gradient."""
+    q, k, v, pos = _mk(seed=21)
+
+    def ring_out(q, k, v):
+        return ring_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos,
+            softmax_scale=8 ** -0.5, logit_softcap=5.0)
+
+    def xla_out(q, k, v):
+        return causal_attention(q, k, v, q_positions=pos,
+                                kv_positions=pos, softmax_scale=8 ** -0.5,
+                                logit_softcap=5.0)
+
+    with jax.sharding.set_mesh(seq_mesh):
+        got = ring_out(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(ring_out(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+    want = xla_out(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    gx = jax.grad(lambda *a: jnp.sum(xla_out(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_ring_traced_window_parity(seq_mesh):
+    """A TRACED window scalar (the gemma-2 per-layer alternating SWA
+    mechanism) must mask identically to the static window (which also
+    truncates the ring scan)."""
+    q, k, v, pos = _mk(seed=22)
+    with jax.sharding.set_mesh(seq_mesh):
+        static = ring_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos, window=11)
+        traced = jax.jit(lambda w: ring_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos, window=w)
+        )(jnp.int32(11))
+    np.testing.assert_allclose(np.asarray(traced), np.asarray(static),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gemma2_model_under_ring_cp(seq_mesh):
+    """Full gemma-2 block stack (alternating window + softcaps + custom
+    scale) under ring CP == the no-mesh forward."""
+    import dataclasses
+
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.parallel.sharding import sharding_tree
+
+    cfg = dataclasses.replace(
+        get_model_config("tiny-gqa"),
+        arch="gemma2", sliding_window=6, sliding_window_pattern=2,
+        attn_logit_softcap=20.0, final_logit_softcap=10.0,
+        query_pre_attn_scalar=8, tie_embeddings=True,
+        context_parallel="ring")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(7))
+    rs = np.random.RandomState(8)
+    ids = jnp.asarray(rs.randint(1, 100, (2, 32)), jnp.int32)
+
+    want = model.apply(params, ids)
+    with jax.sharding.set_mesh(seq_mesh):
+        sharded = jax.device_put(
+            params, sharding_tree(model.partition_specs(), seq_mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sharded)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-4)
