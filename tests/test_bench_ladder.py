@@ -184,3 +184,14 @@ def test_sweep_variants_bind_to_run_variant():
     for cited in ("kv4_micro8_packed", "kv4_seq32k_micro1",
                   "kv4_micro8_b256", "hd128_kv4_micro8_bf16m"):
         assert cited in mod.VARIANTS, f"BASELINE.md cites {cited}"
+
+    # same contract for the decode sweep (tools/sweep_decode.py)
+    dpath = os.path.join(os.path.dirname(path), "sweep_decode.py")
+    dspec = importlib.util.spec_from_file_location("sweep_decode", dpath)
+    dmod = importlib.util.module_from_spec(dspec)
+    dspec.loader.exec_module(dmod)
+    dsig = inspect.signature(dmod.run_variant)
+    assert dmod.VARIANTS
+    for name, kw in dmod.VARIANTS.items():
+        dsig.bind(name, **kw)
+    assert "b8_bf16" in dmod.VARIANTS  # the r3 decode comparison point

@@ -1,0 +1,102 @@
+"""On-chip decode sweep: ms/token through the KV-cache engine across
+cache dtypes and batch sizes, with the HBM roofline printed next to each
+row — the measurement tool for VERDICT r4 items 2 (decode-to-roofline
+after the no-copy restructure) and the int8-cache win.
+
+    python tools/sweep_decode.py [variant ...]   # default: all
+
+Each variant runs in a FRESH child process (same OOM-poisoning rationale
+as tools/sweep_bench.py). Roofline model per decode step:
+params_bytes + kv_bytes_per_step, all at the chip's peak HBM bandwidth.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_variant(name: str, *, batch=8, prompt=128, new=256,
+                kv_dtype="bfloat16", hidden=1024, inter=2816, layers=24,
+                heads=8, kv_heads=4) -> dict:
+    import jax
+
+    from bench import count_params, hbm_bw
+    from dla_tpu.eval.eval_latency import measure_decode
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+
+    cfg = ModelConfig(
+        vocab_size=32000, hidden_size=hidden, intermediate_size=inter,
+        num_layers=layers, num_heads=heads, num_kv_heads=kv_heads,
+        max_seq_length=4096, attention="flash", remat="none",
+        kv_cache_dtype=kv_dtype)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    jax.block_until_ready(params)
+    n_params = count_params(params)
+
+    t0 = time.perf_counter()
+    row = measure_decode(model, params, batch, prompt, new)
+    wall = time.perf_counter() - t0
+
+    # roofline: per decode step, every parameter byte is read once for
+    # the whole batch; the KV cache (avg fill ~ prompt + new/2 columns)
+    # is read once per step; writes are one column (negligible)
+    dev = jax.devices()[0]
+    p_bytes = 2.0 * n_params
+    kv_elem = 1 if kv_dtype == "int8" else 2
+    avg_fill = prompt + new / 2
+    kv_bytes = (2 * layers * batch * avg_fill
+                * kv_heads * cfg.head_dim_ * kv_elem)
+    roofline_ms = (p_bytes + kv_bytes) / hbm_bw(dev) * 1000
+    out = {"variant": name, "ms_per_token": row["ms_per_token"],
+           "decode_tok_s_chip": round(
+               row["decode_tokens_per_second_per_chip"], 1),
+           "roofline_ms": round(roofline_ms, 3),
+           "x_roofline": round(row["ms_per_token"] / roofline_ms, 2),
+           "batch": batch, "prompt": prompt, "new": new,
+           "kv": kv_dtype, "params_m": round(n_params / 1e6),
+           "wall_s": round(wall, 1)}
+    print(out, flush=True)
+    return out
+
+
+VARIANTS = {
+    # the BASELINE.md r3 comparison point: 349M, batch 8 — r3 measured
+    # 2.53 ms/token (~2x roofline) before the no-copy restructure
+    "b8_bf16": dict(batch=8, kv_dtype="bfloat16"),
+    "b8_int8": dict(batch=8, kv_dtype="int8"),
+    # bigger batch amortizes the param reads; cache share grows
+    "b32_bf16": dict(batch=32, kv_dtype="bfloat16"),
+    "b32_int8": dict(batch=32, kv_dtype="int8"),
+    # the PPO rollout shape (128 prompt + 128 new)
+    "b64_n128_int8": dict(batch=64, prompt=128, new=128, kv_dtype="int8"),
+}
+
+
+def main():
+    names = sys.argv[1:] or list(VARIANTS)
+    if len(names) == 1:
+        n = names[0]
+        try:
+            run_variant(n, **VARIANTS[n])
+        except Exception as e:  # OOM etc
+            print({"variant": n, "error": f"{type(e).__name__}: {e}"[:300]},
+                  flush=True)
+            sys.exit(1)
+        return
+    import subprocess
+    for n in names:
+        subprocess.run([sys.executable, os.path.abspath(__file__), n],
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    print("== decode sweep done ==")
+
+
+if __name__ == "__main__":
+    main()
