@@ -170,28 +170,23 @@ def test_sweep_variants_bind_to_run_variant():
     import inspect
     import os
 
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "tools", "sweep_bench.py")
-    spec = importlib.util.spec_from_file_location("sweep_bench", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-
-    sig = inspect.signature(mod.run_variant)
-    assert mod.VARIANTS, "sweep has no variants"
-    for name, kw in mod.VARIANTS.items():
-        sig.bind(name, **kw)  # raises TypeError on a bad kwarg
-    # the exact reproduction commands BASELINE.md cites must resolve
-    for cited in ("kv4_micro8_packed", "kv4_seq32k_micro1",
-                  "kv4_micro8_b256", "hd128_kv4_micro8_bf16m"):
-        assert cited in mod.VARIANTS, f"BASELINE.md cites {cited}"
-
-    # same contract for the decode sweep (tools/sweep_decode.py)
-    dpath = os.path.join(os.path.dirname(path), "sweep_decode.py")
-    dspec = importlib.util.spec_from_file_location("sweep_decode", dpath)
-    dmod = importlib.util.module_from_spec(dspec)
-    dspec.loader.exec_module(dmod)
-    dsig = inspect.signature(dmod.run_variant)
-    assert dmod.VARIANTS
-    for name, kw in dmod.VARIANTS.items():
-        dsig.bind(name, **kw)
-    assert "b8_bf16" in dmod.VARIANTS  # the r3 decode comparison point
+    tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    cited_by_tool = {
+        # the exact reproduction commands BASELINE.md cites must resolve
+        "sweep_bench.py": ("kv4_micro8_packed", "kv4_seq32k_micro1",
+                           "kv4_micro8_b256", "hd128_kv4_micro8_bf16m"),
+        # the r3 decode comparison point
+        "sweep_decode.py": ("b8_bf16",),
+    }
+    for fname, cited in cited_by_tool.items():
+        path = os.path.join(tools_dir, fname)
+        spec = importlib.util.spec_from_file_location(fname[:-3], path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sig = inspect.signature(mod.run_variant)
+        assert mod.VARIANTS, f"{fname} has no variants"
+        for name, kw in mod.VARIANTS.items():
+            sig.bind(name, **kw)  # raises TypeError on a bad kwarg
+        for c in cited:
+            assert c in mod.VARIANTS, f"BASELINE.md cites {fname}:{c}"

@@ -30,35 +30,47 @@ def run_variant(name: str, *, batch=8, prompt=128, new=256,
     from dla_tpu.models.config import ModelConfig
     from dla_tpu.models.transformer import Transformer
 
+    # bf16 params: the inference/rollout storage dtype (fp32 masters
+    # would double the per-step weight read and corrupt the roofline
+    # comparison — review r4)
     cfg = ModelConfig(
         vocab_size=32000, hidden_size=hidden, intermediate_size=inter,
         num_layers=layers, num_heads=heads, num_kv_heads=kv_heads,
         max_seq_length=4096, attention="flash", remat="none",
+        dtype="bfloat16", param_dtype="bfloat16",
         kv_cache_dtype=kv_dtype)
     model = Transformer(cfg)
     params = model.init(jax.random.key(0))
     jax.block_until_ready(params)
     n_params = count_params(params)
+    p_bytes = float(sum(l.size * l.dtype.itemsize
+                        for l in jax.tree.leaves(params)))
 
     t0 = time.perf_counter()
     row = measure_decode(model, params, batch, prompt, new)
+    # measure_decode times the whole generate fn (prefill + decode
+    # scan); subtract a 1-new-token run (~pure prefill) so ms/token is
+    # decode-only — at the PPO rollout shape prefill is a double-digit
+    # share of the total
+    pre = measure_decode(model, params, batch, prompt, 1)
     wall = time.perf_counter() - t0
+    total_ms = row["ms_per_token"] * new
+    decode_ms = (total_ms - pre["ms_per_token"]) / (new - 1)
 
     # roofline: per decode step, every parameter byte is read once for
     # the whole batch; the KV cache (avg fill ~ prompt + new/2 columns)
     # is read once per step; writes are one column (negligible)
     dev = jax.devices()[0]
-    p_bytes = 2.0 * n_params
     kv_elem = 1 if kv_dtype == "int8" else 2
     avg_fill = prompt + new / 2
     kv_bytes = (2 * layers * batch * avg_fill
                 * kv_heads * cfg.head_dim_ * kv_elem)
     roofline_ms = (p_bytes + kv_bytes) / hbm_bw(dev) * 1000
-    out = {"variant": name, "ms_per_token": row["ms_per_token"],
-           "decode_tok_s_chip": round(
-               row["decode_tokens_per_second_per_chip"], 1),
+    out = {"variant": name, "ms_per_token": round(decode_ms, 3),
+           "ms_per_token_incl_prefill": round(row["ms_per_token"], 3),
+           "decode_tok_s_chip": round(1000.0 * batch / decode_ms, 1),
            "roofline_ms": round(roofline_ms, 3),
-           "x_roofline": round(row["ms_per_token"] / roofline_ms, 2),
+           "x_roofline": round(decode_ms / roofline_ms, 2),
            "batch": batch, "prompt": prompt, "new": new,
            "kv": kv_dtype, "params_m": round(n_params / 1e6),
            "wall_s": round(wall, 1)}
