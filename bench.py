@@ -512,7 +512,22 @@ def _emit_and_maybe_extra() -> None:
         print(json.dumps(res), file=sys.stderr)
         extra.append(res)
     # BENCH_extra.json is the on-chip evidence artifact BASELINE.md
-    # cites — a forced-CPU fallback run must not clobber it
+    # cites — a forced-CPU fallback run must not clobber it. Each
+    # artifact carries its provenance (commit + wall time) so the
+    # BASELINE.md tables can cite rows unambiguously.
+    import datetime
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO_ROOT,
+            capture_output=True, text=True, timeout=10)
+        commit = proc.stdout.strip() if proc.returncode == 0 else ""
+    except Exception:  # noqa: BLE001 — provenance must not kill the line
+        commit = ""
+    commit = commit or "unknown"
+    extra.append({"provenance": {
+        "commit": commit,
+        "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds")}})
     import jax
     name = ("BENCH_extra.json" if jax.devices()[0].platform != "cpu"
             else "BENCH_extra_cpu.json")

@@ -46,14 +46,18 @@ def run_variant(name: str, *, batch=8, prompt=128, new=256,
     p_bytes = float(sum(l.size * l.dtype.itemsize
                         for l in jax.tree.leaves(params)))
 
+    if new < 2:
+        raise ValueError("sweep_decode needs new >= 2 (the prefill "
+                         "subtraction divides by new - 1)")
     t0 = time.perf_counter()
     row = measure_decode(model, params, batch, prompt, new)
+    wall = time.perf_counter() - t0
     # measure_decode times the whole generate fn (prefill + decode
     # scan); subtract a 1-new-token run (~pure prefill) so ms/token is
     # decode-only — at the PPO rollout shape prefill is a double-digit
-    # share of the total
+    # share of the total. (Timed outside `wall` so wall_s keeps its
+    # one-measurement meaning.)
     pre = measure_decode(model, params, batch, prompt, 1)
-    wall = time.perf_counter() - t0
     total_ms = row["ms_per_token"] * new
     decode_ms = (total_ms - pre["ms_per_token"]) / (new - 1)
 
@@ -68,7 +72,8 @@ def run_variant(name: str, *, batch=8, prompt=128, new=256,
     roofline_ms = (p_bytes + kv_bytes) / hbm_bw(dev) * 1000
     out = {"variant": name, "ms_per_token": round(decode_ms, 3),
            "ms_per_token_incl_prefill": round(row["ms_per_token"], 3),
-           "decode_tok_s_chip": round(1000.0 * batch / decode_ms, 1),
+           "decode_tok_s_chip": round(
+               1000.0 * batch / decode_ms / jax.device_count(), 1),
            "roofline_ms": round(roofline_ms, 3),
            "x_roofline": round(decode_ms / roofline_ms, 2),
            "batch": batch, "prompt": prompt, "new": new,
