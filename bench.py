@@ -347,6 +347,9 @@ def run_ppo_bench() -> dict:
         # ref == frozen base (LoRA aliasing, train_rlhf.py:283-285)
         score_fn = make_score_fn(policy, policy, rm)
         merge_fn = jax.jit(policy.merge_lora)
+        # int8 weight-only rollouts: halves the decode loop's dominant
+        # HBM traffic (ppo.rollout_quantize_weights in the trainer)
+        quant_fn = jax.jit(policy.quantize_weights)
 
         rs = np.random.RandomState(0)
         ids = rs.randint(1, cfg.vocab_size, (batch, prompt_w)).astype(np.int32)
@@ -355,7 +358,7 @@ def run_ppo_bench() -> dict:
         mask_d = jax.device_put(jnp.asarray(mask))
 
         def one_rollout(i):
-            merged = merge_fn(base, trainer.params)
+            merged = quant_fn(merge_fn(base, trainer.params))
             out = generate_fn(merged, ids_d, mask_d, jax.random.key(i))
             scores = score_fn(merged, base, rm_params,
                               out["sequences"], out["sequence_mask"],
@@ -386,6 +389,7 @@ def run_ppo_bench() -> dict:
         "vs_baseline": round(samples_s / (0.8 * baseline), 4),
         "detail": {"batch": batch, "prompt_len": prompt_w,
                    "new_tokens": new_tokens, "lora_r": cfg.lora_r,
+                   "rollout_weights": "int8", "kv_cache": cfg.kv_cache_dtype,
                    "params_m": round(n_params / 1e6),
                    "baseline_samples_s_chip": round(baseline, 2),
                    "platform": dev.device_kind},

@@ -422,7 +422,7 @@ class Transformer:
             return w.astype(self.adtype)
 
         def proj(name, inp):
-            out = inp @ cast(layer[name])
+            out = inp @ self._weight(layer, name)
             bias = layer.get(f"{name}_bias")
             if bias is not None:
                 out = out + cast(bias)
@@ -516,6 +516,49 @@ class Transformer:
         win = ((jnp.arange(cfg.num_layers) + 1)
                % cfg.sliding_window_pattern != 0)
         return {**layers, "swa_on": win}
+
+    def _weight(self, container: Params, name: str) -> jnp.ndarray:
+        """The named weight matrix in activation dtype. int8 weight-only
+        storage (``quantize_weights``) dequantizes on the fly via the
+        ``<name>_wscale`` per-output-channel scales — XLA reads int8
+        from HBM and fuses convert*scale into the consuming matmul, so
+        the weight read traffic halves vs bf16 (the dominant bytes of
+        the HBM-bound decode loop). Full-precision trees hit the plain
+        astype path (dtype check is trace-time — zero runtime cost)."""
+        w = container[name]
+        if w.dtype == jnp.int8:
+            return (w.astype(self.adtype)
+                    * container[name + "_wscale"].astype(self.adtype))
+        return w.astype(self.adtype)
+
+    _WEIGHT_ONLY_MATS = ("wq", "wk", "wv", "wo", "w_gate", "w_up",
+                         "w_down", "fc1", "fc2")
+
+    def quantize_weights(self, params: Params) -> Params:
+        """Weight-only int8 copy of a param tree for ROLLOUT decode
+        (RLHF's hot loop): each dense [L, in, out] matrix stores int8
+        with symmetric per-(layer, out-channel) fp32 scales
+        (absmax/127 over the in dim). Embeddings, norms, biases, the
+        tied unembedding, and MoE expert stacks stay full precision.
+        The update/scoring paths keep using the original tree — only
+        the sampled tokens see quantization."""
+        out_layers: Params = {}
+        for key, val in params["layers"].items():
+            if (key in self._WEIGHT_ONLY_MATS and val.ndim == 3
+                    and val.dtype != jnp.int8):  # idempotent: re-apply
+                # of an already-quantized tree must not re-scale
+                q, scale = self._symmetric_int8(val, axis=1)  # [L,1,out]
+                out_layers[key] = q
+                out_layers[key + "_wscale"] = scale
+            else:
+                out_layers[key] = val
+        new = {**params, "layers": out_layers}
+        lm = params.get("lm_head")
+        if lm is not None and lm.dtype != jnp.int8:      # [D, V]
+            q, scale = self._symmetric_int8(lm, axis=0)  # [1, V]
+            new["lm_head"] = q
+            new["lm_head_wscale"] = scale
+        return new
 
     def _layer_window(self, layer: Params):
         """Effective window for a layer: the static config window, or —
@@ -1001,7 +1044,7 @@ class Transformer:
         if self.cfg.tie_embeddings:
             w = params["embed"]["embedding"].astype(self.adtype).T
         else:
-            w = params["lm_head"].astype(self.adtype)
+            w = self._weight(params, "lm_head")
         bias = params.get("lm_head_bias")
         return w, None if bias is None else bias.astype(self.adtype)
 
@@ -1042,6 +1085,19 @@ class Transformer:
     def _kv_int8(self) -> bool:
         return self.cfg.kv_cache_dtype == "int8"
 
+    @staticmethod
+    def _symmetric_int8(x: jnp.ndarray, axis: int
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Symmetric int8 quantization along ``axis``: (int8 values,
+        fp32 scale with keepdims). The one recipe shared by the KV cache
+        and weight-only paths (absmax/127, round, clip)."""
+        absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                         keepdims=True)
+        scale = absmax / 127.0 + 1e-12
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                     -127, 127).astype(jnp.int8)
+        return q, scale
+
     def _quantize_kv(self, x: jnp.ndarray
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """[..., D] -> (int8 values, fp32 scale [...]): symmetric
@@ -1049,11 +1105,8 @@ class Transformer:
         head dim). Dequantization (q * scale) fuses into the attention
         einsum, so the cache's HBM read traffic halves on the
         bandwidth-bound decode loop."""
-        ax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-        scale = ax / 127.0 + 1e-12
-        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
-                     -127, 127).astype(jnp.int8)
-        return q, scale
+        q, scale = self._symmetric_int8(x, axis=-1)
+        return q, scale[..., 0]
 
     def _dequantize_kv(self, q: jnp.ndarray, scale: jnp.ndarray
                        ) -> jnp.ndarray:
@@ -1199,7 +1252,7 @@ class Transformer:
                 return w.astype(self.adtype)
 
             def proj(name, inp):
-                out = inp @ cast(layer[name])
+                out = inp @ self._weight(layer, name)
                 bias = layer.get(f"{name}_bias")
                 return out if bias is None else out + cast(bias)
 

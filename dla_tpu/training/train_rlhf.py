@@ -416,10 +416,20 @@ def main(argv=None) -> None:
             return (trainer.params["policy"] if algo == "gae"
                     else trainer.params)
 
+        # ppo.rollout_quantize_weights: sample from an int8 weight-only
+        # copy of the policy (halves the HBM-bound decode loop's weight
+        # reads). reinforce/ppo scoring shares the same quantized tree,
+        # so behavior_logp matches the actual sampling distribution; the
+        # UPDATE keeps full precision. (gae scores from the fp tree — a
+        # small behavior mismatch of the usual quantized-rollout kind.)
+        quant_fn = None
+        if bool(ppo_cfg.get("rollout_quantize_weights", False)):
+            quant_fn = jax.jit(policy.model.quantize_weights)
+
         def rollout_params():
-            if merge_fn is None:
-                return policy_tree()
-            return merge_fn(trainer.frozen["base"], policy_tree())
+            p = (policy_tree() if merge_fn is None
+                 else merge_fn(trainer.frozen["base"], policy_tree()))
+            return quant_fn(p) if quant_fn is not None else p
 
         prompts = load_prompt_records(config.get("sampling", {}))
         if not prompts:

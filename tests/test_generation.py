@@ -151,6 +151,43 @@ def test_int8_kv_cache_decode_close_to_fp():
                                    rtol=0.05, atol=0.08)
 
 
+def test_int8_weight_only_decode_tracks_fp():
+    """quantize_weights (weight-only int8 rollout params): full forward
+    and KV-cache decode over the quantized tree stay close to full
+    precision, and greedy decode agrees on a tiny model — the
+    ppo.rollout_quantize_weights path."""
+    import jax
+
+    from dla_tpu.models.config import get_model_config
+    from dla_tpu.models.transformer import Transformer
+
+    model = Transformer(get_model_config("tiny-gqa"))
+    params = model.init(jax.random.key(0))
+    qparams = model.quantize_weights(params)
+    assert qparams["layers"]["wq"].dtype == jnp.int8
+    assert "wq_wscale" in qparams["layers"]
+    assert qparams["lm_head"].dtype == jnp.int8
+
+    rs = np.random.RandomState(12)
+    ids = jnp.asarray(rs.randint(1, 100, (2, 12)), jnp.int32)
+    full = model.apply(params, ids)
+    quant = model.apply(qparams, ids)
+    np.testing.assert_allclose(np.asarray(quant), np.asarray(full),
+                               rtol=0.08, atol=0.25)
+
+    mask = jnp.ones((2, 12), jnp.int32)
+    lf, cf = model.start_decode(params, ids, mask, 5)
+    lq, cq = model.start_decode(qparams, ids, mask, 5)
+    for _ in range(5):
+        tok = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+        tok_q = jnp.argmax(lq, axis=-1).astype(jnp.int32)
+        np.testing.assert_array_equal(np.asarray(tok), np.asarray(tok_q))
+        lf, cf = model.decode_step(params, cf, tok)
+        lq, cq = model.decode_step(qparams, cq, tok)
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(lf),
+                                   rtol=0.08, atol=0.3)
+
+
 def test_quantize_kv_roundtrip_error_bound():
     import dataclasses
 
