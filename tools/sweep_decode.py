@@ -21,7 +21,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_variant(name: str, *, batch=8, prompt=128, new=256,
-                kv_dtype="bfloat16", hidden=1024, inter=2816, layers=24,
+                kv_dtype="bfloat16", weights="bfloat16",
+                hidden=1024, inter=2816, layers=24,
                 heads=8, kv_heads=4) -> dict:
     import jax
 
@@ -41,6 +42,8 @@ def run_variant(name: str, *, batch=8, prompt=128, new=256,
         kv_cache_dtype=kv_dtype)
     model = Transformer(cfg)
     params = model.init(jax.random.key(0))
+    if weights == "int8":   # the rollout_quantize_weights path
+        params = model.quantize_weights(params)
     jax.block_until_ready(params)
     n_params = count_params(params)
     p_bytes = float(sum(l.size * l.dtype.itemsize
@@ -77,7 +80,8 @@ def run_variant(name: str, *, batch=8, prompt=128, new=256,
            "roofline_ms": round(roofline_ms, 3),
            "x_roofline": round(decode_ms / roofline_ms, 2),
            "batch": batch, "prompt": prompt, "new": new,
-           "kv": kv_dtype, "params_m": round(n_params / 1e6),
+           "kv": kv_dtype, "weights": weights,
+           "params_m": round(n_params / 1e6),
            "wall_s": round(wall, 1)}
     print(out, flush=True)
     return out
@@ -93,6 +97,11 @@ VARIANTS = {
     "b32_int8": dict(batch=32, kv_dtype="int8"),
     # the PPO rollout shape (128 prompt + 128 new)
     "b64_n128_int8": dict(batch=64, prompt=128, new=128, kv_dtype="int8"),
+    # the full rollout stack: int8 weights (rollout_quantize_weights)
+    # + int8 cache — both halves of the decode HBM traffic
+    "b8_w8kv8": dict(batch=8, kv_dtype="int8", weights="int8"),
+    "b64_n128_w8kv8": dict(batch=64, prompt=128, new=128,
+                           kv_dtype="int8", weights="int8"),
 }
 
 
