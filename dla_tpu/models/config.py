@@ -337,5 +337,10 @@ register_model("meta-llama/Llama-2-13b-hf", _REGISTRY["llama2-13b"])
 register_model("meta-llama/Llama-2-70b-hf", _REGISTRY["llama2-70b"])
 register_model("mistralai/Mistral-7B-v0.1", _REGISTRY["mistral-7b"])
 register_model("Qwen/Qwen2-7B", _REGISTRY["qwen2-7b"])
+# qwen2.5 shares the qwen2 architecture and the 7B's exact dims
+# (config.json differs only in sliding-window metadata, which HF
+# defaults to off — hf_import handles real config.json files directly)
+register_model("qwen2.5-7b", _REGISTRY["qwen2-7b"])
+register_model("Qwen/Qwen2.5-7B", _REGISTRY["qwen2-7b"])
 register_model("microsoft/phi-2", _REGISTRY["phi-2"])
 register_model("mistralai/Mixtral-8x7B-v0.1", _REGISTRY["mixtral-8x7b"])
