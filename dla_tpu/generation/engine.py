@@ -9,9 +9,14 @@ sampling (generate_teacher_data.py:72-79), and evaluation
 strings, no re-tokenization round-trip (the reference's host bounce,
 SURVEY.md sec 3.3).
 
-Design: prompts arrive right-padded to a static width P; decode runs a
-``lax.scan`` of exactly ``max_new_tokens`` steps (static shapes; finished
-rows keep writing pad). Per-row true positions are tracked so rotary
+Design: prompts arrive right-padded to a static width P; decode is
+static-shape throughout. With a real EOS id (the default for
+RLHF/eval/teacher-gen) it runs a ``lax.while_loop`` that EXITS EARLY
+once every row has finished — finished rows keep writing pad into
+preallocated [N] buffers, so the outputs are bit-identical to the
+fixed-length schedule (pinned by test). With ``eos_token_id < 0``
+(bench/fixed-length paths) it runs a plain ``lax.scan`` of exactly
+``max_new_tokens`` steps. Per-row true positions are tracked so rotary
 phases match contiguous sequences; ``left_align`` compacts
 [prompt pad gap response] rows into contiguous right-padded sequences for
 downstream in-graph consumers (logprob, reward scoring).
@@ -85,22 +90,56 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig):
         logits, cache = model.start_decode(
             params, input_ids, attention_mask, n)
 
-        def body(carry, step_rng):
-            logits, cache, done = carry
+        rngs = jax.random.split(rng, n)
+        done0 = jnp.zeros((b,), bool)
+
+        def step_fn(step, logits, cache, done):
             tok = sample_token(
-                step_rng, logits,
+                rngs[step], logits,
                 temperature=gen.temperature, top_p=gen.top_p,
                 top_k=gen.top_k, do_sample=gen.do_sample)
             tok = jnp.where(done, gen.pad_token_id, tok)
             emit_mask = ~done
             done = done | (tok == gen.eos_token_id)
             logits, cache = model.decode_step(params, cache, tok)
-            return (logits, cache, done), (tok, emit_mask)
+            return tok, emit_mask, logits, cache, done
 
-        rngs = jax.random.split(rng, n)
-        done0 = jnp.zeros((b,), bool)
-        (_, _, _), (toks, emits) = jax.lax.scan(
-            body, (logits, cache, done0), rngs)
+        if gen.eos_token_id is not None and gen.eos_token_id >= 0:
+            # early exit: a while_loop that stops once every row has hit
+            # EOS — real savings for eval/teacher-gen/rollout batches
+            # whose sequences finish before max_new_tokens. Identical
+            # math/rng stream to the scan path (same pre-split keys
+            # indexed by step; unreached steps leave pad/0 rows).
+            toks0 = jnp.full((n, b), gen.pad_token_id, jnp.int32)
+            emits0 = jnp.zeros((n, b), bool)
+
+            def cond(state):
+                step, _, _, done, _, _ = state
+                return (step < n) & ~jnp.all(done)
+
+            def body(state):
+                step, logits, cache, done, toks, emits = state
+                tok, emit_mask, logits, cache, done = step_fn(
+                    step, logits, cache, done)
+                toks = jax.lax.dynamic_update_slice(
+                    toks, tok[None, :], (step, 0))
+                emits = jax.lax.dynamic_update_slice(
+                    emits, emit_mask[None, :], (step, 0))
+                return step + 1, logits, cache, done, toks, emits
+
+            *_, toks, emits = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), logits, cache, done0, toks0, emits0))
+        else:
+            # no EOS (bench/fixed-length paths): plain scan over n steps
+            def scan_body(carry, step):
+                logits, cache, done = carry
+                tok, emit_mask, logits, cache, done = step_fn(
+                    step, logits, cache, done)
+                return (logits, cache, done), (tok, emit_mask)
+
+            (_, _, _), (toks, emits) = jax.lax.scan(
+                scan_body, (logits, cache, done0), jnp.arange(n))
         response_tokens = toks.T                      # [B, N]
         response_mask = emits.T.astype(jnp.int32)     # [B, N]
 

@@ -114,6 +114,72 @@ def test_engine_text_roundtrip(model_and_params):
     assert all(isinstance(t, str) for t in texts)
 
 
+def test_early_exit_while_matches_scan_path(model_and_params):
+    """The early-exit while_loop (eos >= 0) must produce bit-identical
+    outputs to the fixed-length scan path (eos < 0) when no row ever
+    hits EOS — same pre-split rng keys indexed by step."""
+    model, params = model_and_params
+    import dataclasses
+
+    from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
+
+    rs = np.random.RandomState(7)
+    ids = jnp.asarray(rs.randint(3, 100, (2, 8)), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    base = GenerationConfig(max_new_tokens=6, do_sample=True,
+                            temperature=1.0, pad_token_id=0,
+                            eos_token_id=-1)
+    # an eos id outside the vocab is never sampled: the while path runs
+    # all n steps and must match the scan path exactly
+    unreachable = dataclasses.replace(
+        base, eos_token_id=model.cfg.vocab_size + 7)
+    out_scan = jax.jit(build_generate_fn(model, base))(
+        params, ids, mask, jax.random.key(3))
+    out_while = jax.jit(build_generate_fn(model, unreachable))(
+        params, ids, mask, jax.random.key(3))
+    for k in out_scan:
+        np.testing.assert_array_equal(np.asarray(out_scan[k]),
+                                      np.asarray(out_while[k]), err_msg=k)
+
+
+def test_early_exit_actually_exits_and_matches_masked_scan(
+        model_and_params):
+    """When EOS really fires mid-sequence, the while path must equal the
+    fixed-length scan output with post-EOS positions replaced by
+    pad/emit-0 — covering the early-termination machinery itself (buffer
+    prefill, cond's all(done) exit), not just the never-fires case."""
+    model, params = model_and_params
+    import dataclasses
+
+    from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
+
+    rs = np.random.RandomState(9)
+    ids = jnp.asarray(rs.randint(3, 100, (2, 8)), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    base = GenerationConfig(max_new_tokens=6, do_sample=False,
+                            pad_token_id=0, eos_token_id=-1)
+    ref = jax.jit(build_generate_fn(model, base))(
+        params, ids, mask, jax.random.key(0))
+    # pick the token row 0 emits greedily at step 2 as the EOS id: it
+    # demonstrably fires mid-sequence for at least that row
+    eos = int(np.asarray(ref["response_tokens"])[0, 2])
+    out = jax.jit(build_generate_fn(
+        model, dataclasses.replace(base, eos_token_id=eos)))(
+        params, ids, mask, jax.random.key(0))
+
+    want_toks = np.asarray(ref["response_tokens"]).copy()
+    want_mask = np.ones_like(want_toks)
+    for r in range(want_toks.shape[0]):
+        hits = np.where(want_toks[r] == eos)[0]
+        if hits.size:                      # eos kept, everything after pad
+            want_toks[r, hits[0] + 1:] = 0
+            want_mask[r, hits[0] + 1:] = 0
+    np.testing.assert_array_equal(np.asarray(out["response_tokens"]),
+                                  want_toks)
+    np.testing.assert_array_equal(np.asarray(out["response_mask"]),
+                                  want_mask)
+
+
 def test_int8_kv_cache_decode_close_to_fp():
     """kv_cache_dtype: int8 halves decode's cache HBM traffic; per-token
     logits must track the full-precision cache closely and greedy
