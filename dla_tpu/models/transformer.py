@@ -30,7 +30,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dla_tpu.models.config import ModelConfig
-from dla_tpu.ops.attention import causal_attention, decode_attention
+from dla_tpu.ops.attention import (
+    causal_attention,
+    chunked_causal_attention,
+    decode_attention,
+)
 from dla_tpu.ops.norms import layer_norm, rms_norm
 from dla_tpu.ops.rotary import apply_rotary, rotary_angles
 
@@ -624,13 +628,20 @@ class Transformer:
         if (self.cfg.attention == "flash" and allow_flash and t == s
                 and _flash_tileable(t)):
             return self._flash(q, k, v, flash_segs)
-        return causal_attention(
-            q, k, v, kv_segment_mask=kv_segment_mask,
+        kw = dict(
+            kv_segment_mask=kv_segment_mask,
             q_positions=q_positions, kv_positions=kv_positions,
             window=window if window is not None
             else (self.cfg.sliding_window or None),
             softmax_scale=self._softmax_scale,
             logit_softcap=self.cfg.attn_logit_softcap)
+        from dla_tpu.ops.attention import DEFAULT_Q_CHUNK
+        if t == s and t > DEFAULT_Q_CHUNK:
+            # flash-ineligible long sequences (gemma-2 softcap/per-layer
+            # window, gapped masks): query-chunked to keep live scores
+            # O(T * chunk), forward AND backward (checkpointed scan)
+            return chunked_causal_attention(q, k, v, **kw)
+        return causal_attention(q, k, v, **kw)
 
     def _flash(self, q, k, v, segs: Optional[Tuple]):
         """Invoke the pallas flash kernel, shard_map-wrapped when the

@@ -15,9 +15,13 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30  # large-but-finite: -inf breaks softmax rows that are fully masked
+# query-block size for chunked_causal_attention; the dispatch gate in
+# models/transformer.py keys off this same constant
+DEFAULT_Q_CHUNK = 512
 
 
 def causal_attention(
@@ -80,6 +84,81 @@ def causal_attention(
     weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-30)
     out = jnp.einsum("bkgts,bskd->btkgd", weights.astype(v.dtype), v)
     return out.reshape(b, t, h, d)
+
+
+def chunked_causal_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, S, K, D]
+    v: jnp.ndarray,  # [B, S, K, D]
+    *,
+    kv_segment_mask: Optional[jnp.ndarray] = None,  # [B, T, S]
+    q_positions: Optional[jnp.ndarray] = None,      # [B, T]
+    kv_positions: Optional[jnp.ndarray] = None,     # [B, S]
+    softmax_scale: Optional[float] = None,
+    window=None,                     # static int or traced scalar
+    logit_softcap: float = 0.0,
+    q_chunk: int = DEFAULT_Q_CHUNK,
+) -> jnp.ndarray:
+    """causal_attention computed one query block at a time: peak live
+    scores are [B, H, q_chunk, S] instead of [B, H, T, T].
+
+    This is the O(T)-memory path for models the Pallas flash kernel
+    cannot serve (gemma-2: softcapping / per-layer windows / custom
+    scale) — without it their training forward+backward materializes
+    quadratic score tensors, the same class of blowup ops.fused_ce
+    exists to kill on the loss side. The scan body is jax.checkpoint-ed
+    so the BACKWARD also recomputes per chunk rather than saving every
+    chunk's weights (which would re-materialize the full [B, H, T, S]).
+    A T that doesn't divide into chunks is PADDED up (pad query rows
+    compute garbage nothing consumes; outputs sliced back to T), so the
+    O(T * chunk) bound holds for every length. Exactly equal to
+    causal_attention (same masks, positions, window, softcap, scale
+    semantics).
+    """
+    b, t, h, d = q.shape
+    if t <= q_chunk:
+        return causal_attention(
+            q, k, v, kv_segment_mask=kv_segment_mask,
+            q_positions=q_positions, kv_positions=kv_positions,
+            softmax_scale=softmax_scale, window=window,
+            logit_softcap=logit_softcap)
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    pad = (-t) % q_chunk
+    tp = t + pad
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        # pad rows get in-range causal positions; their outputs are
+        # garbage that the final slice drops
+        q_positions = jnp.pad(q_positions, ((0, 0), (0, pad)),
+                              constant_values=0)
+        if kv_segment_mask is not None:
+            kv_segment_mask = jnp.pad(
+                kv_segment_mask, ((0, 0), (0, pad), (0, 0)),
+                constant_values=1)
+    nc = tp // q_chunk
+    q_c = q.reshape(b, nc, q_chunk, h, d).transpose(1, 0, 2, 3, 4)
+    pos_c = q_positions.reshape(b, nc, q_chunk).transpose(1, 0, 2)
+    xs = (q_c, pos_c)
+    if kv_segment_mask is not None:
+        xs = xs + (kv_segment_mask.reshape(
+            b, nc, q_chunk, kv_segment_mask.shape[-1]
+        ).transpose(1, 0, 2, 3),)
+
+    def body(_, chunk_xs):
+        if kv_segment_mask is not None:
+            qc, pc, mc = chunk_xs
+        else:
+            qc, pc = chunk_xs
+            mc = None
+        out = causal_attention(
+            qc, k, v, kv_segment_mask=mc, q_positions=pc,
+            kv_positions=kv_positions, softmax_scale=softmax_scale,
+            window=window, logit_softcap=logit_softcap)
+        return None, out
+
+    _, outs = jax.lax.scan(jax.checkpoint(body), None, xs)
+    return outs.transpose(1, 0, 2, 3, 4).reshape(b, tp, h, d)[:, :t]
 
 
 def decode_attention(

@@ -77,6 +77,69 @@ def test_gqa_matches_repeated_kv():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
 
 
+@pytest.mark.parametrize("window,softcap", [(None, 0.0), (7, 0.0),
+                                            (None, 5.0), (7, 5.0)])
+def test_chunked_causal_attention_matches_one_shot(window, softcap):
+    """Query-chunked attention (the O(T*chunk) path for flash-ineligible
+    models like gemma-2) == one-shot causal_attention, forward and
+    gradient, with windows/softcap/segments/custom scale."""
+    from dla_tpu.ops.attention import chunked_causal_attention
+
+    rs = np.random.RandomState(3)
+    b, t, h, kh, d = 2, 24, 4, 2, 8
+    q = jnp.asarray(rs.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, t, kh, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, t, kh, d).astype(np.float32))
+    seg = jnp.asarray((np.arange(t)[None, :] >= 10).astype(np.int32)
+                      .repeat(2, 0))
+    seg_mask = (seg[:, :, None] == seg[:, None, :]).astype(jnp.int32)
+    kw = dict(kv_segment_mask=seg_mask, window=window,
+              logit_softcap=softcap, softmax_scale=8 ** -0.5)
+
+    def f_chunk(q, k, v):
+        return chunked_causal_attention(q, k, v, q_chunk=8, **kw)
+
+    def f_full(q, k, v):
+        return causal_attention(q, k, v, **kw)
+
+    np.testing.assert_allclose(np.asarray(f_chunk(q, k, v)),
+                               np.asarray(f_full(q, k, v)),
+                               rtol=2e-5, atol=2e-6)
+    gc = jax.grad(lambda *a: jnp.sum(f_chunk(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda *a: jnp.sum(f_full(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gc, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_attention_pads_indivisible_lengths():
+    """A T that doesn't divide into chunks is padded up, NOT bounced to
+    the quadratic one-shot op (the memory bound must hold for every
+    length); results still match exactly, forward and gradient."""
+    from dla_tpu.ops.attention import chunked_causal_attention
+
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(1, 10, 2, 8).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 10, 2, 8).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 10, 2, 8).astype(np.float32))
+
+    def f_chunk(q, k, v):
+        return chunked_causal_attention(q, k, v, q_chunk=4)  # 10 % 4 != 0
+
+    np.testing.assert_allclose(np.asarray(f_chunk(q, k, v)),
+                               np.asarray(causal_attention(q, k, v)),
+                               rtol=1e-5, atol=1e-6)
+    gc = jax.grad(lambda *a: jnp.sum(f_chunk(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda *a: jnp.sum(causal_attention(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gc, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+
+
 @pytest.mark.parametrize("window", [None, 3])
 def test_decode_attention_matches_concat_cache(window):
     """decode_attention over (un-updated cache + new k/v) must equal
