@@ -409,3 +409,29 @@ def test_pipeline_train_step_loss_falls(tiny_setup):
         losses = [trainer.step_on_batch(batch, jax.random.key(i))[0]
                   for i in range(20)]
     assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+
+def test_pipeline_gemma2_chunked_attention_parity():
+    """gemma-2 under PP at T > DEFAULT_Q_CHUNK: the chunked-attention
+    scan (checkpointed) nests inside the stage shard_map and matches the
+    no-mesh forward — softcaps + alternating window + post-norms
+    included."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        get_model_config("tiny-gqa"),
+        arch="gemma2", sliding_window=6, sliding_window_pattern=2,
+        attn_logit_softcap=20.0, final_logit_softcap=10.0,
+        query_pre_attn_scalar=8, tie_embeddings=True, max_seq_length=1024)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(11))
+    rs = np.random.RandomState(12)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 640)), jnp.int32)
+    want = model.apply(params, ids)
+    mesh = _stage_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-3, atol=3e-4)
