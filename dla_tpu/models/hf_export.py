@@ -81,6 +81,17 @@ def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
         "torch_dtype": "float32",
     }
     if cfg.arch == "gemma2":
+        # Gemma2Config has no sliding_window_pattern knob — alternation
+        # (pattern 2) is implicit in the architecture. A model overridden
+        # to any other pattern cannot be represented as gemma2; refuse
+        # rather than silently round-tripping to different logits
+        # (import hard-codes pattern 2 back).
+        if cfg.sliding_window and cfg.sliding_window_pattern != 2:
+            raise ValueError(
+                f"gemma2 export requires sliding_window_pattern == 2 "
+                f"(HF Gemma2's implicit alternation); this model uses "
+                f"pattern {cfg.sliding_window_pattern}, which a gemma2 "
+                "config.json cannot express")
         # Gemma2Config reads hidden_activation (hidden_act is the
         # legacy key other families use)
         out["hidden_activation"] = "gelu_pytorch_tanh"

@@ -55,6 +55,11 @@ _ULYSSES_WINDOW_ERROR = (
     "path reasons by global index); use context_parallel: ring "
     "(window-aware) or unset model.sliding_window")
 
+_ULYSSES_GEMMA2_ERROR = (
+    "gemma-2 attention (softcapping / query_pre_attn_scalar) is not "
+    "supported under ulysses context parallelism; use "
+    "context_parallel: ring")
+
 
 def _flash_tileable(t: int) -> bool:
     """Whether the Pallas flash kernel may take sequence length T.
@@ -140,11 +145,7 @@ class Transformer:
             if cfg.sliding_window:
                 raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
             if cfg.attn_logit_softcap or cfg.query_pre_attn_scalar:
-                raise NotImplementedError(
-                    "gemma-2 attention (softcapping / "
-                    "query_pre_attn_scalar) is not supported under "
-                    "ulysses context parallelism; use "
-                    "context_parallel: ring")
+                raise NotImplementedError(_ULYSSES_GEMMA2_ERROR)
 
     # ------------------------------------------------------------------ init
 
@@ -501,13 +502,17 @@ class Transformer:
         """Whether the Pallas flash kernel may serve a full-sequence
         forward of length t for THIS config: the kernel speaks neither
         softcapping, per-layer windows, nor a non-default softmax scale
-        (gemma-2) — those take the XLA path. One predicate shared by
-        apply() and prefill() so the two gates cannot diverge."""
+        (gemma-2) — those take the XLA path. The scale gate compares the
+        EFFECTIVE scale, not the knob: query_pre_attn_scalar == head_dim
+        (gemma2-2b/9b) yields exactly the kernel's default head_dim**-0.5
+        and must not disqualify. One predicate shared by apply() and
+        prefill() so the two gates cannot diverge."""
         cfg = self.cfg
         return (cfg.attention == "flash" and _flash_tileable(t)
                 and not cfg.attn_logit_softcap
                 and cfg.sliding_window_pattern == 1
-                and cfg.query_pre_attn_scalar is None)
+                and (cfg.query_pre_attn_scalar is None
+                     or cfg.query_pre_attn_scalar == cfg.head_dim_))
 
     def _with_layer_windows(self, layers: Params) -> Params:
         """Inject the per-layer SWA flag into the scan stream for
@@ -600,11 +605,7 @@ class Transformer:
                     raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
                 if (self.cfg.attn_logit_softcap
                         or self.cfg.query_pre_attn_scalar is not None):
-                    raise NotImplementedError(
-                        "gemma-2 attention (softcapping / "
-                        "query_pre_attn_scalar) is not supported under "
-                        "ulysses context parallelism; use "
-                        "context_parallel: ring")
+                    raise NotImplementedError(_ULYSSES_GEMMA2_ERROR)
                 from dla_tpu.ops.ulysses import ulysses_causal_attention
                 return ulysses_causal_attention(
                     q, k, v, q_positions=q_positions,
@@ -953,39 +954,15 @@ class Transformer:
                 if a in mesh.shape and a not in manual:
                     dp_shards *= mesh.shape[a]
         if v > 1:
-            # circular schedule: M is pinned to the stage count (the
-            # bufferless re-injection needs it); a batch that cannot
-            # split into S microbatches falls back to plain GPipe
-            from dla_tpu.ops.pipeline import _warn_once
-            if x.shape[0] % n_stages == 0:
-                if cfg.pipeline_microbatches not in (0, n_stages):
-                    _warn_once(
-                        ("interleave-m", cfg.pipeline_microbatches,
-                         n_stages),
-                        f"[dla_tpu][pipeline] WARNING: "
-                        f"pipeline_microbatches="
-                        f"{cfg.pipeline_microbatches} is ignored under "
-                        f"pipeline_interleave={v}: the circular schedule "
-                        f"pins M to the stage count ({n_stages})")
-                m = n_stages
-                if dp_shards > 1 and (x.shape[0] // m) % dp_shards:
-                    _warn_once(
-                        ("interleave-dp", x.shape[0], n_stages, dp_shards),
-                        f"[dla_tpu][pipeline] WARNING: interleaved "
-                        f"microbatches of {x.shape[0] // m} rows do not "
-                        f"divide the {dp_shards} batch shards; attention "
-                        "falls back to the replicated path for this "
-                        "shape")
-            else:
-                _warn_once(("interleave", x.shape[0], n_stages, v),
-                           f"[dla_tpu][pipeline] WARNING: batch "
-                           f"{x.shape[0]} cannot split into {n_stages} "
-                           f"microbatches; pipeline_interleave={v} "
-                           "falls back to plain GPipe")
-                v = 1
-                m = resolve_microbatches(
-                    x.shape[0], cfg.pipeline_microbatches, n_stages,
-                    dp_shards=dp_shards)
+            # circular schedule: M pinned to the stage count; falls back
+            # to plain GPipe when the batch can't split S ways. The
+            # degradation announcements live in ops.pipeline, next to the
+            # plain-path policy, so the two cannot drift.
+            from dla_tpu.ops.pipeline import \
+                resolve_interleaved_microbatches
+            m, v = resolve_interleaved_microbatches(
+                x.shape[0], n_stages, v, dp_shards,
+                cfg.pipeline_microbatches)
         else:
             m = resolve_microbatches(x.shape[0], cfg.pipeline_microbatches,
                                      n_stages, dp_shards=dp_shards)

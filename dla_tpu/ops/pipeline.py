@@ -173,6 +173,39 @@ def _warn_once(key, msg: str) -> None:
         print(msg, file=sys.stderr, flush=True)
 
 
+def resolve_interleaved_microbatches(batch: int, n_stages: int, v: int,
+                                     dp_shards: int,
+                                     configured_m: int) -> tuple:
+    """Microbatch resolution for the circular schedule: M is pinned to
+    the stage count (the bufferless re-injection requires it). Returns
+    (m, v); a batch that cannot split S ways falls back to plain GPipe
+    (v=1) through resolve_microbatches. Owns ALL the interleave-path
+    degradation announcements so they cannot drift from the plain-path
+    policy in resolve_microbatches."""
+    if batch % n_stages == 0:
+        if configured_m not in (0, n_stages):
+            # only when M actually gets pinned — on the fallback path
+            # below, configured_m IS honored by resolve_microbatches
+            _warn_once(("interleave-m", configured_m, n_stages),
+                       f"[dla_tpu][pipeline] WARNING: "
+                       f"pipeline_microbatches={configured_m} is ignored "
+                       f"under pipeline_interleave={v}: the circular "
+                       f"schedule pins M to the stage count ({n_stages})")
+        if dp_shards > 1 and (batch // n_stages) % dp_shards:
+            _warn_once(("interleave-dp", batch, n_stages, dp_shards),
+                       f"[dla_tpu][pipeline] WARNING: interleaved "
+                       f"microbatches of {batch // n_stages} rows do not "
+                       f"divide the {dp_shards} batch shards; attention "
+                       "falls back to the replicated path for this shape")
+        return n_stages, v
+    _warn_once(("interleave", batch, n_stages, v),
+               f"[dla_tpu][pipeline] WARNING: batch {batch} cannot "
+               f"split into {n_stages} microbatches; "
+               f"pipeline_interleave={v} falls back to plain GPipe")
+    return resolve_microbatches(batch, configured_m, n_stages,
+                                dp_shards=dp_shards), 1
+
+
 def resolve_microbatches(batch: int, requested: Optional[int],
                          n_stages: int, dp_shards: int = 1) -> int:
     """Pick the pipeline microbatch count M for a batch of ``batch`` rows.
@@ -210,19 +243,24 @@ def resolve_microbatches(batch: int, requested: Optional[int],
                        f"batch {batch}; degraded to M={m} ({n_stages} "
                        f"stages -> bubble fraction {bubble:.0%})"
                        + (" — stages run SERIALLY" if m == 1 else ""))
-    # default path: announce any materially bad bubble (> 1/3 of pipeline
-    # time, i.e. m < 2S - 2), not just full serialization — a mis-sized
-    # batch quietly running a 60% bubble is the same silent-degrade class
-    # as the round-3 gcd issue
-    if n_stages > 1 and not requested and m < 2 * n_stages - 2:
+    # announce any materially bad bubble (> 1/3 of pipeline time, i.e.
+    # m < 2S - 2) on EVERY path — a mis-sized batch (default) or an
+    # explicitly under-configured M quietly running a 60%+ bubble is the
+    # same silent-degrade class as the round-3 gcd issue
+    # (the explicit-but-non-dividing case already announced its bubble
+    # in the degrade warning above — don't double-report)
+    degraded_explicit = bool(requested) and batch % requested != 0
+    if n_stages > 1 and m < 2 * n_stages - 2 and not degraded_explicit:
         bubble = (n_stages - 1) / (m + n_stages - 1)
-        _warn_once(key + ("serial",), f"[dla_tpu][pipeline] WARNING: batch {batch} only "
-                   f"splits into M={m} pipeline microbatches over "
-                   f"{dp_shards} batch shards; {n_stages} stages run at a "
+        cause = (f"pipeline_microbatches={requested}" if requested
+                 else f"batch {batch} only splits into M={m} pipeline "
+                      f"microbatches over {dp_shards} batch shards")
+        _warn_once(key + ("serial",), f"[dla_tpu][pipeline] WARNING: "
+                   f"{cause}; {n_stages} stages run at a "
                    f"{bubble:.0%} bubble"
                    + (" (SERIALLY)" if m == 1 else "")
-                   + " — size the per-step batch toward "
-                   f"{4 * n_stages * max(1, dp_shards)} rows")
+                   + " — target M >= 4*stage ("
+                   f"{4 * n_stages * max(1, dp_shards)} rows per step)")
     if dp_shards > 1 and (batch // m) % dp_shards != 0:
         _warn_once(key + ("dp",), f"[dla_tpu][pipeline] WARNING: pipeline "
                    f"microbatches of {batch // m} rows do not divide the "

@@ -190,6 +190,25 @@ def test_gemma2_token_logps_respect_softcap(tiny_gemma2_dir):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_gemma2_export_refuses_nonstandard_window_pattern(
+        tmp_path, tiny_gemma2_dir):
+    """Gemma2Config cannot express sliding_window_pattern != 2 (the
+    alternation is implicit); exporting such a model must REFUSE rather
+    than silently round-tripping to different logits (import hard-codes
+    pattern 2 back)."""
+    d, _ = tiny_gemma2_dir
+    import dataclasses
+
+    import pytest as _pytest
+
+    from dla_tpu.models.hf_export import export_hf_weights
+
+    cfg, params = _load(d)
+    uni = dataclasses.replace(cfg, sliding_window_pattern=1)
+    with _pytest.raises(ValueError, match="sliding_window_pattern"):
+        export_hf_weights(params, uni, tmp_path / "refused")
+
+
 def test_gemma2_int8_cache_decode_tracks_fp(tiny_gemma2_dir):
     """gemma-2 x int8 KV cache: softcapped, alternating-window decode
     over a quantized cache stays close to the full-precision cache."""

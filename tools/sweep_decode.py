@@ -48,6 +48,12 @@ def run_variant(name: str, *, batch=8, prompt=128, new=256,
     n_params = count_params(params)
     p_bytes = float(sum(l.size * l.dtype.itemsize
                         for l in jax.tree.leaves(params)))
+    # a decode step GATHERS only `batch` embedding rows, not the whole
+    # table — count the table out of the per-step weight read (the
+    # untied lm_head matmul still reads fully and stays in)
+    emb = params["embed"]["embedding"]
+    p_bytes_step = (p_bytes - emb.size * emb.dtype.itemsize
+                    + batch * emb.shape[1] * emb.dtype.itemsize)
 
     if new < 2:
         raise ValueError("sweep_decode needs new >= 2 (the prefill "
@@ -72,7 +78,7 @@ def run_variant(name: str, *, batch=8, prompt=128, new=256,
     avg_fill = prompt + new / 2
     kv_bytes = (2 * layers * batch * avg_fill
                 * kv_heads * cfg.head_dim_ * kv_elem)
-    roofline_ms = (p_bytes + kv_bytes) / hbm_bw(dev) * 1000
+    roofline_ms = (p_bytes_step + kv_bytes) / hbm_bw(dev) * 1000
     out = {"variant": name, "ms_per_token": round(decode_ms, 3),
            "ms_per_token_incl_prefill": round(row["ms_per_token"], 3),
            "decode_tok_s_chip": round(
