@@ -435,3 +435,30 @@ def test_pipeline_gemma2_chunked_attention_parity():
         got = jax.jit(lambda p: model.apply(p, ids))(sp)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=3e-3, atol=3e-4)
+
+
+def test_interleaved_gemma2_swa_flag_follows_layers():
+    """gemma-2's per-layer swa_on flag (injected into the layer stream)
+    must ride the SAME [L] -> [S, V, c] round-robin reshape as the
+    weights under the circular schedule — a mismatch would window the
+    wrong layers."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        get_model_config("tiny-gqa"),
+        arch="gemma2", sliding_window=5, sliding_window_pattern=2,
+        attn_logit_softcap=20.0, final_logit_softcap=10.0,
+        query_pre_attn_scalar=8, tie_embeddings=True,
+        pipeline_interleave=2)   # 4 layers: 2 stages x 2 blocks of 1
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(13))
+    rs = np.random.RandomState(14)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    want = model.apply(params, ids)
+    mesh = _stage_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
