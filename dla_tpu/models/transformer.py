@@ -415,6 +415,7 @@ class Transformer:
                cp: Optional[Tuple] = None,
                dropout_key: Optional[jax.Array] = None,
                token_valid: Optional[jnp.ndarray] = None,  # [B, T] for MoE
+               factored_mask: Optional[Tuple] = None,  # (valid, segments)
                ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
         """One decoder block. Returns (output, (k, v)) — k/v before override,
         for cache writes. ``layer`` may carry LoRA leaves (merged upstream)."""
@@ -451,7 +452,8 @@ class Transformer:
         attn = self._attention(q, k, v, kv_segment_mask,
                                q_positions, kv_positions, allow_flash, cp,
                                flash_segs=flash_segs,
-                               window=self._layer_window(layer))
+                               window=self._layer_window(layer),
+                               factored_mask=factored_mask)
         attn = attn.reshape(b, t, cfg.num_heads * dh)
 
         if cfg.arch == "phi":
@@ -536,8 +538,11 @@ class Transformer:
         astype path (dtype check is trace-time — zero runtime cost)."""
         w = container[name]
         if w.dtype == jnp.int8:
-            return (w.astype(self.adtype)
-                    * container[name + "_wscale"].astype(self.adtype))
+            # multiply in fp32, cast the PRODUCT: casting the scale to
+            # bf16 first would add a correlated ~2^-9 relative error per
+            # output channel on top of int8's inherent half-step error
+            return (w.astype(jnp.float32)
+                    * container[name + "_wscale"]).astype(self.adtype)
         return w.astype(self.adtype)
 
     _WEIGHT_ONLY_MATS = ("wq", "wk", "wv", "wo", "w_gate", "w_up",
@@ -585,7 +590,7 @@ class Transformer:
     def _attention(self, q, k, v, kv_segment_mask, q_positions, kv_positions,
                    allow_flash: bool = False, cp: Optional[Tuple] = None,
                    flash_segs: Optional[jnp.ndarray] = None,
-                   window=None):
+                   window=None, factored_mask: Optional[Tuple] = None):
         """Pick the attention backend. The pallas flash kernel handles the
         full-sequence causal path on contiguous right-padded batches whose
         length tiles its blocks — including packed batches, whose segment
@@ -640,8 +645,22 @@ class Transformer:
         if t == s and t > DEFAULT_Q_CHUNK:
             # flash-ineligible long sequences (gemma-2 softcap/per-layer
             # window, gapped masks): query-chunked to keep live scores
-            # O(T * chunk), forward AND backward (checkpointed scan)
+            # O(T * chunk), forward AND backward (checkpointed scan).
+            # With factored_mask set, each chunk builds its own [B,C,S]
+            # mask slab from the 1-D metadata — no [B,T,T] anywhere.
+            if factored_mask is not None:
+                valid, segs = factored_mask
+                return chunked_causal_attention(
+                    q, k, v, kv_valid=valid,
+                    q_segments=segs, kv_segments=segs, **kw)
             return chunked_causal_attention(q, k, v, **kw)
+        if factored_mask is not None and kw["kv_segment_mask"] is None:
+            # safety net (callers only set factored_mask on the long
+            # path above): chunked's t <= q_chunk branch builds the slab
+            valid, segs = factored_mask
+            return chunked_causal_attention(
+                q, k, v, kv_valid=valid,
+                q_segments=segs, kv_segments=segs, **kw)
         return causal_attention(q, k, v, **kw)
 
     def _flash(self, q, k, v, segs: Optional[Tuple]):
@@ -843,13 +862,27 @@ class Transformer:
                 block_k=self.cfg.flash_block_k or DEFAULT_BLOCK_K)
 
         kv_mask = None
+        factored = None
         if cp is None and not allow_flash:
-            if attention_mask is not None:
-                kv_mask = jnp.broadcast_to(
-                    attention_mask[:, None, :].astype(bool), (b, t, t))
-            if segment_ids is not None:
-                same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
-                kv_mask = same_seg if kv_mask is None else (kv_mask & same_seg)
+            from dla_tpu.ops.attention import DEFAULT_Q_CHUNK
+            if (t > DEFAULT_Q_CHUNK and n_stages == 1
+                    and (attention_mask is not None
+                         or segment_ids is not None)):
+                # long flash-ineligible sequences route through the
+                # query-chunked attention, which builds each chunk's
+                # mask slab from this 1-D metadata — never materialize
+                # the [B, T, T] mask here (at 32k that mask alone is
+                # O(GB) before any score exists)
+                factored = (attention_mask, segment_ids)
+            else:
+                if attention_mask is not None:
+                    kv_mask = jnp.broadcast_to(
+                        attention_mask[:, None, :].astype(bool), (b, t, t))
+                if segment_ids is not None:
+                    same_seg = (segment_ids[:, :, None]
+                                == segment_ids[:, None, :])
+                    kv_mask = (same_seg if kv_mask is None
+                               else (kv_mask & same_seg))
 
         x = _constrain(self._embed(params, input_ids), ACT_SPEC)
         cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
@@ -901,7 +934,8 @@ class Transformer:
                                         positions, positions,
                                         allow_flash=allow_flash,
                                         flash_segs=flash_segs, cp=cp,
-                                        token_valid=token_valid)
+                                        token_valid=token_valid,
+                                        factored_mask=factored)
                 return h, aux
         else:
             def body(carry, xs):
@@ -911,7 +945,8 @@ class Transformer:
                                         allow_flash=allow_flash,
                                         flash_segs=flash_segs, cp=cp,
                                         dropout_key=key,
-                                        token_valid=token_valid)
+                                        token_valid=token_valid,
+                                        factored_mask=factored)
                 return h, aux
             layers = (layers, keys)
 
@@ -1098,7 +1133,10 @@ class Transformer:
 
     def _dequantize_kv(self, q: jnp.ndarray, scale: jnp.ndarray
                        ) -> jnp.ndarray:
-        return q.astype(self.adtype) * scale[..., None].astype(self.adtype)
+        # fp32 multiply, cast the product (see _weight: a bf16-cast
+        # scale would shift whole per-position head vectors coherently)
+        return (q.astype(jnp.float32) * scale[..., None]
+                ).astype(self.adtype)
 
     def init_cache(self, batch: int, max_len: int) -> Params:
         cfg = self.cfg
@@ -1149,8 +1187,17 @@ class Transformer:
         b, t = input_ids.shape
         positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
         flash_ok = self._flash_eligible(t)
-        kv_mask = None if flash_ok else jnp.broadcast_to(
-            attention_mask[:, None, :].astype(bool), (b, t, t))
+        from dla_tpu.ops.attention import DEFAULT_Q_CHUNK
+        kv_mask = None
+        pre_factored = None
+        if not flash_ok:
+            if t > DEFAULT_Q_CHUNK:
+                # long flash-ineligible prefill (gemma-2 32k rollouts):
+                # factored validity through the chunked path, no [B,T,T]
+                pre_factored = (attention_mask, None)
+            else:
+                kv_mask = jnp.broadcast_to(
+                    attention_mask[:, None, :].astype(bool), (b, t, t))
         x = self._embed(params, input_ids)
         cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
                                  scaling=cfg.rope_scaling)
@@ -1159,7 +1206,8 @@ class Transformer:
             h, kv, _ = self._block(layer, carry, cos, sin, kv_mask,
                                    positions, positions,
                                    allow_flash=flash_ok,
-                                   token_valid=attention_mask)
+                                   token_valid=attention_mask,
+                                   factored_mask=pre_factored)
             return h, kv
 
         x, (ks, vs) = jax.lax.scan(

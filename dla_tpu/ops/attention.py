@@ -54,8 +54,10 @@ def causal_attention(
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
 
     qg = q.reshape(b, t, kheads, groups, d)
-    # scores [B, K, G, T, S]
-    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
+    # scores [B, K, G, T, S] — fp32 out of the MXU (bf16 operands with
+    # fp32 accumulation), so softmax numerics match ring/flash/fused_ce
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k,
+                        preferred_element_type=jnp.float32) * scale
     if logit_softcap:
         scores = logit_softcap * jnp.tanh(scores / logit_softcap)
 
@@ -98,6 +100,9 @@ def chunked_causal_attention(
     window=None,                     # static int or traced scalar
     logit_softcap: float = 0.0,
     q_chunk: int = DEFAULT_Q_CHUNK,
+    kv_valid: Optional[jnp.ndarray] = None,         # [B, S] 1 = attend
+    q_segments: Optional[jnp.ndarray] = None,       # [B, T] packed ids
+    kv_segments: Optional[jnp.ndarray] = None,      # [B, S]
 ) -> jnp.ndarray:
     """causal_attention computed one query block at a time: peak live
     scores are [B, H, q_chunk, S] instead of [B, H, T, T].
@@ -111,14 +116,45 @@ def chunked_causal_attention(
     chunk's weights (which would re-materialize the full [B, H, T, S]).
     A T that doesn't divide into chunks is PADDED up (pad query rows
     compute garbage nothing consumes; outputs sliced back to T), so the
-    O(T * chunk) bound holds for every length. Exactly equal to
-    causal_attention (same masks, positions, window, softcap, scale
-    semantics).
+    O(T * chunk) bound holds for every length.
+
+    Masking comes in two forms: a caller-materialized ``kv_segment_mask``
+    [B, T, S] (itself O(T^2) bytes — fine at moderate T), or the FACTORED
+    1-D metadata ``kv_valid`` / ``q_segments`` / ``kv_segments``, from
+    which each chunk's [B, C, S] mask slab is built inside the
+    checkpointed body — nothing quadratic ever lives, the ring kernel's
+    own trick. The two are mutually exclusive; semantics match
+    causal_attention exactly.
     """
     b, t, h, d = q.shape
+    if kv_segment_mask is not None and (
+            kv_valid is not None or q_segments is not None
+            or kv_segments is not None):
+        raise ValueError("pass kv_segment_mask OR factored "
+                         "kv_valid/q_segments/kv_segments, not both")
+    if (q_segments is None) != (kv_segments is None):
+        raise ValueError("q_segments and kv_segments must be passed "
+                         "together (a one-sided segment restriction "
+                         "would be silently dropped)")
+
+    def factored_mask_slab(qseg_c, rows):
+        """[B, rows, S] mask from the 1-D metadata for one query chunk."""
+        slab = None
+        if kv_valid is not None:
+            slab = jnp.broadcast_to(
+                kv_valid[:, None, :].astype(bool),
+                (b, rows, kv_valid.shape[1]))
+        if qseg_c is not None and kv_segments is not None:
+            same = qseg_c[:, :, None] == kv_segments[:, None, :]
+            slab = same if slab is None else (slab & same)
+        return slab
+
     if t <= q_chunk:
+        mc = kv_segment_mask
+        if mc is None and (kv_valid is not None or q_segments is not None):
+            mc = factored_mask_slab(q_segments, t)
         return causal_attention(
-            q, k, v, kv_segment_mask=kv_segment_mask,
+            q, k, v, kv_segment_mask=mc,
             q_positions=q_positions, kv_positions=kv_positions,
             softmax_scale=softmax_scale, window=window,
             logit_softcap=logit_softcap)
@@ -136,28 +172,34 @@ def chunked_causal_attention(
             kv_segment_mask = jnp.pad(
                 kv_segment_mask, ((0, 0), (0, pad), (0, 0)),
                 constant_values=1)
+        if q_segments is not None:
+            q_segments = jnp.pad(q_segments, ((0, 0), (0, pad)),
+                                 constant_values=0)
     nc = tp // q_chunk
     q_c = q.reshape(b, nc, q_chunk, h, d).transpose(1, 0, 2, 3, 4)
     pos_c = q_positions.reshape(b, nc, q_chunk).transpose(1, 0, 2)
-    xs = (q_c, pos_c)
+    xs = [q_c, pos_c]
     if kv_segment_mask is not None:
-        xs = xs + (kv_segment_mask.reshape(
+        xs.append(kv_segment_mask.reshape(
             b, nc, q_chunk, kv_segment_mask.shape[-1]
-        ).transpose(1, 0, 2, 3),)
+        ).transpose(1, 0, 2, 3))
+    if q_segments is not None:
+        xs.append(q_segments.reshape(b, nc, q_chunk).transpose(1, 0, 2))
 
     def body(_, chunk_xs):
+        qc, pc = chunk_xs[0], chunk_xs[1]
         if kv_segment_mask is not None:
-            qc, pc, mc = chunk_xs
+            mc = chunk_xs[2]
         else:
-            qc, pc = chunk_xs
-            mc = None
+            qseg_c = chunk_xs[2] if q_segments is not None else None
+            mc = factored_mask_slab(qseg_c, q_chunk)
         out = causal_attention(
             qc, k, v, kv_segment_mask=mc, q_positions=pc,
             kv_positions=kv_positions, softmax_scale=softmax_scale,
             window=window, logit_softcap=logit_softcap)
         return None, out
 
-    _, outs = jax.lax.scan(jax.checkpoint(body), None, xs)
+    _, outs = jax.lax.scan(jax.checkpoint(body), None, tuple(xs))
     return outs.transpose(1, 0, 2, 3, 4).reshape(b, tp, h, d)[:, :t]
 
 
@@ -198,9 +240,9 @@ def decode_attention(
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
 
     qg = q.reshape(b, kheads, groups, d)
-    # [B, K, G, S] scores against the existing cache
-    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache)
-    scores = scores.astype(jnp.float32) * scale
+    # [B, K, G, S] scores against the existing cache (fp32 accumulation)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
     if logit_softcap:
         scores = logit_softcap * jnp.tanh(scores / logit_softcap)
     delta = q_positions - kv_positions            # [B, S]
@@ -209,8 +251,9 @@ def decode_attention(
         mask = mask & (delta < window)
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     # [B, K, G, 1] the new token's self-score
-    self_score = jnp.einsum("bkgd,bkd->bkg", qg, k_new[:, 0]
-                            )[..., None].astype(jnp.float32) * scale
+    self_score = jnp.einsum("bkgd,bkd->bkg", qg, k_new[:, 0],
+                            preferred_element_type=jnp.float32
+                            )[..., None] * scale
     if logit_softcap:
         self_score = logit_softcap * jnp.tanh(self_score / logit_softcap)
 

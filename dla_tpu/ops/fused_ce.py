@@ -88,7 +88,11 @@ def _fused_bwd(chunk, softcap, res, g):
     hp = _pad_rows(hidden2d, chunk)
     tp = _pad_rows(targets1d, chunk)
     gp = _pad_rows(g, chunk)           # pad rows get g = 0: no gradient
-    lp = _pad_rows(lse, chunk)
+    # pad lse with a huge value so recomputed pad-row probabilities
+    # underflow to 0 (lse=0 padding could overflow exp(logits) to inf
+    # for large biased logits, and inf * 0 = NaN would poison db/dw)
+    lp = jnp.concatenate(
+        [lse, jnp.full(((-lse.shape[0]) % chunk,), 1e30, lse.dtype)])
     nc = hp.shape[0] // chunk
     h_c = hp.reshape(nc, chunk, d)
     t_c = tp.reshape(nc, chunk)
@@ -198,7 +202,8 @@ def fused_token_logprobs(
     b, t, d = hidden.shape
     logp = _fused_logprobs(
         hidden.reshape(b * t, d), w, bias,
-        jnp.clip(targets, 0).reshape(b * t), chunk, softcap)
+        jnp.clip(targets, 0, w.shape[1] - 1).reshape(b * t), chunk,
+        softcap)
     return logp.reshape(b, t)
 
 

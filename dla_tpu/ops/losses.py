@@ -30,8 +30,11 @@ def token_logprobs(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     """
     logits = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(logits, axis=-1)
+    # clip BOTH bounds: take_along_axis fills out-of-range gathers with
+    # NaN, so a tokenizer/model vocab mismatch would NaN the whole loss
     picked = jnp.take_along_axis(
-        logits, jnp.clip(targets, 0)[..., None], axis=-1)[..., 0]
+        logits, jnp.clip(targets, 0, logits.shape[-1] - 1)[..., None],
+        axis=-1)[..., 0]
     return picked - lse
 
 

@@ -60,7 +60,10 @@ def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg, win,
     n = jax.lax.psum(1, axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    qg = q.reshape(b, tl, kh, groups, d).astype(jnp.float32)
+    # keep operands in their (bf16) dtype: the MXU runs bf16-in/fp32-out
+    # natively, so fp32-casting q/k/v here would trade several-x matmul
+    # throughput for zero accumulation-precision gain
+    qg = q.reshape(b, tl, kh, groups, d)
 
     m0 = jnp.full((b, kh, groups, tl, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, kh, groups, tl, 1), jnp.float32)
@@ -68,8 +71,9 @@ def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg, win,
 
     def step(carry, _):
         m, l, acc, k_c, v_c, pos_c, valid_c, seg_c = carry
-        s = jnp.einsum("btkgd,bskd->bkgts", qg,
-                       k_c.astype(jnp.float32)) * scale     # [B,K,G,Tl,Sl]
+        s = jnp.einsum("btkgd,bskd->bkgts", qg, k_c,
+                       preferred_element_type=jnp.float32
+                       ) * scale                            # [B,K,G,Tl,Sl]
         if logit_softcap:
             # gemma-2: cap * tanh(s / cap) on the scaled scores, pre-mask
             s = logit_softcap * jnp.tanh(s / logit_softcap)
@@ -89,7 +93,8 @@ def _ring_local(q, k, v, q_pos, kv_pos, kv_valid, q_seg, kv_seg, win,
         corr = jnp.where(safe, jnp.exp(m - m_new), 1.0)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * corr + jnp.einsum(
-            "bkgts,bskd->bkgtd", p, v_c.astype(jnp.float32))
+            "bkgts,bskd->bkgtd", p.astype(v_c.dtype), v_c,
+            preferred_element_type=jnp.float32)
 
         rot = lambda x: jax.lax.ppermute(x, axis_name, perm)
         return (m_new, l, acc, rot(k_c), rot(v_c), rot(pos_c),

@@ -114,6 +114,45 @@ def test_chunked_causal_attention_matches_one_shot(window, softcap):
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_chunked_attention_factored_mask_matches_materialized():
+    """The factored 1-D metadata path (kv_valid + segment ids, per-chunk
+    mask slabs — no [B,T,S] ever) must equal the caller-materialized
+    kv_segment_mask path, forward and gradient."""
+    from dla_tpu.ops.attention import chunked_causal_attention
+
+    rs = np.random.RandomState(5)
+    b, t, h, kh, d = 2, 24, 4, 2, 8
+    q = jnp.asarray(rs.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, t, kh, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, t, kh, d).astype(np.float32))
+    valid = jnp.asarray((np.arange(t)[None, :]
+                         < np.array([[t], [t - 5]])).astype(np.int32))
+    seg = jnp.asarray((np.arange(t)[None, :] >= 9).astype(np.int32)
+                      .repeat(2, 0) + 1)
+    mask = (valid[:, None, :].astype(bool)
+            & (seg[:, :, None] == seg[:, None, :]))
+
+    def f_fac(q, k, v):
+        return chunked_causal_attention(
+            q, k, v, q_chunk=8, kv_valid=valid,
+            q_segments=seg, kv_segments=seg, logit_softcap=5.0)
+
+    def f_mat(q, k, v):
+        return chunked_causal_attention(
+            q, k, v, q_chunk=8, kv_segment_mask=mask, logit_softcap=5.0)
+
+    np.testing.assert_allclose(np.asarray(f_fac(q, k, v)),
+                               np.asarray(f_mat(q, k, v)),
+                               rtol=1e-6, atol=1e-7)
+    ga = jax.grad(lambda *a: jnp.sum(f_fac(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gb = jax.grad(lambda *a: jnp.sum(f_mat(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for x, y in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_chunked_attention_pads_indivisible_lengths():
     """A T that doesn't divide into chunks is padded up, NOT bounced to
     the quadratic one-shot op (the memory bound must hold for every
