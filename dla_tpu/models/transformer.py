@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dla_tpu.models.config import ModelConfig
+from dla_tpu.parallel.mesh import auto_axes
 from dla_tpu.ops.attention import (
     causal_attention,
     chunked_causal_attention,
@@ -87,11 +88,9 @@ def _flash_mesh():
     mesh = _ambient_mesh()
     if mesh is None:
         return None
-    manual = set(getattr(mesh, "manual_axes", ()) or ())
     n = 1
-    for name, size in mesh.shape.items():  # any >1 AUTO axis replicates
-        if name not in manual:
-            n *= size
+    for name in auto_axes(mesh):  # any >1 AUTO axis replicates
+        n *= mesh.shape[name]
     return mesh if n > 1 else None
 
 
@@ -685,9 +684,8 @@ class Transformer:
         # wrap over the batch/head axes that are still GSPMD-auto; under
         # the pipeline's stage shard_map this nests partial-manual with
         # `stage` untouched (already manual in the enclosing scope)
-        manual = set(getattr(mesh, "manual_axes", ()) or ())
         wrap_axes = {a for a in ("data", "fsdp", "model")
-                     if a in mesh.shape and a not in manual}
+                     if a in auto_axes(mesh)}
         model_size = mesh.shape.get("model", 1) if "model" in wrap_axes \
             else 1
         batch_shards = 1
@@ -899,11 +897,11 @@ class Transformer:
         if n_stages > 1:
             # pipeline parallelism: layer stack sharded over `stage`,
             # GPipe microbatch schedule (ops.pipeline). LoRA leaves ride
-            # in `layers` and reshape with everything else.
-            if cp is not None:
-                raise NotImplementedError(
-                    "stage > 1 (pipeline) with sequence > 1 (context "
-                    "parallelism) is not supported yet — pick one")
+            # in `layers` and reshape with everything else. Context
+            # parallelism composes: the ring/ulysses shard_map nests
+            # partial-manual over the still-auto `sequence` axis inside
+            # the stage schedule (like _flash), with the CP metadata
+            # (validity, segments) riding the aux shift register.
             if keys is not None:
                 raise NotImplementedError(
                     "lora_dropout under pipeline parallelism is not "
@@ -916,7 +914,7 @@ class Transformer:
             x = self._pipeline_forward(layers, x, cos, sin, kv_mask,
                                        positions, n_stages,
                                        allow_flash=allow_flash,
-                                       flash_segs=flash_segs)
+                                       flash_segs=flash_segs, cp=cp)
             return self._final_norm(params, x), None
 
         # MoE routing must know which tokens are real: pads must not
@@ -962,7 +960,8 @@ class Transformer:
                           positions: jnp.ndarray,
                           n_stages: int, *,
                           allow_flash: bool = False,
-                          flash_segs: Optional[Tuple] = None
+                          flash_segs: Optional[Tuple] = None,
+                          cp: Optional[Tuple] = None
                           ) -> jnp.ndarray:
         """GPipe over the `stage` mesh axis: reshape the [L, ...] layer
         stack to [S, L/S, ...] (shard-local — the stage axis owns
@@ -982,11 +981,10 @@ class Transformer:
                 f"pipeline needs num_layers ({n_layers}) divisible by "
                 f"stage axis x interleave ({n_stages} x {v})")
         mesh = _ambient_mesh()
-        manual = set(getattr(mesh, "manual_axes", ()) or ()) if mesh else ()
         dp_shards = 1
         if mesh is not None:
             for a in ("data", "fsdp"):
-                if a in mesh.shape and a not in manual:
+                if a in auto_axes(mesh):
                     dp_shards *= mesh.shape[a]
         if v > 1:
             # circular schedule: M pinned to the stage count; falls back
@@ -1023,15 +1021,28 @@ class Transformer:
         if flash_segs is not None:
             aux["flash_segs"] = jax.tree.map(
                 lambda a: microbatch(a, m), flash_segs)
+        cp_mode = cp_gapped = None
+        if cp is not None:
+            # CP metadata microbatches with the activations; the static
+            # parts (mode, gapped-positions flag) close over stage_fn
+            cp_mode, cp_valid, cp_seg, cp_gapped = cp
+            aux["cp_valid"] = microbatch(cp_valid, m)
+            aux["cp_seg"] = microbatch(cp_seg, m)
 
         def stage_fn(stage_params, h, aux_t):
+            cp_t = None
+            if cp_mode is not None:
+                cp_t = (cp_mode, aux_t["cp_valid"], aux_t["cp_seg"],
+                        cp_gapped)
+
             def body(carry, layer):
                 out, _, _ = self._block(layer, carry, aux_t["cos"],
                                         aux_t["sin"], aux_t.get("kv_mask"),
                                         aux_t["positions"],
                                         aux_t["positions"],
                                         allow_flash=allow_flash,
-                                        flash_segs=aux_t.get("flash_segs"))
+                                        flash_segs=aux_t.get("flash_segs"),
+                                        cp=cp_t)
                 return out, None
             h, _ = jax.lax.scan(self._maybe_remat(body), h, stage_params)
             return h

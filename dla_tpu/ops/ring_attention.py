@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from dla_tpu.parallel.mesh import auto_axes
+
 NEG_INF = -1e30
 SEQ_AXIS = "sequence"
 
@@ -184,6 +186,7 @@ def ring_causal_attention(
         in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec, sspec,
                   P()),
         out_specs=qspec,
+        axis_names=auto_axes(mesh),
         check_vma=False,
     )
     return fn(q, k, v, q_positions, kv_positions,

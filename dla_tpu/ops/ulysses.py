@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dla_tpu.ops.attention import causal_attention
+from dla_tpu.parallel.mesh import auto_axes
 
 SEQ_AXIS = "sequence"
 
@@ -124,6 +125,7 @@ def ulysses_causal_attention(
         mesh=mesh,
         in_specs=(qspec, qspec, qspec, sspec, sspec, sspec, sspec),
         out_specs=qspec,
+        axis_names=auto_axes(mesh),
         check_vma=False,
     )
     return fn(q, k, v, q_positions, kv_positions,

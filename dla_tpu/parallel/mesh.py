@@ -81,6 +81,18 @@ class MeshConfig:
         return sizes
 
 
+def auto_axes(mesh) -> set:
+    """Axes of ``mesh`` not already manualized by an enclosing shard_map.
+
+    The one definition of "which axes may this op still shard_map over":
+    ring/ulysses CP and the flash wrapper all nest partial-manual inside
+    the pipeline's stage schedule, and each must exclude the axes the
+    enclosing scope already made manual. A concrete ``jax.sharding.Mesh``
+    has no ``manual_axes`` — everything is auto there."""
+    manual = set(getattr(mesh, "manual_axes", ()) or ())
+    return {a for a in mesh.shape if a not in manual}
+
+
 def build_mesh(
     mesh_config: Optional[MeshConfig] = None,
     *,

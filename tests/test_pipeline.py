@@ -411,6 +411,132 @@ def test_pipeline_train_step_loss_falls(tiny_setup):
     assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
 
 
+def _pp_cp_mesh(stage=2, sequence=2, fsdp=2):
+    if jax.device_count() < stage * sequence * fsdp:
+        pytest.skip("needs the 8-device CPU mesh")
+    return build_mesh(MeshConfig(stage=stage, data=1, fsdp=fsdp, model=1,
+                                 sequence=sequence))
+
+
+def test_pipeline_ring_cp_forward_matches(tiny_setup):
+    """PP x CP (round-5 verdict item 2): ring attention's shard_map nests
+    partial-manual over the still-auto `sequence` axis inside the stage
+    schedule, with CP metadata riding the aux shift register."""
+    import dataclasses
+    model0, _, _ = tiny_setup
+    cfg = dataclasses.replace(model0.cfg, context_parallel="ring")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(20)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 32)), jnp.int32)
+    want = model.apply(params, ids)
+    mesh = _pp_cp_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_ring_cp_grads_match(tiny_setup):
+    """Backward through PP x ring: the ring scan's ppermute transpose
+    nests under the stage schedule's reverse shift register."""
+    import dataclasses
+    model0, _, _ = tiny_setup
+    cfg = dataclasses.replace(model0.cfg, context_parallel="ring")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(21)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 32)), jnp.int32)
+    batch = {"input_ids": ids, "labels": jnp.where(ids % 5 == 0, -100, ids)}
+
+    def loss(p):
+        return model_fused_ce(model, p, batch)[0]
+
+    g_ref = jax.grad(loss)(params)
+    mesh = _pp_cp_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        g_pp = jax.jit(jax.grad(loss))(sp)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_pipeline_ring_cp_sliding_window(tiny_setup):
+    """PP x windowed ring (mistral-style SWA): the window's ring-scan
+    truncation and absolute-position mask survive the stage nesting."""
+    import dataclasses
+    model0, _, _ = tiny_setup
+    cfg = dataclasses.replace(model0.cfg, context_parallel="ring",
+                              sliding_window=7)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(22)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 32)), jnp.int32)
+    want = model.apply(params, ids)
+    mesh = _pp_cp_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_ulysses_cp_forward_matches(tiny_setup):
+    """PP x ulysses: the head all-to-all nests inside the stage schedule
+    the same way (tiny has 2 kv heads — divisible by sequence=2)."""
+    import dataclasses
+    model0, _, _ = tiny_setup
+    cfg = dataclasses.replace(model0.cfg, context_parallel="ulysses")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(23)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 32)), jnp.int32)
+    want = model.apply(params, ids)
+    mesh = _pp_cp_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(p, ids))(sp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_ring_cp_packed_segments(tiny_setup):
+    """PP x ring x packing: segment ids and validity microbatch with the
+    activations and rotate around the ring correctly."""
+    import dataclasses
+    model0, _, _ = tiny_setup
+    cfg = dataclasses.replace(model0.cfg, context_parallel="ring")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(24)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 32)), jnp.int32)
+    seg = np.zeros((4, 32), np.int32)
+    for i in range(4):
+        n1 = 10 + i
+        seg[i, :n1] = 1
+        seg[i, n1:28] = 2
+    seg = jnp.asarray(seg)
+    mask = (seg > 0).astype(jnp.int32)
+    want = model.apply(params, ids, attention_mask=mask, segment_ids=seg)
+    mesh = _pp_cp_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got = jax.jit(lambda p: model.apply(
+            p, ids, attention_mask=mask, segment_ids=seg))(sp)
+    m = np.asarray(seg) > 0
+    for bi in range(4):
+        np.testing.assert_allclose(
+            np.asarray(got)[bi][m[bi]], np.asarray(want)[bi][m[bi]],
+            rtol=2e-4, atol=2e-4)
+
+
 def test_pipeline_gemma2_chunked_attention_parity():
     """gemma-2 under PP at T > DEFAULT_Q_CHUNK: the chunked-attention
     scan (checkpointed) nests inside the stage shard_map and matches the
