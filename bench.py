@@ -77,11 +77,33 @@ PPO_BASELINE_BW_EFF = 0.60
 
 
 def hbm_bw(device) -> float:
+    """Per-chip HBM bandwidth for the roofline. Unrecognized accelerator
+    kinds fall back to the v5e figure — LOUDLY (ADVICE r4): a silently
+    assumed bandwidth would skew decode rooflines and PPO vs_baseline on
+    future chips with no trace in the artifact. hbm_bw_assumed() tells
+    callers to record the fallback in their emitted detail."""
+    bw, assumed = _hbm_bw_lookup(device)
+    if assumed:
+        print(f"[bench] WARNING: unrecognized device_kind "
+              f"'{getattr(device, 'device_kind', '?')}' — assuming v5e "
+              f"HBM bandwidth ({bw:.3g} B/s) for the roofline",
+              file=sys.stderr)
+    return bw
+
+
+def hbm_bw_assumed(device) -> bool:
+    """True when hbm_bw() is a fallback guess, not a known-chip figure."""
+    return _hbm_bw_lookup(device)[1]
+
+
+def _hbm_bw_lookup(device):
     kind = getattr(device, "device_kind", "cpu").lower()
     for key, val in PEAK_HBM_BW.items():
         if key in kind:
-            return val
-    return 819e9 if device.platform != "cpu" else PEAK_HBM_BW["cpu"]
+            return val, False
+    if device.platform == "cpu":
+        return PEAK_HBM_BW["cpu"], False
+    return 819e9, True
 
 
 def ppo_baseline_samples_per_sec(n_params: int, batch: int, prompt: int,
@@ -167,19 +189,22 @@ def run_bench() -> dict:
         # ~350M-param Mistral-style decoder (GQA 8q/4kv like Mistral-7B's
         # 32q/8kv ratio, head_dim 128): big enough to exercise the MXU,
         # small enough that params + Adam state fit one v5e chip.
-        # Measured-fastest single-chip configuration (round-3 on-chip
-        # sweep, tools/sweep_bench.py): Pallas flash attention
-        # (512-blocks), remat="dots", micro=8, fused chunked CE, bf16
-        # Adam first moment — 31.7k tok/s (33.7% MFU, 1.05x the
-        # H100-normalized bar). head_dim 64 -> 128 was the big rock: it
-        # fills the MXU's 128-deep contraction in the attention kernel
-        # AND stops the saved flash activations from 2x lane-padding
-        # ([.,.,.,64] tiles pad to 128 — round-2's hd-64 config OOMed
-        # at micro=8 for exactly that reason, BENCH r3 logs).
+        # Measured-fastest single-chip configuration (round-5 on-chip
+        # sweep, tools/sweep_bench.py): Pallas flash attention with
+        # 1024x1024 blocks, remat="dots", micro=8, fused CE at
+        # chunk=4096, bf16 Adam first moment — 33.0k tok/s (35.0% MFU,
+        # 1.094x the H100-normalized bar). head_dim 64 -> 128 was the
+        # big rock (round 3): it fills the MXU's 128-deep contraction in
+        # the attention kernel AND stops the saved flash activations
+        # from 2x lane-padding. Round 5 added the block-size bump
+        # (1024-blocks cut the causal diagonal waste and per-block
+        # bookkeeping vs 512: +3.9% step) and the larger CE chunk
+        # (fewer [chunk, V] logit tiles: +3.1%); combined +6%.
         cfg = ModelConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_layers=24, num_heads=8, num_kv_heads=4,
-            max_seq_length=2048, remat="dots", attention="flash")
+            max_seq_length=2048, remat="dots", attention="flash",
+            flash_block_q=1024, flash_block_k=1024)
         try:
             micro = int(os.environ.get("DLA_BENCH_MICRO", "8"))
         except ValueError:
@@ -205,7 +230,8 @@ def run_bench() -> dict:
 
     def loss_fn(p, frozen, batch, rng):
         del frozen, rng
-        loss, _ = model_fused_ce(model, p, batch)
+        loss, _ = model_fused_ce(model, p, batch,
+                                 **({"chunk": 4096} if on_accel else {}))
         return loss, {}
 
     config = {
@@ -302,6 +328,11 @@ def run_ppo_bench() -> dict:
         # rollout batch 64 = the reference's own scale
         # (config/rlhf_config.yaml rollout_batch_size)
         batch, prompt_w, new_tokens, rollouts, warmup = 64, 128, 128, 3, 1
+        # the UPDATE phase grad-accumulates 4 x 16 rows: at micro=64 the
+        # "dots" remat stash is [24L, 64, 256, 5632] bf16 x2 (~8.2G) and
+        # the step OOMs a 15.75G v5e (measured r5); micro=16 bounds the
+        # stash at ~2.1G with the same samples/sec semantics
+        update_micro, update_accum = 16, 4
     else:
         cfg = ModelConfig(
             vocab_size=512, hidden_size=64, intermediate_size=192,
@@ -309,6 +340,7 @@ def run_ppo_bench() -> dict:
             max_seq_length=128, remat="none", dtype="float32",
             param_dtype="float32", lora_r=4)
         batch, prompt_w, new_tokens, rollouts, warmup = 4, 16, 16, 2, 1
+        update_micro, update_accum = batch, 1
 
     mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
     policy = Transformer(cfg)
@@ -327,12 +359,12 @@ def run_ppo_bench() -> dict:
             "experiment_name": "bench_ppo",
             "optimization": {
                 "total_batch_size": batch,
-                "micro_batch_size": max(1, batch // dp),
+                "micro_batch_size": max(1, update_micro // dp),
                 "learning_rate": 1e-6, "max_train_steps": rollouts + warmup,
                 "lr_scheduler": "constant", "max_grad_norm": 1.0,
             },
             "logging": {"output_dir": "/tmp/dla_bench_ppo", "log_dir": None},
-            "hardware": {"gradient_accumulation_steps": 1},
+            "hardware": {"gradient_accumulation_steps": update_accum},
         }
         trainer = Trainer(
             config=config, mesh=mesh,
@@ -392,7 +424,11 @@ def run_ppo_bench() -> dict:
                    "rollout_weights": "int8", "kv_cache": cfg.kv_cache_dtype,
                    "params_m": round(n_params / 1e6),
                    "baseline_samples_s_chip": round(baseline, 2),
-                   "platform": dev.device_kind},
+                   "platform": dev.device_kind,
+                   # flag a guessed roofline bandwidth (ADVICE r4) so
+                   # artifact consumers can spot a mismatched baseline
+                   **({"hbm_bw_assumed_v5e": True}
+                      if hbm_bw_assumed(dev) else {})},
     }
 
 

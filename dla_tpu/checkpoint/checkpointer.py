@@ -281,6 +281,7 @@ class Checkpointer:
                         else None)
         shard_by_path = dict(shard_leaves) if shard_leaves else {}
         restored: Dict[str, Any] = {}
+        reshaped_paths = []
         for path, tmpl_leaf in leaves_t:
             meta = index["leaves"].get(path)
             if meta is None:
@@ -288,15 +289,37 @@ class Checkpointer:
             is_key = _is_prng_key(tmpl_leaf)
             sharding = shard_by_path.get(path)
             reader = _ShardReader.from_meta(ckpt, meta)
+            saved_shape = tuple(meta["shape"])
+            want_shape = tuple(getattr(tmpl_leaf, "shape", saved_shape))
+            if (not is_key and saved_shape != want_shape
+                    and int(np.prod(saved_shape)) == int(np.prod(want_shape))):
+                # size-preserving layout adaptation: the interleaved-PP
+                # block-major storage ([V, S, c, ...] leaves) is a
+                # row-major reshape of the canonical [L, ...] stack, so
+                # checkpoints written under either layout — or a
+                # different stage count — restore into the other by
+                # plain reshape (models/transformer.py
+                # _interleaved_storage). Genuine mismatches still fail
+                # the size check and raise below.
+                full = reader.full().reshape(want_shape)
+                out = (jax.device_put(full, sharding)
+                       if sharding is not None else jax.device_put(full))
+                reshaped_paths.append(path)
+                restored[path] = out
+                continue
             if sharding is not None and not is_key:
                 out = jax.make_array_from_callback(
-                    tuple(meta["shape"]), sharding,
+                    saved_shape, sharding,
                     lambda idx, r=reader: r.read(idx))
             else:
                 out = jax.device_put(reader.full())
                 if is_key:
                     out = jax.random.wrap_key_data(out)
             restored[path] = out
+        if reshaped_paths and jax.process_index() == 0:
+            print(f"[dla_tpu][checkpoint] adapted layer-stack layout of "
+                  f"{len(reshaped_paths)} leaves on restore (e.g. "
+                  f"{reshaped_paths[0]})", flush=True)
 
         tree = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(template),

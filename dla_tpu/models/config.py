@@ -109,6 +109,16 @@ class ModelConfig:
     # microbatches for the same bubble under plain GPipe). Requires
     # num_layers % (stage * V) == 0; M is pinned to the stage count.
     pipeline_interleave: int = 1
+    # storage hint for the interleaved schedule: when > 1 (and
+    # pipeline_interleave > 1) the stacked layer dim of every layer/LoRA
+    # leaf is stored block-major [V, S, L/(S*V), ...] — a row-major
+    # reshape of the canonical [L] stack — so the circular schedule's
+    # round-robin block ownership is stage-shard-local (no per-step
+    # cross-stage weight reshard). The config loader sets this from
+    # hardware.mesh.stage; couples param storage SHAPE (not order) to
+    # the stage count — cross-topology moves are a free reshape via
+    # Transformer.to_canonical_layout/to_storage_layout.
+    pipeline_stages: int = 0
     # Mixture-of-Experts (beyond-reference capability; makes the
     # reserved `expert` mesh axis real — ops/moe.py). 0 = dense MLP.
     # llama arch only; top-k routing with GShard capacity dispatch.

@@ -133,6 +133,11 @@ def export_hf_weights(params: Dict[str, Any], cfg: ModelConfig,
     def linear(x) -> np.ndarray:
         return host(x).T.copy()  # [in, out] -> HF [out, in]
 
+    # interleaved-PP storage layout ([V, S, c, ...] leaves) back to the
+    # canonical [L, ...] stack HF expects — a no-op for flat storage,
+    # with the enable predicate owned by the model, not duplicated here
+    from dla_tpu.models.transformer import Transformer
+    params = Transformer(cfg).to_canonical_layout(params)
     layers = params["layers"]
     L = cfg.num_layers
     moe = cfg.num_experts > 0

@@ -131,6 +131,12 @@ class Transformer:
         self.cfg = cfg
         self.adtype = jnp.dtype(cfg.dtype)
         self.pdtype = jnp.dtype(cfg.param_dtype)
+        if self._interleaved_storage and cfg.num_layers % (
+                cfg.pipeline_stages * cfg.pipeline_interleave):
+            raise ValueError(
+                f"pipeline_stages={cfg.pipeline_stages} x "
+                f"pipeline_interleave={cfg.pipeline_interleave} must divide "
+                f"num_layers={cfg.num_layers}")
         # gemma-2 scales attention by query_pre_attn_scalar**-0.5 (which
         # differs from head_dim**-0.5 on the 27B); None = op default
         self._softmax_scale = (
@@ -146,9 +152,93 @@ class Transformer:
             if cfg.attn_logit_softcap or cfg.query_pre_attn_scalar:
                 raise NotImplementedError(_ULYSSES_GEMMA2_ERROR)
 
+    # ------------------------------------------------------- storage layout
+
+    @property
+    def _interleaved_storage(self) -> bool:
+        """Whether stacked layer leaves are stored [V, S, c, ...] instead
+        of [L, ...]. The circular/interleaved pipeline schedule assigns
+        block b = p*S + s to stage s; with flat [L] storage sharded
+        contiguously over `stage`, GSPMD must exchange ~(V-1)/V of every
+        layer weight across the stage ring EVERY step (measured: one
+        weight-shaped all-to-all per layer leaf per step, r5 HLO probe).
+        Because block-major [V, S, c] is exactly the row-major reshape of
+        the canonical [L] stack, storing that 3-D leading shape and
+        sharding dim 1 over `stage` makes the round-robin ownership
+        shard-local with ZERO data reordering — flattening back to [L]
+        is a free reshape off-mesh. Enabled by cfg.pipeline_stages (set
+        from hardware.mesh.stage by the config loader when
+        pipeline_interleave > 1)."""
+        return (self.cfg.pipeline_stages > 1
+                and self.cfg.pipeline_interleave > 1)
+
+    def _storage_lead(self) -> Tuple[int, int, int]:
+        cfg = self.cfg
+        v, s = cfg.pipeline_interleave, cfg.pipeline_stages
+        return v, s, cfg.num_layers // (v * s)
+
+    def _map_layer_stack(self, tree: Params, fn) -> Params:
+        """Apply ``fn`` to every stacked leaf under tree["layers"]
+        (shallow copy elsewhere). Trees without a "layers" key pass
+        through unchanged."""
+        if not isinstance(tree, dict) or "layers" not in tree:
+            return tree
+        return {**tree,
+                "layers": {k: fn(v) for k, v in tree["layers"].items()}}
+
+    def to_storage_layout(self, tree: Params) -> Params:
+        """Canonical [L, ...] layer stacks -> the model's storage layout
+        ([V, S, c, ...] when interleaved storage is on; identity
+        otherwise). Idempotent: leaves already in storage shape pass
+        through. Use after building canonical trees (HF import, external
+        tools) before handing them to this model."""
+        if not self._interleaved_storage:
+            return tree
+        v, s, c = self._storage_lead()
+
+        def go(x):
+            if x.shape[:3] == (v, s, c):
+                return x
+            return x.reshape((v, s, c) + x.shape[1:])
+        return self._map_layer_stack(tree, go)
+
+    def to_canonical_layout(self, tree: Params) -> Params:
+        """Inverse of to_storage_layout (for export / plain-scan paths)."""
+        if not self._interleaved_storage:
+            return tree
+        n = self.cfg.num_layers
+
+        def go(x):
+            if x.shape[0] == n:
+                return x
+            return x.reshape((n,) + x.shape[3:])
+        return self._map_layer_stack(tree, go)
+
+    def _flat_layers(self, layers: Params) -> Params:
+        """Layer dict in canonical flat [L, ...] form for plain
+        scan-over-layers paths (free reshape: block-major storage IS
+        canonical row-major order)."""
+        if not self._interleaved_storage:
+            return layers
+        n = self.cfg.num_layers
+        return {k: (v.reshape((n,) + v.shape[3:])
+                    if v.shape[0] != n else v)
+                for k, v in layers.items()}
+
+    def _storage_spec(self, spec: P) -> P:
+        """Layer-stack PartitionSpec for the storage layout: the leading
+        P("stage", *rest) becomes P(None, "stage", None, *rest) — the
+        stage axis moves to the middle (block-index) dim."""
+        if not self._interleaved_storage:
+            return spec
+        return P(None, "stage", None, *spec[1:])
+
     # ------------------------------------------------------------------ init
 
     def init(self, rng: jax.Array) -> Params:
+        return self.to_storage_layout(self._init_canonical(rng))
+
+    def _init_canonical(self, rng: jax.Array) -> Params:
         cfg = self.cfg
         dh = cfg.head_dim_
         qdim, kvdim = cfg.num_heads * dh, cfg.num_kv_heads * dh
@@ -264,7 +354,7 @@ class Transformer:
                                   jnp.float32) * 0.02).astype(self.pdtype)
             layers[f"{t}_lora_b"] = jnp.zeros(
                 (cfg.num_layers, cfg.lora_r, dout), self.pdtype)
-        return {"layers": layers}
+        return self.to_storage_layout({"layers": layers})
 
     def lora_partition_specs(self) -> Params:
         """A shards its input dim like the base matrix; B its output dim."""
@@ -280,8 +370,10 @@ class Transformer:
         layers: Params = {}
         for t in self.cfg.lora_targets:
             spec = base[t]
-            layers[f"{t}_lora_a"] = P("stage", spec[1], None)
-            layers[f"{t}_lora_b"] = P("stage", None, spec[2])
+            layers[f"{t}_lora_a"] = self._storage_spec(
+                P("stage", spec[1], None))
+            layers[f"{t}_lora_b"] = self._storage_spec(
+                P("stage", None, spec[2]))
         return {"layers": layers}
 
     def merge_lora(self, params: Params, lora: Params) -> Params:
@@ -294,7 +386,8 @@ class Transformer:
         for t in cfg.lora_targets:
             a = lora["layers"][f"{t}_lora_a"].astype(jnp.float32)
             b = lora["layers"][f"{t}_lora_b"].astype(jnp.float32)
-            delta = jnp.einsum("lir,lro->lio", a, b) * scale
+            # "..." leading dims: [L] canonical or [V, S, c] storage
+            delta = jnp.einsum("...ir,...ro->...io", a, b) * scale
             new_layers[t] = (new_layers[t].astype(jnp.float32) + delta
                              ).astype(new_layers[t].dtype)
         out["layers"] = new_layers
@@ -323,6 +416,12 @@ class Transformer:
     # ------------------------------------------------------- partition specs
 
     def partition_specs(self) -> Params:
+        specs = self._partition_specs_canonical()
+        return self._map_layer_stack(
+            specs, self._storage_spec) if self._interleaved_storage \
+            else specs
+
+    def _partition_specs_canonical(self) -> Params:
         """PartitionSpec pytree mirroring ``init``'s output.
 
         fsdp shards the embedding/hidden dim; model shards heads / MLP
@@ -515,16 +614,21 @@ class Transformer:
                 and (cfg.query_pre_attn_scalar is None
                      or cfg.query_pre_attn_scalar == cfg.head_dim_))
 
-    def _with_layer_windows(self, layers: Params) -> Params:
+    def _with_layer_windows(self, layers: Params,
+                            storage: bool = False) -> Params:
         """Inject the per-layer SWA flag into the scan stream for
         alternating-window archs (gemma-2: layer l slides iff
         (l+1) % pattern != 0, HF Gemma2's is_sliding). Not a param —
-        rides the scan xs like the LoRA dropout keys."""
+        rides the scan xs like the LoRA dropout keys. ``storage``:
+        shape the flag [V, S, c] to match interleaved-storage leaves
+        (canonical index semantics survive the row-major reshape)."""
         cfg = self.cfg
         if not (cfg.sliding_window and cfg.sliding_window_pattern > 1):
             return layers
         win = ((jnp.arange(cfg.num_layers) + 1)
                % cfg.sliding_window_pattern != 0)
+        if storage and self._interleaved_storage:
+            win = win.reshape(self._storage_lead())
         return {**layers, "swa_on": win}
 
     def _weight(self, container: Params, name: str) -> jnp.ndarray:
@@ -556,12 +660,14 @@ class Transformer:
         The update/scoring paths keep using the original tree — only
         the sampled tokens see quantization."""
         out_layers: Params = {}
+        # dense [L, in, out] canonical or [V, S, c, in, out] storage
+        mat_ndim = 5 if self._interleaved_storage else 3
         for key, val in params["layers"].items():
-            if (key in self._WEIGHT_ONLY_MATS and val.ndim == 3
+            if (key in self._WEIGHT_ONLY_MATS and val.ndim == mat_ndim
                     and val.dtype != jnp.int8):  # idempotent: re-apply
                 # of an already-quantized tree must not re-scale
-                q, scale = self._symmetric_int8(val, axis=1)  # [L,1,out]
-                out_layers[key] = q
+                q, scale = self._symmetric_int8(val, axis=val.ndim - 2)
+                out_layers[key] = q            # scale [..., 1, out]
                 out_layers[key + "_wscale"] = scale
             else:
                 out_layers[key] = val
@@ -892,7 +998,13 @@ class Transformer:
             layers = {**layers, **lora["layers"]}
             if dropout_rng is not None and cfg.lora_dropout > 0:
                 keys = jax.random.split(dropout_rng, cfg.num_layers)
-        layers = self._with_layer_windows(layers)
+        # window flags join in the layout each path consumes: storage
+        # shape under the pipeline (the [V,S,c] leaves go straight to the
+        # stage schedule), flat [L] for the plain scan
+        if n_stages > 1:
+            layers = self._with_layer_windows(layers, storage=True)
+        else:
+            layers = self._with_layer_windows(self._flat_layers(layers))
 
         if n_stages > 1:
             # pipeline parallelism: layer stack sharded over `stage`,
@@ -999,21 +1111,46 @@ class Transformer:
         else:
             m = resolve_microbatches(x.shape[0], cfg.pipeline_microbatches,
                                      n_stages, dp_shards=dp_shards)
-        # block b = p*S + s lives at stacked[s, p]: [L] -> [V, S, c]
-        # (natural block-major order) -> transpose -> [S, V, c].
-        # LAYOUT COST (v > 1 only): params are stored contiguously over
-        # `stage` (stage s owns layers s*L/S..), but the round-robin
-        # schedule needs the strided blocks {p*S+s} — GSPMD inserts a
-        # cross-stage reshard of ~(V-1)/V of the layer weights per step.
-        # Fine when weight bytes/stage << per-step activation compute
-        # (deep-but-thin stages, the schedule's niche: batches too small
-        # for M=4S GPipe); a storage-permuted layout that makes this
-        # shard-local couples param order to the mesh's stage count and
-        # is tracked as future work (docs/pp_bubble.md).
-        c = n_layers // (n_stages * v)
-        stage_layers = jax.tree.map(
-            lambda l: l.reshape((v, n_stages, c) + l.shape[1:]
-                                ).swapaxes(0, 1), layers)
+        # block b = p*S + s lives at stacked[s, p]: the schedule wants
+        # [S, V, c] leaves with `stage` sharding dim 0.
+        if self._interleaved_storage:
+            if n_stages != cfg.pipeline_stages:
+                raise ValueError(
+                    f"model storage is laid out for pipeline_stages="
+                    f"{cfg.pipeline_stages} but the mesh has a stage axis "
+                    f"of {n_stages}; rebuild params via "
+                    "to_canonical_layout/to_storage_layout")
+            if v > 1:
+                # storage leaves are already block-major [V, S, c, ...]
+                # with `stage` sharding dim 1: the swap to [S, V, c] is a
+                # shard-local transpose — NO cross-stage weight
+                # collective per step (the (V-1)/V all-to-all reshard the
+                # flat layout paid; docs/pp_bubble.md, r5)
+                stage_layers = jax.tree.map(
+                    lambda l: l.swapaxes(0, 1), layers)
+            else:
+                # degraded to plain GPipe (batch cannot split S ways —
+                # already announced): contiguous stages need canonical
+                # order, so this corner pays the reshard the main path
+                # no longer does
+                c = n_layers // n_stages
+                stage_layers = jax.tree.map(
+                    lambda l: l.reshape((n_layers,) + l.shape[3:]
+                                        ).reshape((n_stages, 1, c)
+                                                  + l.shape[3:]), layers)
+        else:
+            # flat [L] storage: [L] -> [V, S, c] (block-major) ->
+            # transpose -> [S, V, c]. LAYOUT COST (v > 1 only): params
+            # are stored contiguously over `stage` but the round-robin
+            # schedule needs the strided blocks {p*S+s} — GSPMD inserts
+            # a cross-stage reshard of ~(V-1)/V of the layer weights per
+            # step. Set cfg.pipeline_stages (the config loader does it
+            # from hardware.mesh.stage) to store block-major and make
+            # the schedule shard-local.
+            c = n_layers // (n_stages * v)
+            stage_layers = jax.tree.map(
+                lambda l: l.reshape((v, n_stages, c) + l.shape[1:]
+                                    ).swapaxes(0, 1), layers)
         aux = {"cos": microbatch(cos, m), "sin": microbatch(sin, m),
                "positions": microbatch(positions, m)}
         if kv_mask is not None:
@@ -1222,7 +1359,8 @@ class Transformer:
             return h, kv
 
         x, (ks, vs) = jax.lax.scan(
-            body, x, self._with_layer_windows(params["layers"]))
+            body, x,
+            self._with_layer_windows(self._flat_layers(params["layers"])))
         h = self._final_norm(params, x)
 
         lengths = attention_mask.astype(jnp.int32).sum(axis=1)
@@ -1338,7 +1476,7 @@ class Transformer:
             x2 = x1 + mlp_out
             return x2, (k, v)
 
-        xs = (self._with_layer_windows(params["layers"]),
+        xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
               cache["k"], cache["v"])
         if self._kv_int8:
             xs = xs + (cache["k_scale"], cache["v_scale"])

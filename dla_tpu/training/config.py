@@ -92,6 +92,17 @@ def load_config(path, overlays: Sequence[str] = (),
     for ov_path in overlays:
         cfg = deep_merge(cfg, load_yaml(ov_path))
     cfg = apply_overrides(cfg, overrides)
+    # interleaved-PP storage coupling: block-major layer storage needs
+    # the stage count at model-build time (transformer.py
+    # _interleaved_storage). Copied, not required — an explicit
+    # model.pipeline_stages (or a wildcard/absent stage axis) wins.
+    model = cfg.get("model") or {}
+    stage = ((cfg.get("hardware") or {}).get("mesh") or {}).get("stage", 1)
+    if (int(model.get("pipeline_interleave", 1) or 1) > 1
+            and "pipeline_stages" not in model
+            and isinstance(stage, int) and stage > 1):
+        model["pipeline_stages"] = stage
+        cfg["model"] = model
     if not quiet:
         for w in warn_legacy_keys(cfg):
             print(f"[dla_tpu][config] {w}", flush=True)

@@ -70,6 +70,7 @@ def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
                 "attn_logit_softcap", "final_logit_softcap",
                 "query_pre_attn_scalar",
                 "pipeline_microbatches", "pipeline_interleave",
+                "pipeline_stages",
                 "num_experts", "num_experts_per_token",
                 "moe_capacity_factor", "moe_group_size", "moe_aux_weight",
                 "moe_z_weight"):
@@ -101,6 +102,10 @@ def load_causal_lm(name_or_path: str, model_cfg: Dict[str, Any],
                 "cannot rebuild the architecture")
         cfg = ModelConfig.from_dict({**mc, **overrides})
         model = Transformer(cfg)
+        # a checkpoint written by a matching run is already in storage
+        # layout (idempotent); one written canonically (e.g. converted
+        # cross-topology via to_canonical_layout) reshapes here
+        params = model.to_storage_layout(params)
         tok = _tokenizer_for(name_or_path, model_cfg, aux)
         return ModelBundle(model, params, model.partition_specs(), tok, cfg)
 
@@ -108,6 +113,9 @@ def load_causal_lm(name_or_path: str, model_cfg: Dict[str, Any],
     if hf is not None:
         cfg, params = hf
         model = Transformer(cfg)
+        # HF import builds the canonical [L] stack; interleaved-PP
+        # models store block-major (free reshape, no-op otherwise)
+        params = model.to_storage_layout(params)
         tok = _tokenizer_for(name_or_path, model_cfg)
         return ModelBundle(model, params, model.partition_specs(), tok, cfg)
 

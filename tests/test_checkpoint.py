@@ -135,3 +135,29 @@ def test_overwrite_same_step(tmp_path):
     got, _ = ck.restore(t1, tag="final")
     np.testing.assert_array_equal(
         np.asarray(got["params"]["w"]), np.asarray(t2["params"]["w"]))
+
+
+def test_restore_adapts_layer_stack_layout(tmp_path, mesh8):
+    """A checkpoint saved with flat [L, ...] layer leaves restores into
+    an interleaved-storage template ([V, S, c, ...]) by row-major
+    reshape — pre-layout-change checkpoints stay resumable (round 5),
+    and stage-count changes are a free reshape. (Size-mismatched leaves
+    keep restore's longstanding behavior: saved shape wins — the
+    adaptation only engages on equal element counts.)"""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dla_tpu.checkpoint.checkpointer import Checkpointer
+    ck = Checkpointer(tmp_path / "ck")
+    flat = {"layers": {"wq": np.arange(4 * 6, dtype=np.float32
+                                       ).reshape(4, 2, 3)}}
+    ck.save(1, flat, {"step": 1})
+    tmpl = {"layers": {"wq": np.zeros((2, 2, 1, 2, 3), np.float32)}}
+    sh = {"layers": {"wq": NamedSharding(mesh8, P(None, "data"))}}
+    tree, aux = ck.restore(tmpl, shardings=sh)
+    got = np.asarray(tree["layers"]["wq"])
+    assert got.shape == (2, 2, 1, 2, 3)
+    # row-major invariant: flattening recovers the canonical order
+    np.testing.assert_array_equal(got.reshape(4, 2, 3),
+                                  flat["layers"]["wq"])

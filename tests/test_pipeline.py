@@ -291,6 +291,151 @@ def test_interleaved_pipeline_grads_match(tiny_setup):
                                    rtol=2e-3, atol=2e-4)
 
 
+def test_interleaved_storage_no_weight_collective():
+    """Round-5 verdict item 3: with pipeline_stages set, layer weights
+    store block-major [V, S, c, ...] and the circular schedule runs with
+    ZERO cross-stage weight collectives — the flat layout paid one
+    weight-shaped all-to-all per layer leaf per step (~(V-1)/V of all
+    layer bytes). HLO-level assertion on a pure stage mesh (no fsdp/tp,
+    so any all-gather/all-to-all would be the weight reshard) + parity."""
+    import dataclasses
+    import re
+    cfg0 = get_model_config("tiny-gqa")
+    model0 = Transformer(cfg0)
+    params0 = model0.init(jax.random.key(2))
+    rs = np.random.RandomState(30)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    want = model0.apply(params0, ids)
+
+    cfg = dataclasses.replace(cfg0, pipeline_interleave=2,
+                              pipeline_stages=2)
+    model = Transformer(cfg)
+    params = model.to_storage_layout(params0)
+    for k, v in params["layers"].items():
+        assert v.shape[:3] == (2, 2, 1), (k, v.shape)
+    # plain-scan path flattens storage back for free — exact equality
+    np.testing.assert_array_equal(np.asarray(model.apply(params, ids)),
+                                  np.asarray(want))
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = build_mesh(MeshConfig(stage=2, data=1, fsdp=1, model=1,
+                                 sequence=1), devices=jax.devices()[:2])
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        compiled = jax.jit(lambda p: model.apply(p, ids)).lower(sp
+                                                               ).compile()
+        got = compiled(sp)
+    hlo = compiled.as_text()
+    counts = {op: len(re.findall(rf'= [^\n]*{op}\(', hlo))
+              for op in ("all-gather", "all-to-all")}
+    assert counts["all-gather"] == 0 and counts["all-to-all"] == 0, (
+        f"cross-stage weight reshard survived: {counts}")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_interleaved_storage_grads_match():
+    """Backward under block-major storage: grads come back in storage
+    layout and match the canonical reference after flattening."""
+    import dataclasses
+    cfg0 = get_model_config("tiny-gqa")
+    model0 = Transformer(cfg0)
+    params0 = model0.init(jax.random.key(3))
+    rs = np.random.RandomState(31)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    batch = {"input_ids": ids, "labels": jnp.where(ids % 7 == 0, -100, ids)}
+    g_ref = jax.grad(lambda p: model_fused_ce(model0, p, batch)[0])(params0)
+
+    cfg = dataclasses.replace(cfg0, pipeline_interleave=2,
+                              pipeline_stages=2)
+    model = Transformer(cfg)
+    params = model.to_storage_layout(params0)
+    mesh = _stage_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        g_pp = jax.jit(jax.grad(
+            lambda p: model_fused_ce(model, p, batch)[0]))(sp)
+    g_flat = model.to_canonical_layout(g_pp)
+    for a, b in zip(jax.tree.leaves(g_flat), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_interleaved_storage_gemma2_swa_and_lora():
+    """swa_on flags reshape with the block-major storage (canonical
+    index semantics survive the row-major reshape), and LoRA
+    init/merge/quantize all speak the 5-D leaf layout."""
+    import dataclasses
+    cfg0 = dataclasses.replace(
+        get_model_config("tiny-gqa"),
+        arch="gemma2", sliding_window=5, sliding_window_pattern=2,
+        attn_logit_softcap=20.0, final_logit_softcap=10.0,
+        query_pre_attn_scalar=8, tie_embeddings=True,
+        lora_r=4, lora_targets=("wq", "wv"))
+    model0 = Transformer(cfg0)
+    params0 = model0.init(jax.random.key(13))
+    lora0 = model0.init_lora(jax.random.key(14))
+    # make B nonzero so merge actually changes weights
+    lora0 = jax.tree.map(
+        lambda x: x + 0.01 if x.ndim and x.shape[-1] != 4 else x, lora0)
+    rs = np.random.RandomState(32)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    want = model0.apply(model0.merge_lora(params0, lora0), ids)
+
+    cfg = dataclasses.replace(cfg0, pipeline_interleave=2,
+                              pipeline_stages=2)
+    model = Transformer(cfg)
+    params = model.to_storage_layout(params0)
+    lora = model.to_storage_layout(lora0)
+    merged = model.merge_lora(params, lora)
+    got = model.apply(merged, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # quantize_weights handles 5-D mats; decode path flattens them
+    q = model.quantize_weights(merged)
+    assert q["layers"]["wq"].dtype == jnp.int8
+    assert q["layers"]["wq_wscale"].shape[:3] == (2, 2, 1)
+    logits, cache = model.start_decode(
+        q, ids[:, :8], jnp.ones((4, 8), jnp.int32), max_new_tokens=2)
+    logits2, _ = model.decode_step(q, cache, jnp.argmax(logits, -1))
+    assert np.isfinite(np.asarray(logits2)).all()
+    # pipeline parity under the stage mesh
+    mesh = _stage_mesh()
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(merged, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got_pp = jax.jit(lambda p: model.apply(p, ids))(sp)
+    np.testing.assert_allclose(np.asarray(got_pp), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_config_loader_sets_pipeline_stages(tmp_path):
+    """load_config copies hardware.mesh.stage into model.pipeline_stages
+    when pipeline_interleave > 1 (the storage-layout coupling)."""
+    import yaml
+
+    from dla_tpu.training.config import load_config
+    cfg = {"model": {"model_name_or_path": "tiny-gqa",
+                     "pipeline_interleave": 2},
+           "hardware": {"mesh": {"stage": 2, "fsdp": 4}}}
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    out = load_config(str(p), quiet=True)
+    assert out["model"]["pipeline_stages"] == 2
+    # explicit value wins; no interleave -> untouched
+    cfg["model"]["pipeline_stages"] = 4
+    p.write_text(yaml.safe_dump(cfg))
+    assert load_config(str(p), quiet=True)["model"]["pipeline_stages"] == 4
+    del cfg["model"]["pipeline_stages"]
+    cfg["model"]["pipeline_interleave"] = 1
+    p.write_text(yaml.safe_dump(cfg))
+    assert "pipeline_stages" not in load_config(str(p),
+                                                quiet=True)["model"]
+
+
 def test_interleaved_falls_back_when_batch_too_small(capsys):
     """A batch that cannot split into S microbatches falls back to plain
     GPipe with a warning instead of failing."""

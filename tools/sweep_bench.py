@@ -151,6 +151,20 @@ VARIANTS = {
     # odd micro between the 8-OOM-at-hd64 and 12-OOM-at-hd128 cliffs
     "kv4_micro10": dict(heads=8, kv_heads=4, micro=10,
                         moment_dtype="bfloat16"),
+    # round-5: the two independent wins measured above (1024-blocks
+    # 1.0714, ce4096 1.065) combined, plus one step further on each
+    "kv4_micro8_b1024_ce4096": dict(heads=8, kv_heads=4, micro=8,
+                                    moment_dtype="bfloat16",
+                                    block_q=1024, block_k=1024,
+                                    ce_chunk=4096),
+    "kv4_micro8_b1024_ce8192": dict(heads=8, kv_heads=4, micro=8,
+                                    moment_dtype="bfloat16",
+                                    block_q=1024, block_k=1024,
+                                    ce_chunk=8192),
+    "kv4_micro8_b2048_ce4096": dict(heads=8, kv_heads=4, micro=8,
+                                    moment_dtype="bfloat16",
+                                    block_q=2048, block_k=1024,
+                                    ce_chunk=4096),
     # the flagship packing:true path — segment ids through the
     # segment-aware flash kernel (fwd + bwd)
     "kv4_micro8_packed": dict(heads=8, kv_heads=4, micro=8,
