@@ -998,6 +998,16 @@ class Transformer:
             layers = {**layers, **lora["layers"]}
             if dropout_rng is not None and cfg.lora_dropout > 0:
                 keys = jax.random.split(dropout_rng, cfg.num_layers)
+        # MoE routing must know which tokens are real: pads must not
+        # claim expert capacity or skew the balance statistics (shared
+        # by the pipeline and plain-scan paths)
+        token_valid = None
+        if cfg.num_experts > 0:
+            if attention_mask is not None:
+                token_valid = attention_mask
+            elif segment_ids is not None:
+                token_valid = (segment_ids > 0).astype(jnp.int32)
+
         # window flags join in the layout each path consumes: storage
         # shape under the pipeline (the [V,S,c] leaves go straight to the
         # stage schedule), flat [L] for the plain scan
@@ -1018,25 +1028,11 @@ class Transformer:
                 raise NotImplementedError(
                     "lora_dropout under pipeline parallelism is not "
                     "supported; set lora.dropout to 0")
-            if cfg.num_experts > 0:
-                raise NotImplementedError(
-                    "MoE under pipeline parallelism is not supported yet "
-                    "(the router's balance loss has no collection path "
-                    "through the stage schedule)")
-            x = self._pipeline_forward(layers, x, cos, sin, kv_mask,
-                                       positions, n_stages,
-                                       allow_flash=allow_flash,
-                                       flash_segs=flash_segs, cp=cp)
-            return self._final_norm(params, x), None
-
-        # MoE routing must know which tokens are real: pads must not
-        # claim expert capacity or skew the balance statistics
-        token_valid = None
-        if cfg.num_experts > 0:
-            if attention_mask is not None:
-                token_valid = attention_mask
-            elif segment_ids is not None:
-                token_valid = (segment_ids > 0).astype(jnp.int32)
+            x, moe_aux = self._pipeline_forward(
+                layers, x, cos, sin, kv_mask, positions, n_stages,
+                allow_flash=allow_flash, flash_segs=flash_segs, cp=cp,
+                token_valid=token_valid)
+            return self._final_norm(params, x), moe_aux
 
         if keys is None:
             def body(carry, layer):
@@ -1073,8 +1069,9 @@ class Transformer:
                           n_stages: int, *,
                           allow_flash: bool = False,
                           flash_segs: Optional[Tuple] = None,
-                          cp: Optional[Tuple] = None
-                          ) -> jnp.ndarray:
+                          cp: Optional[Tuple] = None,
+                          token_valid: Optional[jnp.ndarray] = None
+                          ) -> Tuple[jnp.ndarray, Optional[Any]]:
         """GPipe over the `stage` mesh axis: reshape the [L, ...] layer
         stack to [S, L/S, ...] (shard-local — the stage axis owns
         contiguous layer blocks), microbatch the batch dim, and run the
@@ -1165,6 +1162,9 @@ class Transformer:
             cp_mode, cp_valid, cp_seg, cp_gapped = cp
             aux["cp_valid"] = microbatch(cp_valid, m)
             aux["cp_seg"] = microbatch(cp_seg, m)
+        collect_aux = cfg.num_experts > 0
+        if token_valid is not None:
+            aux["token_valid"] = microbatch(token_valid, m)
 
         def stage_fn(stage_params, h, aux_t):
             cp_t = None
@@ -1173,20 +1173,34 @@ class Transformer:
                         cp_gapped)
 
             def body(carry, layer):
-                out, _, _ = self._block(layer, carry, aux_t["cos"],
-                                        aux_t["sin"], aux_t.get("kv_mask"),
-                                        aux_t["positions"],
-                                        aux_t["positions"],
-                                        allow_flash=allow_flash,
-                                        flash_segs=aux_t.get("flash_segs"),
-                                        cp=cp_t)
-                return out, None
-            h, _ = jax.lax.scan(self._maybe_remat(body), h, stage_params)
+                out, _, aux_l = self._block(
+                    layer, carry, aux_t["cos"],
+                    aux_t["sin"], aux_t.get("kv_mask"),
+                    aux_t["positions"], aux_t["positions"],
+                    allow_flash=allow_flash,
+                    flash_segs=aux_t.get("flash_segs"), cp=cp_t,
+                    token_valid=aux_t.get("token_valid"))
+                return out, aux_l
+            h, auxs = jax.lax.scan(self._maybe_remat(body), h,
+                                   stage_params)
+            if collect_aux:
+                # sum this block's per-layer scalars; gpipe masks
+                # garbage ticks, sums across ticks and psums across
+                # stages — (1/(L*M))x that sum is the layer-and-
+                # microbatch mean the plain scan path reports
+                return h, jax.tree.map(
+                    lambda a: jnp.sum(a.astype(jnp.float32), axis=0),
+                    auxs)
             return h
 
         out = gpipe(stage_fn, stage_layers, microbatch(x, m), aux,
-                    n_stages, passes=v)
-        return out.reshape(x.shape)
+                    n_stages, passes=v, collect_aux=collect_aux)
+        moe_aux = None
+        if collect_aux:
+            out, aux_sums = out
+            moe_aux = type(aux_sums)(
+                *(a / (n_layers * m) for a in aux_sums))
+        return out.reshape(x.shape), moe_aux
 
     def _final_norm(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
         if self.cfg.arch == "phi":

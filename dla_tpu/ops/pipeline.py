@@ -51,6 +51,7 @@ def gpipe(
     aux_mb: Pytree,             # pytree of [M, mb, ...] per-microbatch aux
     n_stages: int,
     passes: int = 1,
+    collect_aux: bool = False,
 ) -> jnp.ndarray:
     """Run ``stage_fn`` (one pass's layer block) as a pipeline over the
     ``stage`` mesh axis — plain GPipe (``passes=1``) or the interleaved
@@ -75,6 +76,15 @@ def gpipe(
     arrives at stage 0 exactly when it starts pass p+1 at tick t+1 —
     the shift register needs no extra buffering (the maxtext
     circ_storage degenerates away at M = S).
+
+    ``collect_aux``: stage_fn returns (h, aux_pytree) — small per-block
+    scalars (the MoE router's balance/z/dropped stats). Emissions from
+    warmup/drain garbage ticks are zero-masked; real-tick emissions are
+    summed across ticks and psum-ed across stages, so the caller gets
+    the SUM over every (microbatch, layer-block) execution — divide by
+    (L * M) for the layer-and-microbatch mean. Returns (out, aux_sums).
+    Gradients flow through the collection (the balance loss trains the
+    router), riding the same scan/psum transposes as the activations.
 
     Requires the ambient mesh to carry a ``stage`` axis of ``n_stages``
     (Transformer._pipeline_forward guarantees it; direct callers get a
@@ -117,24 +127,41 @@ def gpipe(
             # passes consume the ring input from stage S-1
             sx = jnp.where((s_idx == 0) & (t < m), inj, sx)
             out = stage_fn(block, sx, aux_t)
+            if collect_aux:
+                out, aux_emit = out
+                # zero the warmup/drain garbage-tick emissions
+                real = ((rel >= 0) & (rel < passes * m))
+                aux_emit = jax.tree.map(
+                    lambda a: jnp.where(real, a, 0.0), aux_emit)
+                return jax.lax.ppermute(out, "stage", perm), (out, aux_emit)
             return jax.lax.ppermute(out, "stage", perm), out
 
         _, ys = jax.lax.scan(tick, st_x, jnp.arange(total_ticks))
+        aux_sums = None
+        if collect_aux:
+            ys, aux_ys = ys
+            # sum real-tick emissions locally, then across the stage ring
+            aux_sums = jax.tree.map(
+                lambda a: jax.lax.psum(jnp.sum(a, axis=0), "stage"),
+                aux_ys)
         # only the last stage's emissions are the model output
         last = (s_idx == n_stages - 1).astype(ys.dtype)
-        return jax.lax.psum(ys * last, "stage")
+        out = jax.lax.psum(ys * last, "stage")
+        return (out, aux_sums) if collect_aux else out
 
     fn = jax.shard_map(
         run,
         in_specs=(jax.tree.map(lambda _: P("stage"), stage_params),
                   P(), jax.tree.map(lambda _: P(), aux_mb)),
-        out_specs=P(),
+        out_specs=P() if not collect_aux else (P(), P()),
         axis_names={"stage"}, check_vma=False)
-    ys = fn(stage_params, x_mb, aux_mb)
+    res = fn(stage_params, x_mb, aux_mb)
+    ys, aux_sums = res if collect_aux else (res, None)
     # the last stage's FINAL-pass emissions: microbatch j exits at tick
     # (passes-1)*m + (S-1) + j
     start = (passes - 1) * m + pad
-    return ys[start:start + m]
+    out = ys[start:start + m]
+    return (out, aux_sums) if collect_aux else out
 
 
 def _require_stage_mesh(n_stages: int) -> None:

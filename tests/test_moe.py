@@ -215,3 +215,72 @@ def test_moe_config_guards():
                     num_layers=1, num_heads=1, num_kv_heads=1,
                     num_experts=2, lora_r=4,
                     lora_targets=("wq", "w_gate"))
+
+def test_moe_pipeline_parity_and_aux():
+    """MoE x PP (round-5 verdict item 4): the router's aux scalars ride
+    the stage schedule (masked tick sums, psum at collection). Hidden
+    states match the plain forward; router_z / dropped_frac are linear
+    in tokens so their microbatch means equal the full-batch stats
+    exactly; load_balance is a product of per-expert means, so the
+    per-microbatch convention (same as grad accumulation's) differs
+    within a small tolerance."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = get_model_config("tiny-moe")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rs = np.random.RandomState(40)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    mask = jnp.ones((4, 16), jnp.int32)
+    want_h, want_aux = model.hidden_states_with_aux(params, ids, mask)
+    mesh = build_mesh(MeshConfig(stage=2, data=1, fsdp=2, model=1,
+                                 sequence=1, expert=2))
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        got_h, got_aux = jax.jit(
+            lambda p: model.hidden_states_with_aux(p, ids, mask))(sp)
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(got_aux.router_z),
+                               float(want_aux.router_z), rtol=1e-5)
+    np.testing.assert_allclose(float(got_aux.dropped_frac),
+                               float(want_aux.dropped_frac), atol=1e-6)
+    np.testing.assert_allclose(float(got_aux.load_balance),
+                               float(want_aux.load_balance), rtol=5e-2)
+
+
+def test_moe_pipeline_grads_flow_through_router():
+    """Backward through MoE x PP: the balance loss trains the router via
+    the masked-psum collection path (grads match the plain scan within
+    the microbatch-statistics tolerance)."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = get_model_config("tiny-moe")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(1))
+    rs = np.random.RandomState(41)
+    ids = jnp.asarray(rs.randint(1, 100, (4, 16)), jnp.int32)
+    batch = {"input_ids": ids,
+             "attention_mask": np.ones((4, 16), np.int32),
+             "labels": jnp.where(ids % 5 == 0, -100, ids)}
+
+    def loss(p):
+        return model_fused_ce(model, p, batch)[0]
+
+    g_ref = jax.grad(loss)(params)
+    mesh = build_mesh(MeshConfig(stage=2, data=1, fsdp=2, model=1,
+                                 sequence=1, expert=2))
+    with jax.sharding.set_mesh(mesh):
+        sp = jax.device_put(params, sharding_tree(model.partition_specs(),
+                                                  mesh))
+        g_pp = jax.jit(jax.grad(loss))(sp)
+    # the router grad must be nonzero (balance loss collected) and close
+    router_ref = np.asarray(g_ref["layers"]["router"])
+    router_pp = np.asarray(g_pp["layers"]["router"])
+    assert np.abs(router_pp).max() > 0
+    np.testing.assert_allclose(router_pp, router_ref, rtol=5e-2,
+                               atol=5e-4)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-4)
