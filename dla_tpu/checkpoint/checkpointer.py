@@ -291,16 +291,24 @@ class Checkpointer:
             reader = _ShardReader.from_meta(ckpt, meta)
             saved_shape = tuple(meta["shape"])
             want_shape = tuple(getattr(tmpl_leaf, "shape", saved_shape))
-            if (not is_key and saved_shape != want_shape
-                    and int(np.prod(saved_shape)) == int(np.prod(want_shape))):
-                # size-preserving layout adaptation: the interleaved-PP
+            if (not is_key
+                    and _is_layer_stack_reshape(path, saved_shape,
+                                                want_shape)):
+                # layer-stack layout adaptation: the interleaved-PP
                 # block-major storage ([V, S, c, ...] leaves) is a
                 # row-major reshape of the canonical [L, ...] stack, so
                 # checkpoints written under either layout — or a
                 # different stage count — restore into the other by
                 # plain reshape (models/transformer.py
-                # _interleaved_storage). Genuine mismatches still fail
-                # the size check and raise below.
+                # _interleaved_storage). Deliberately NARROW: only
+                # "layers" leaves whose trailing dims match exactly and
+                # whose leading dims are a pure regrouping qualify — any
+                # other shape mismatch keeps restore's longstanding
+                # behavior (saved shape wins, mismatch surfaces at
+                # first use). The adapted leaf is read WHOLE on every
+                # host (migration-scale path; for in-place topology
+                # flips of very large trees prefer an offline
+                # to_canonical_layout/to_storage_layout conversion).
                 full = reader.full().reshape(want_shape)
                 out = (jax.device_put(full, sharding)
                        if sharding is not None else jax.device_put(full))
@@ -325,6 +333,31 @@ class Checkpointer:
             jax.tree_util.tree_structure(template),
             [restored[p] for p, _ in leaves_t])
         return tree, index.get("aux", {})
+
+
+def _is_layer_stack_reshape(path: str, saved: Tuple[int, ...],
+                            want: Tuple[int, ...]) -> bool:
+    """Whether a saved leaf may be row-major-reshaped into the template
+    shape: a layer-stack leaf (path under a "layers" subtree) whose
+    trailing dims are IDENTICAL and whose differing leading dims ([L]
+    vs [V, S, c], any grouping) regroup the same element count. Equal
+    trailing dims rule out transposes and other coincidental
+    size matches — reshape is only sound for the leading-dim
+    regrouping the interleaved-PP storage uses."""
+    if saved == want or f"{SEP}layers{SEP}" not in f"{SEP}{path}{SEP}":
+        return False
+    if int(np.prod(saved)) != int(np.prod(want)):
+        return False
+    # strip the longest common SUFFIX; the remainders are the leading
+    # group dims on each side — both must be pure regroupings
+    i = 0
+    while (i < min(len(saved), len(want))
+           and saved[len(saved) - 1 - i] == want[len(want) - 1 - i]):
+        i += 1
+    lead_saved = saved[:len(saved) - i]
+    lead_want = want[:len(want) - i]
+    return (math.prod(lead_saved or (1,)) == math.prod(lead_want or (1,))
+            and len(lead_saved) in (1, 2, 3) and len(lead_want) in (1, 2, 3))
 
 
 def load_tree_numpy(ckpt_dir, prefix: Optional[str] = None

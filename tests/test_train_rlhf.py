@@ -156,3 +156,70 @@ def test_rlhf_gae_checkpoint_chains(tmp_path):
     ids = np.random.RandomState(0).randint(1, 100, (2, 8)).astype(np.int32)
     out = bundle.model.apply(bundle.params, ids)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def _enable_quant(cfg_path):
+    import yaml
+    cfg = yaml.safe_load(open(cfg_path))
+    cfg["ppo"]["rollout_quantize_weights"] = True
+    open(cfg_path, "w").write(yaml.safe_dump(cfg))
+    return cfg_path
+
+
+def test_quantized_rollout_gae_scores_from_quantized_tree(tmp_path,
+                                                         monkeypatch):
+    """Round-5 verdict item 5: with ppo.rollout_quantize_weights, GAE's
+    behavior_logp/behavior_values must come from the SAME int8 tree that
+    sampled (previously gae scored from full precision — off-policy
+    drift). The spy asserts the score fn receives int8 weights and no
+    separate adapters."""
+    import jax.numpy as jnp
+
+    import dla_tpu.training.train_rlhf as tr
+    seen = {}
+    real = tr.make_gae_score_fn
+
+    def spy(*a, **k):
+        fn = real(*a, **k)
+
+        def wrapped(policy_params, value_head, ref_params, rm_params,
+                    *args, **kw):
+            seen["int8"] = policy_params["layers"]["wq"].dtype == jnp.int8
+            seen["lora"] = kw.get("lora") is not None
+            return fn(policy_params, value_head, ref_params, rm_params,
+                      *args, **kw)
+        return wrapped
+
+    monkeypatch.setattr(tr, "make_gae_score_fn", spy)
+    cfgp = _enable_quant(_rlhf_cfg(tmp_path, "gae", steps=2))
+    tr.main(["--config", str(cfgp)])
+    assert seen.get("int8") is True, (
+        "gae scored from a non-quantized tree under "
+        f"rollout_quantize_weights: {seen}")
+    assert seen.get("lora") is False
+    assert np.isfinite(_metrics(tmp_path)[-1]["train/loss"])
+
+
+def test_quantized_rollout_reinforce_scores_from_quantized_tree(
+        tmp_path, monkeypatch):
+    """Same pin for the reinforce/ppo score path (already consistent —
+    regression guard)."""
+    import jax.numpy as jnp
+
+    import dla_tpu.training.train_rlhf as tr
+    seen = {}
+    real = tr.make_score_fn
+
+    def spy(*a, **k):
+        fn = real(*a, **k)
+
+        def wrapped(policy_params, *args, **kw):
+            seen["int8"] = policy_params["layers"]["wq"].dtype == jnp.int8
+            return fn(policy_params, *args, **kw)
+        return wrapped
+
+    monkeypatch.setattr(tr, "make_score_fn", spy)
+    cfgp = _enable_quant(_rlhf_cfg(tmp_path, "reinforce"))
+    tr.main(["--config", str(cfgp)])
+    assert seen.get("int8") is True
+    assert np.isfinite(_metrics(tmp_path)[-1]["train/loss"])
