@@ -139,25 +139,12 @@ class ModelConfig:
     lora_dropout: float = 0.0
     lora_targets: tuple = ("wq", "wk", "wv", "wo")
 
-    # ulysses materializes full-length attention scores per head slice
-    # (dla_tpu/ops/ulysses.py memory note) — quadratic in sequence length.
-    # Past this bound it will OOM before ring attention even breaks a
-    # sweat, so fail at config time with the fix in the message.
-    ULYSSES_MAX_SEQ = 16384
-
     def __post_init__(self):
         if self.kv_cache_dtype not in ("bfloat16", "int8"):
             raise ValueError(
                 f"kv_cache_dtype must be 'bfloat16' or 'int8', got "
                 f"{self.kv_cache_dtype!r} — a typo here would silently "
                 "run the full-precision cache")
-        if (self.context_parallel == "ulysses"
-                and self.max_seq_length > self.ULYSSES_MAX_SEQ):
-            raise ValueError(
-                f"context_parallel: ulysses materializes [T, T]-scale "
-                f"scores and cannot run at max_seq_length="
-                f"{self.max_seq_length} (> {self.ULYSSES_MAX_SEQ}); use "
-                f"context_parallel: ring for long context")
         if self.num_experts > 0:
             if self.arch != "llama":
                 raise ValueError(

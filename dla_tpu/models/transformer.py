@@ -50,18 +50,6 @@ ACT_SPEC = P(("data", "fsdp"), "sequence", None)
 # trace-time, so one line per compiled shape, not per step
 _REPLICATED_FLASH_LOGGED: set = set()
 
-_ULYSSES_WINDOW_ERROR = (
-    "sliding-window attention is not supported under ulysses context "
-    "parallelism (it gathers full-length kv per head slice and its flash "
-    "path reasons by global index); use context_parallel: ring "
-    "(window-aware) or unset model.sliding_window")
-
-_ULYSSES_GEMMA2_ERROR = (
-    "gemma-2 attention (softcapping / query_pre_attn_scalar) is not "
-    "supported under ulysses context parallelism; use "
-    "context_parallel: ring")
-
-
 def _flash_tileable(t: int) -> bool:
     """Whether the Pallas flash kernel may take sequence length T.
 
@@ -142,15 +130,6 @@ class Transformer:
         self._softmax_scale = (
             cfg.query_pre_attn_scalar ** -0.5
             if cfg.query_pre_attn_scalar else None)
-        if cfg.context_parallel == "ulysses" and _sequence_axis_size() > 1:
-            # fail at model construction (trainers build models under the
-            # ambient mesh, before checkpoint load or compile); the same
-            # refusals backstop at trace time in _attention for models
-            # built outside the mesh
-            if cfg.sliding_window:
-                raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
-            if cfg.attn_logit_softcap or cfg.query_pre_attn_scalar:
-                raise NotImplementedError(_ULYSSES_GEMMA2_ERROR)
 
     # ------------------------------------------------------- storage layout
 
@@ -711,16 +690,20 @@ class Transformer:
         if cp is not None:
             mode, kv_valid, seg, gapped = cp
             if mode == "ulysses":
-                if self.cfg.sliding_window:
-                    raise NotImplementedError(_ULYSSES_WINDOW_ERROR)
-                if (self.cfg.attn_logit_softcap
-                        or self.cfg.query_pre_attn_scalar is not None):
-                    raise NotImplementedError(_ULYSSES_GEMMA2_ERROR)
                 from dla_tpu.ops.ulysses import ulysses_causal_attention
+                # window/softcap/query-scale fold into the per-head-slice
+                # attention: the all-to-all hands each device the FULL
+                # sequence (global positions via gather), so the same
+                # window semantics ring implements by rotating metadata
+                # apply directly (ops/ulysses.py _ulysses_local)
                 return ulysses_causal_attention(
                     q, k, v, q_positions=q_positions,
                     kv_positions=kv_positions, kv_valid=kv_valid,
                     segment_ids=seg,
+                    window=window,
+                    contiguous=not gapped,
+                    softmax_scale=self._softmax_scale,
+                    logit_softcap=self.cfg.attn_logit_softcap,
                     use_flash=(self.cfg.attention == "flash"
                                and _flash_tileable(t)),
                     flash_block_q=self.cfg.flash_block_q,

@@ -228,15 +228,20 @@ def test_ring_sliding_window_parity(seq_mesh):
                                    rtol=2e-3, atol=2e-4)
 
 
-def test_model_sliding_window_under_ring_cp(seq_mesh):
-    """A sliding-window model trains under ring CP: full-model forward
-    parity vs the no-mesh forward, and ulysses stays refused."""
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_model_sliding_window_under_cp(seq_mesh, mode):
+    """A sliding-window (mistral-family) model trains under BOTH CP
+    modes: full-model forward parity vs the no-mesh forward. Ulysses
+    folds the window into the per-head-slice attention (r4 VERDICT
+    item 6 — previously refused)."""
     from dla_tpu.models.config import get_model_config
     from dla_tpu.models.transformer import Transformer
     from dla_tpu.parallel.sharding import sharding_tree
 
+    kv_heads = {"ring": None, "ulysses": 4}[mode]  # ulysses: seq | kv
+    kw = {"num_kv_heads": kv_heads} if kv_heads else {}
     cfg = get_model_config("tiny-gqa", sliding_window=6,
-                           context_parallel="ring")
+                           context_parallel=mode, **kw)
     model = Transformer(cfg)
     params = model.init(jax.random.key(0))
     rs = np.random.RandomState(3)
@@ -249,12 +254,6 @@ def test_model_sliding_window_under_ring_cp(seq_mesh):
         got = jax.jit(lambda p: model.apply(p, ids))(sharded)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-3, atol=2e-4)
-
-    cfg_u = get_model_config("tiny-gqa", sliding_window=6,
-                             context_parallel="ulysses")
-    with jax.sharding.set_mesh(seq_mesh):
-        with pytest.raises(NotImplementedError, match="ulysses"):
-            Transformer(cfg_u)
 
 
 @pytest.mark.parametrize("window", [1, 8, 9, 17, 32])
@@ -372,9 +371,12 @@ def test_ring_traced_window_parity(seq_mesh):
                                rtol=2e-5, atol=2e-6)
 
 
-def test_gemma2_model_under_ring_cp(seq_mesh):
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_gemma2_model_under_cp(seq_mesh, mode):
     """Full gemma-2 block stack (alternating window + softcaps + custom
-    scale) under ring CP == the no-mesh forward."""
+    scale) under BOTH CP modes == the no-mesh forward. Under ulysses the
+    traced per-layer window rides the shard_map as a replicated scalar
+    and masks on the gathered global positions (r4 VERDICT item 6)."""
     import dataclasses
 
     from dla_tpu.models.config import get_model_config
@@ -382,11 +384,11 @@ def test_gemma2_model_under_ring_cp(seq_mesh):
     from dla_tpu.parallel.sharding import sharding_tree
 
     cfg = dataclasses.replace(
-        get_model_config("tiny-gqa"),
+        get_model_config("tiny-gqa", num_kv_heads=4),
         arch="gemma2", sliding_window=6, sliding_window_pattern=2,
         attn_logit_softcap=20.0, final_logit_softcap=10.0,
         query_pre_attn_scalar=8, tie_embeddings=True,
-        context_parallel="ring")
+        context_parallel=mode)
     model = Transformer(cfg)
     params = model.init(jax.random.key(7))
     rs = np.random.RandomState(8)
@@ -399,3 +401,92 @@ def test_gemma2_model_under_ring_cp(seq_mesh):
         got = jax.jit(lambda p: model.apply(p, ids))(sharded)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-3, atol=2e-4)
+
+
+def test_ulysses_sliding_window_parity(seq_mesh):
+    """Op-level: ulysses with a static window == single-device windowed
+    attention, on BOTH backends — masked XLA (use_flash=False) and the
+    flash kernel (window by index == window by position on contiguous
+    rows). Window unaligned with the shard width."""
+    q, k, v, pos = _mk(h=8, kh=4, seed=31)
+    window = 11
+
+    want = causal_attention(q, k, v, q_positions=pos, kv_positions=pos,
+                            window=window)
+    with jax.sharding.set_mesh(seq_mesh):
+        for use_flash in (False, True):
+            got = jax.jit(lambda q, k, v, f=use_flash:
+                          ulysses_causal_attention(
+                              q, k, v, q_positions=pos, kv_positions=pos,
+                              window=window, use_flash=f))(q, k, v)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+                err_msg=f"use_flash={use_flash}")
+
+
+def test_ulysses_window_gradient_parity(seq_mesh):
+    """Training through windowed ulysses: gradient parity vs the XLA
+    windowed path (the all-to-alls and gathers transpose cleanly)."""
+    q, k, v, pos = _mk(h=8, kh=4, seed=32)
+    window = 9
+
+    def uly(q, k, v):
+        return ulysses_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos, window=window)
+
+    def xla(q, k, v):
+        return causal_attention(q, k, v, q_positions=pos,
+                                kv_positions=pos, window=window)
+
+    with jax.sharding.set_mesh(seq_mesh):
+        gu = jax.jit(jax.grad(lambda *a: jnp.sum(uly(*a) ** 2),
+                              argnums=(0, 1, 2)))(q, k, v)
+    gx = jax.grad(lambda *a: jnp.sum(xla(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_ulysses_softcap_scale_traced_window_parity(seq_mesh):
+    """gemma-2 numerics under ulysses: softcapping + non-default scale +
+    a TRACED window scalar (the per-layer alternating-SWA mechanism)
+    must match the XLA path exactly."""
+    q, k, v, pos = _mk(h=8, kh=4, seed=33)
+
+    want = causal_attention(q, k, v, q_positions=pos, kv_positions=pos,
+                            softmax_scale=8 ** -0.5, logit_softcap=5.0,
+                            window=7)
+    with jax.sharding.set_mesh(seq_mesh):
+        got = jax.jit(lambda w: ulysses_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos,
+            softmax_scale=8 ** -0.5, logit_softcap=5.0, window=w)
+        )(jnp.int32(7))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_gapped_window_no_flash(seq_mesh):
+    """Gapped positions (cumsum recipe) with a window: contiguous=False
+    must drop the static window off the index-based flash kernel and
+    mask on gathered global positions instead — exactness where
+    index-window math would be wrong."""
+    q, k, v, _ = _mk(h=8, kh=4, seed=34)
+    b, t = q.shape[0], q.shape[1]
+    mask = np.ones((b, t), np.int32)
+    mask[:, 4:24] = 0  # a 20-token hole spanning whole shards
+    valid = jnp.asarray(mask)
+    pos = jnp.cumsum(valid, axis=1) - 1
+    window = 8
+
+    win_mask = ((pos[:, :, None] - pos[:, None, :]) < window)
+    ref = causal_attention(
+        q, k, v, q_positions=pos, kv_positions=pos,
+        kv_segment_mask=(valid[:, None, :].astype(bool)
+                         & jnp.broadcast_to(win_mask, (b, t, t))))
+    with jax.sharding.set_mesh(seq_mesh):
+        out = jax.jit(lambda q, k, v: ulysses_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos, kv_valid=valid,
+            window=window, contiguous=False, use_flash=True))(q, k, v)
+    err = np.abs(np.asarray(out) - np.asarray(ref))
+    assert err[np.asarray(valid).astype(bool)].max() < 2e-5
