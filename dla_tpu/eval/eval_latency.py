@@ -37,6 +37,22 @@ def parse_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
+def _sync(out) -> None:
+    """Force completion of every computation ``out`` depends on.
+
+    ``block_until_ready`` alone is NOT sufficient on remote/tunneled
+    backends (axon): the r5 on-chip decode sweep measured 0.007 ms/token
+    (~100x under the HBM roofline, with negative prefill-subtracted
+    times) because buffers reported ready before remote execution
+    finished. A literal one-element device->host fetch cannot return
+    early; one leaf suffices — all outputs of a jitted call materialize
+    with its single XLA executable."""
+    jax.block_until_ready(out)
+    leaves = jax.tree.leaves(out)
+    if leaves:
+        np.asarray(leaves[0][(0,) * leaves[0].ndim])
+
+
 def measure_forward(model, params, batch_sizes: List[int],
                     seq_lengths: List[int], warmup: int, steps: int
                     ) -> List[Dict[str, float]]:
@@ -51,11 +67,19 @@ def measure_forward(model, params, batch_sizes: List[int],
                 rs.randint(0, model.cfg.vocab_size - 1, (b, s)), jnp.int32)
             mask = jnp.ones((b, s), jnp.int32)
             for _ in range(warmup):
-                fwd(params, ids, mask).block_until_ready()
+                _sync(fwd(params, ids, mask))
+            # dispatch the whole loop, then sync each step's output:
+            # steps still pipeline on-device when the backend is sane,
+            # and a lazy backend is forced to execute every step (not
+            # just the last one it happens to fetch)
             t0 = time.perf_counter()
-            for _ in range(steps):
-                out = fwd(params, ids, mask)
-            out.block_until_ready()
+            outs = [fwd(params, ids, mask) for _ in range(steps)]
+            for i in range(steps):
+                # drop each reference as it syncs: retaining all
+                # [B, S, V] logits buffers would multiply peak HBM
+                # by `steps`
+                _sync(outs[i])
+                outs[i] = None
             dt = time.perf_counter() - t0
             tokens = b * s * steps
             rows.append({
@@ -84,12 +108,12 @@ def measure_decode(model, params, batch_size: int, prompt_len: int,
         jnp.int32)
     mask = jnp.ones((batch_size, prompt_len), jnp.int32)
     for _ in range(warmup):
-        jax.tree.map(lambda x: x.block_until_ready(),
-                     fn(params, ids, mask, jax.random.key(0)))
+        _sync(fn(params, ids, mask, jax.random.key(0)))
     t0 = time.perf_counter()
+    outs = [fn(params, ids, mask, jax.random.key(r)) for r in range(reps)]
     for r in range(reps):
-        out = fn(params, ids, mask, jax.random.key(r))
-    jax.tree.map(lambda x: x.block_until_ready(), out)
+        _sync(outs[r])
+        outs[r] = None
     dt = time.perf_counter() - t0
     total_new = batch_size * new_tokens * reps
     return {

@@ -403,6 +403,32 @@ def test_gemma2_model_under_cp(seq_mesh, mode):
                                rtol=2e-3, atol=2e-4)
 
 
+def test_ring_vs_chunked_bf16_tolerance(seq_mesh):
+    """Pin the bf16 numerics drift between ring and chunked attention at
+    representative T (ADVICE r4): ring casts softmax weights to the
+    value dtype before the value einsum (ring_attention.py ~:96, the
+    flash kernel's convention) while keeping fp32 online-softmax
+    accumulators. If a future change regresses the accumulators to bf16
+    (or otherwise loosens long-T numerics), the drift blows through this
+    bound and the change is caught here instead of in training curves."""
+    from dla_tpu.ops.attention import chunked_causal_attention
+
+    b, t, h, kh, d = 2, 512, 4, 2, 64
+    rs = np.random.RandomState(11)
+    q = jnp.asarray(rs.randn(b, t, h, d), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, t, kh, d), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, t, kh, d), jnp.bfloat16)
+    pos = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+
+    want = chunked_causal_attention(q, k, v, q_positions=pos,
+                                    kv_positions=pos, q_chunk=128)
+    with jax.sharding.set_mesh(seq_mesh):
+        got = jax.jit(lambda q, k, v: ring_causal_attention(
+            q, k, v, q_positions=pos, kv_positions=pos))(q, k, v)
+    err = np.abs(np.asarray(got, np.float32) - np.asarray(want, np.float32))
+    assert err.max() < 1.6e-2, f"ring vs chunked bf16 drift: {err.max()}"
+
+
 def test_ulysses_sliding_window_parity(seq_mesh):
     """Op-level: ulysses with a static window == single-device windowed
     attention, on BOTH backends — masked XLA (use_flash=False) and the
