@@ -73,7 +73,10 @@ class PackedInstructionDataset:
             lengths_l.append(min(int(ex["input_ids"].shape[0]), max_length))
             if not lazy:
                 if int(ex["input_ids"].shape[0]) > max_length:
-                    ex = {k: v[:max_length] for k, v in ex.items()}
+                    # 0-d extras (TeacherRolloutDataset's reward) pass
+                    # through untouched
+                    ex = {k: v[:max_length] if getattr(v, "ndim", 1) else v
+                          for k, v in ex.items()}
                 self._examples.append(ex)
         self.lengths = np.asarray(lengths_l, np.int32)
         assign, n_rows = self._place(self.lengths)
@@ -87,7 +90,8 @@ class PackedInstructionDataset:
             return self._examples[i]
         ex = self.base[i]
         if int(ex["input_ids"].shape[0]) > self.max_length:
-            ex = {k: v[: self.max_length] for k, v in ex.items()}
+            ex = {k: v[: self.max_length] if getattr(v, "ndim", 1) else v
+                  for k, v in ex.items()}
         return ex
 
     def _place(self, lengths: np.ndarray):
@@ -139,3 +143,174 @@ class PackedInstructionDataset:
         """Fraction of token slots holding real tokens (1.0 = perfect)."""
         total = len(self.rows) * self.max_length
         return int(self.lengths.sum()) / max(total, 1)
+
+
+class PackedTeacherDataset(PackedInstructionDataset):
+    """Packing for distillation rows (TeacherRolloutDataset): identical
+    segment machinery, plus the per-example scalar ``reward`` carried as
+    a token-weighted row mean. The trainer re-weights its reward_mean
+    metric by row token counts for packed batches (train_distill.py), so
+    the logged value is the corpus TOKEN-weighted reward mean — exact
+    under any row/batch split, unlike a mean of per-row means over
+    unevenly filled rows. Extends the SFT-only scope of the reference's
+    dead ``packing`` key (config/sft_config.yaml:16) to phase 4."""
+
+    def __init__(self, base, max_length: int, lazy: bool = True):
+        super().__init__(base, max_length, lazy=lazy)
+        # one extra scalar per example — cheap even for lazy mode when
+        # the base caches records (tokenization is NOT repeated: rewards
+        # come from the raw records, not the encoded arrays)
+        self.rewards = np.asarray(
+            [float(base.records[i].get("reward", 1.0))
+             for i in range(len(base))], np.float32)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        row = super().__getitem__(idx)
+        ex_idx = self.rows[idx]
+        w = self.lengths[ex_idx].astype(np.float32)
+        r = self.rewards[ex_idx]
+        row["reward"] = np.asarray(
+            float((w * r).sum() / max(w.sum(), 1.0)), np.float32)
+        return row
+
+
+class PackedPreferenceDataset:
+    """Greedy joint first-fit packing of preference PAIRS: pair i goes
+    into row r only if BOTH its chosen sequence fits r's chosen row and
+    its rejected sequence fits r's rejected row — so segment j of a
+    chosen row is always the partner of segment j of the same rejected
+    row, and the DPO/reward pair algebra needs no index plumbing beyond
+    the shared (row, segment) coordinate. Segments are numbered from 1
+    per row (0 = padding), matching PackedInstructionDataset.
+
+    The joint two-sided constraint is why this does not reuse the
+    native single-length packer (dla_pack_ffd): placement must check
+    both fills at once. The greedy loop is O(pairs * open_rows) in
+    Python at init time — dataset sizes for preference phases are far
+    below the SFT corpora the native packer exists for.
+
+    Batch items:
+      chosen / rejected: {input_ids, attention_mask, labels,
+                          segment_ids} [L] each
+      pair_mask: [max_pairs] 1.0 for real pairs (segment j+1 exists)
+    """
+
+    CLOSE_MARGIN = 8
+
+    def __init__(self, base, max_length: int, lazy: bool = True):
+        self.max_length = max_length
+        self.pad_token_id = base.tokenizer.pad_token_id
+        self.base = base
+        self.lazy = lazy
+        self._examples: List[Dict[str, Dict[str, np.ndarray]]] = []
+        len_c, len_r = [], []
+        for i in range(len(base)):
+            ex = base[i]
+            len_c.append(min(int(ex["chosen"]["input_ids"].shape[0]),
+                             max_length))
+            len_r.append(min(int(ex["rejected"]["input_ids"].shape[0]),
+                             max_length))
+            if not lazy:
+                self._examples.append(self._truncate(ex))
+        self.len_c = np.asarray(len_c, np.int32)
+        self.len_r = np.asarray(len_r, np.int32)
+
+        rows: List[List[int]] = []
+        fill_c: List[int] = []
+        fill_r: List[int] = []
+        open_rows: List[int] = []
+        for i in range(len(base)):
+            lc, lr = int(self.len_c[i]), int(self.len_r[i])
+            placed = False
+            for r in open_rows:
+                if (fill_c[r] + lc <= max_length
+                        and fill_r[r] + lr <= max_length):
+                    rows[r].append(i)
+                    fill_c[r] += lc
+                    fill_r[r] += lr
+                    placed = True
+                    break
+            if not placed:
+                rows.append([i])
+                fill_c.append(lc)
+                fill_r.append(lr)
+                open_rows.append(len(rows) - 1)
+            open_rows = [
+                r for r in open_rows
+                if (fill_c[r] + self.CLOSE_MARGIN <= max_length
+                    and fill_r[r] + self.CLOSE_MARGIN <= max_length)]
+        self.rows = rows
+        self.max_pairs = max(len(r) for r in rows) if rows else 1
+
+    def _truncate(self, ex):
+        L = self.max_length
+        return {side: {k: v[:L] for k, v in ex[side].items()}
+                for side in ("chosen", "rejected")}
+
+    def _example(self, i: int):
+        if not self.lazy:
+            return self._examples[i]
+        return self._truncate(self.base[i])
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def _pack_side(self, exs: Sequence[Dict[str, np.ndarray]]):
+        L = self.max_length
+        out = {
+            "input_ids": np.full(L, self.pad_token_id, np.int32),
+            "labels": np.full(L, IGNORE_INDEX, np.int32),
+            "attention_mask": np.zeros(L, np.int32),
+            "segment_ids": np.zeros(L, np.int32),
+        }
+        pos = 0
+        for si, ex in enumerate(exs, start=1):
+            n = ex["input_ids"].shape[0]
+            out["input_ids"][pos:pos + n] = ex["input_ids"]
+            out["labels"][pos:pos + n] = ex["labels"]
+            out["labels"][pos] = IGNORE_INDEX   # next-token shift guard
+            out["attention_mask"][pos:pos + n] = 1
+            out["segment_ids"][pos:pos + n] = si
+            pos += n
+        return out
+
+    def __getitem__(self, idx: int) -> Dict[str, Dict[str, np.ndarray]]:
+        exs = [self._example(i) for i in self.rows[idx]]
+        pair_mask = np.zeros(self.max_pairs, np.float32)
+        pair_mask[:len(exs)] = 1.0
+        return {
+            "chosen": self._pack_side([e["chosen"] for e in exs]),
+            "rejected": self._pack_side([e["rejected"] for e in exs]),
+            "pair_mask": pair_mask,
+        }
+
+    def collate(self, batch):
+        out = {
+            side: {k: np.stack([ex[side][k] for ex in batch])
+                   for k in batch[0][side]}
+            for side in ("chosen", "rejected")
+        }
+        out["pair_mask"] = np.stack([ex["pair_mask"] for ex in batch])
+        return out
+
+    def packing_efficiency(self) -> float:
+        total = 2 * len(self.rows) * self.max_length
+        return ((int(self.len_c.sum()) + int(self.len_r.sum()))
+                / max(total, 1))
+
+
+def pack_preference_splits(train_ds, eval_ds, max_length: int):
+    """Wrap train/eval preference splits for packing with ONE shared
+    static pair width (the jitted loss closes over a single n_segments;
+    both splits pad their pair_mask to the wider). Returns
+    (packed_train, packed_eval_or_None, n_segments) — the shared setup
+    for train_dpo and train_reward."""
+    train_p = PackedPreferenceDataset(train_ds, max_length)
+    eval_p = (PackedPreferenceDataset(eval_ds, max_length)
+              if eval_ds is not None else None)
+    n = max([train_p.max_pairs]
+            + ([eval_p.max_pairs] if eval_p is not None else []))
+    train_p.max_pairs = n
+    if eval_p is not None:
+        eval_p.max_pairs = n
+    return train_p, eval_p, n

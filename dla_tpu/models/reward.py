@@ -72,20 +72,46 @@ class RewardModel:
               attention_mask: jnp.ndarray,
               dropout_rng: Optional[jax.Array] = None,
               lora: Optional[Params] = None,
-              with_aux: bool = False):
+              with_aux: bool = False,
+              segment_ids: Optional[jnp.ndarray] = None,
+              n_segments: int = 0):
         """[B, T] -> [B] scalar rewards (fp32). ``dropout_rng`` drives
         both the pooled-feature dropout and (split) LoRA dropout.
         ``with_aux`` additionally returns the backbone's MoE aux tuple
         (None for dense backbones) so the pairwise-loss trainer can
-        regularize the router."""
+        regularize the router.
+
+        With ``segment_ids`` + static ``n_segments`` (packed preference
+        rows, data/packing.py — segments numbered from 1), pooling runs
+        PER SEGMENT and the result is [B, n_segments] — each segment
+        pools exactly as it would as a standalone row (the backbone
+        masks cross-segment attention and restarts positions), so
+        packed rewards equal unpacked rewards. Absent segments read 0
+        and must be dropped by the caller's pair mask."""
         lora_rng = None
         if dropout_rng is not None and lora is not None:
             dropout_rng, lora_rng = jax.random.split(dropout_rng)
         h, moe_aux = self.backbone.hidden_states_with_aux(
-            params, input_ids, attention_mask,
+            params, input_ids, attention_mask, segment_ids=segment_ids,
             lora=lora, dropout_rng=lora_rng)
         mask = attention_mask.astype(jnp.float32)
-        if self.pooling == "last_token":
+        if segment_ids is not None:
+            if not n_segments:
+                raise ValueError("segment_ids needs a static n_segments")
+            oh = (segment_ids[:, :, None]
+                  == jnp.arange(1, n_segments + 1)[None, None, :]
+                  ).astype(jnp.float32) * mask[:, :, None]  # [B, T, S]
+            if self.pooling == "last_token":
+                t_idx = jnp.arange(h.shape[1])[None, :, None]
+                # rows are contiguous per segment: last real token of
+                # segment s = max index where oh is on (0 if absent)
+                idx = jnp.max(jnp.where(oh > 0, t_idx, -1), axis=1)
+                pooled = jnp.take_along_axis(
+                    h, jnp.maximum(idx, 0)[:, :, None], axis=1)  # [B,S,D]
+            else:
+                pooled = jnp.einsum("btd,bts->bsd", h, oh) / (
+                    jnp.sum(oh, axis=1)[..., None] + 1e-8)
+        elif self.pooling == "last_token":
             idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
             pooled = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
         else:
@@ -98,7 +124,7 @@ class RewardModel:
             pooled = jnp.where(keep, pooled / (1.0 - self.dropout), 0.0)
         head = params["reward_head"]
         rewards = (pooled @ head["w"].astype(jnp.float32)
-                   + head["b"].astype(jnp.float32))[:, 0]
+                   + head["b"].astype(jnp.float32))[..., 0]
         return (rewards, moe_aux) if with_aux else rewards
 
     __call__ = apply

@@ -187,6 +187,61 @@ def model_fused_sequence_logprob(model, params, input_ids, attention_mask,
     return (logp, moe_aux) if with_aux else logp
 
 
+def model_fused_segment_logprob(model, params, sub, n_segments: int,
+                                lora=None, dropout_rng=None,
+                                chunk: int = DEFAULT_CHUNK,
+                                with_aux: bool = False):
+    """Per-SEGMENT mean-token logp for a packed batch, [B, n_segments]
+    fp32 — the packed-row counterpart of model_fused_sequence_logprob
+    (``data.packing: true`` for the preference phases; generalizes the
+    reference's SFT-scoped dead key config/sft_config.yaml:16). ``sub``
+    is one side of a packed preference batch: input_ids /
+    attention_mask / segment_ids, segments numbered from 1
+    (data/packing.py convention, 0 = padding)."""
+    h, moe_aux = model.hidden_states_with_aux(
+        params, sub["input_ids"], attention_mask=sub["attention_mask"],
+        segment_ids=sub["segment_ids"], lora=lora, dropout_rng=dropout_rng)
+    w, bias = model.unembed_params(params)
+    logp = fused_segment_logprob_mean(
+        h, w, sub["input_ids"], sub["attention_mask"], sub["segment_ids"],
+        n_segments, bias=bias, chunk=chunk,
+        softcap=model.cfg.final_logit_softcap)
+    return (logp, moe_aux) if with_aux else logp
+
+
+def fused_segment_logprob_mean(
+    hidden: jnp.ndarray,          # [B, T, D]
+    w: jnp.ndarray,               # [D, V]
+    input_ids: jnp.ndarray,       # [B, T]
+    mask: jnp.ndarray,            # [B, T] 1 = real token
+    segment_ids: jnp.ndarray,     # [B, T] packed ids, 1-based (0 = pad)
+    n_segments: int,              # static max segments per row
+    bias: Optional[jnp.ndarray] = None,
+    chunk: int = DEFAULT_CHUNK,
+    softcap: float = 0.0,
+) -> jnp.ndarray:
+    """Length-normalized mean per-token logp PER SEGMENT, [B, n_segments]
+    fp32. Equals fused_sequence_logprob_mean run on each segment as a
+    standalone row (positions restart per segment in the model, so the
+    hidden states already match). Cross-segment next-token pairs are
+    excluded the same way packing masks the first label of each segment;
+    absent segments (j >= the row's segment count) return 0."""
+    targets = input_ids[:, 1:]
+    seg_t = segment_ids[:, 1:]
+    # a target belongs to its own segment, and its predicting hidden
+    # state must sit in the SAME segment (drop first-token-of-segment)
+    m = (mask[:, 1:].astype(jnp.float32)
+         * (seg_t == segment_ids[:, :-1]) * (seg_t > 0))
+    logp = fused_token_logprobs(hidden[:, :-1, :], w, targets, bias,
+                                chunk, softcap)            # [B, T-1]
+    oh = (seg_t[:, :, None]
+          == jnp.arange(1, n_segments + 1)[None, None, :]
+          ).astype(jnp.float32)                            # [B, T-1, S]
+    num = jnp.einsum("bt,bts->bs", logp * m, oh)
+    den = jnp.einsum("bt,bts->bs", m, oh)
+    return num / (den + 1e-8)
+
+
 def fused_token_logprobs(
     hidden: jnp.ndarray,          # [B, T, D] (activation dtype)
     w: jnp.ndarray,               # [D, V] unembedding, activation dtype

@@ -16,8 +16,13 @@ import jax.numpy as jnp
 IGNORE_INDEX = -100  # reference label-mask convention (src/data/datasets.py:66-75)
 
 
-def masked_mean(x: jnp.ndarray, mask: jnp.ndarray,
+def masked_mean(x: jnp.ndarray, mask: Optional[jnp.ndarray],
                 axis=None, eps: float = 1e-8) -> jnp.ndarray:
+    """Mean of ``x`` weighted by ``mask``; None = plain mean. The one
+    weighting rule shared by the losses and the packed-path metrics
+    (pair_mask or None flow through the same call site)."""
+    if mask is None:
+        return jnp.mean(x, axis=axis)
     mask = mask.astype(jnp.float32)
     return jnp.sum(x * mask, axis=axis) / (jnp.sum(mask, axis=axis) + eps)
 
@@ -94,6 +99,7 @@ def dpo_loss(
     ref_rejected_logp: jnp.ndarray,
     beta: float,
     label_smoothing: float = 0.0,
+    valid: jnp.ndarray = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Direct Preference Optimization loss over per-sequence logps.
 
@@ -102,6 +108,10 @@ def dpo_loss(
     ``label_smoothing`` implements the conservative-DPO variant the
     reference declares in config (dpo_config.yaml:9) but never wires
     (SURVEY.md sec 2.5) — here it is functional; 0.0 reproduces reference.
+
+    ``valid`` (same shape as the logps) weights the mean — the packed
+    preference path passes its [B, n_segments] pair mask so absent
+    segments drop out; None keeps the reference's plain mean.
 
     Returns (loss, margin) where margin = beta * (logits difference), used
     for the preference_rate metric (train_dpo.py:130-132).
@@ -112,20 +122,23 @@ def dpo_loss(
     pos = -jax.nn.log_sigmoid(margin)
     if label_smoothing:
         neg = -jax.nn.log_sigmoid(-margin)
-        loss = jnp.mean((1 - label_smoothing) * pos + label_smoothing * neg)
+        per = (1 - label_smoothing) * pos + label_smoothing * neg
     else:
-        loss = jnp.mean(pos)
+        per = pos
+    loss = masked_mean(per, valid)
     return loss, margin
 
 
 def pairwise_reward_loss(chosen_rewards: jnp.ndarray,
-                         rejected_rewards: jnp.ndarray) -> jnp.ndarray:
+                         rejected_rewards: jnp.ndarray,
+                         valid: jnp.ndarray = None) -> jnp.ndarray:
     """Bradley-Terry pairwise ranking loss.
 
     Reference math (src/models/reward_model.py:67-68):
       -logsigmoid(chosen - rejected).mean()
-    """
-    return -jnp.mean(jax.nn.log_sigmoid(chosen_rewards - rejected_rewards))
+    ``valid`` weights the mean over real pairs (packed batches)."""
+    return masked_mean(
+        -jax.nn.log_sigmoid(chosen_rewards - rejected_rewards), valid)
 
 
 def reinforce_loss(

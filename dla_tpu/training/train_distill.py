@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from dla_tpu.data.iterator import ShardedBatchIterator
 from dla_tpu.data.loaders import build_teacher_dataset
+from dla_tpu.data.packing import PackedTeacherDataset
 from dla_tpu.ops.fused_ce import (
     fused_cross_entropy_loss,
     fused_kl_distill_loss,
@@ -55,33 +56,55 @@ def make_distill_loss(student_model, teacher_models: List[Any],
     # neither the student's logits nor any teacher's probabilities are
     # materialized at [B, T, V].
     def loss_fn(params, frozen, batch, rng):
+        seg = batch.get("segment_ids")   # packed rows (data.packing)
         if lora:
             base = frozen["student_base"]
             h, moe_aux = student_model.hidden_states_with_aux(
                 base, batch["input_ids"],
-                attention_mask=batch["attention_mask"],
+                attention_mask=batch["attention_mask"], segment_ids=seg,
                 lora=params, dropout_rng=rng if train else None)
         else:
             del rng
             base = params
             h, moe_aux = student_model.hidden_states_with_aux(
                 params, batch["input_ids"],
-                attention_mask=batch["attention_mask"])
+                attention_mask=batch["attention_mask"], segment_ids=seg)
         sw, sbias = student_model.unembed_params(base)
-        metrics = {"reward_mean": jnp.mean(batch["reward"])}
+        if seg is None:
+            reward_mean = jnp.mean(batch["reward"])
+        else:
+            # packed rows carry token-weighted row means; re-weighting
+            # by row fill makes this the corpus token-weighted mean —
+            # exact under any packing (mean-of-row-means is not: FFD
+            # leaves unevenly filled tail rows)
+            w = jnp.sum(batch["attention_mask"], axis=1).astype(jnp.float32)
+            reward_mean = jnp.sum(batch["reward"] * w) / (jnp.sum(w) + 1e-8)
+        metrics = {"reward_mean": reward_mean}
         if use_kl and teacher_models:
             t_hiddens, t_ws, t_biases = [], [], []
             for i, tm in enumerate(teacher_models):
                 tp = frozen[f"teacher_{i}"]
                 t_hiddens.append(jax.lax.stop_gradient(tm.hidden_states(
                     tp, batch["input_ids"],
-                    attention_mask=batch["attention_mask"])))
+                    attention_mask=batch["attention_mask"],
+                    segment_ids=seg)))
                 tw, tb = tm.unembed_params(tp)
                 t_ws.append(jax.lax.stop_gradient(tw))
                 t_biases.append(None if tb is None
                                 else jax.lax.stop_gradient(tb))
+            kl_mask = batch["attention_mask"]
+            if seg is not None:
+                # a packed segment's FIRST token is the next-token
+                # target of the previous segment's last position — the
+                # same cross-segment pair the packer's label IGNORE
+                # kills on the CE path (data/packing.py)
+                start = jnp.concatenate(
+                    [jnp.ones_like(seg[:, :1]),
+                     (seg[:, 1:] != seg[:, :-1]).astype(seg.dtype)],
+                    axis=1)
+                kl_mask = kl_mask * (1 - start)
             loss = fused_kl_distill_loss(
-                h, sw, t_hiddens, t_ws, batch["attention_mask"],
+                h, sw, t_hiddens, t_ws, kl_mask,
                 temperature, student_bias=sbias, teacher_biases=t_biases,
                 student_softcap=student_model.cfg.final_logit_softcap,
                 teacher_softcaps=[tm.cfg.final_logit_softcap
@@ -164,6 +187,12 @@ def main(argv=None) -> None:
         data_cfg = {**config.get("data", {}),
                     "max_seq_length": student.config.max_seq_length}
         train_ds = build_teacher_dataset(data_cfg, student.tokenizer)
+        if data_cfg.get("packing"):
+            train_ds = PackedTeacherDataset(
+                train_ds, student.config.max_seq_length)
+            log_rank_zero(
+                f"[dla_tpu] packing: {len(train_ds)} rows, "
+                f"{train_ds.packing_efficiency():.1%} token efficiency")
         train_it = ShardedBatchIterator(
             train_ds, trainer.global_batch,
             seed=int(config.get("seed", 0)),

@@ -21,11 +21,13 @@ import jax.numpy as jnp
 
 from dla_tpu.data.iterator import ShardedBatchIterator
 from dla_tpu.data.loaders import build_preference_dataset
+from dla_tpu.data.packing import pack_preference_splits
 from dla_tpu.ops.fused_ce import (
+    model_fused_segment_logprob,
     model_fused_sequence_logprob,
     weighted_moe_aux,
 )
-from dla_tpu.ops.losses import dpo_loss
+from dla_tpu.ops.losses import dpo_loss, masked_mean
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
@@ -37,15 +39,25 @@ from dla_tpu.training.model_io import (
 )
 from dla_tpu.training.trainer import Trainer
 from dla_tpu.training.utils import seed_everything
+from dla_tpu.utils.logging import log_rank_zero
 
 
 def make_dpo_loss(policy_model, ref_model, beta: float,
                   label_smoothing: float = 0.0, lora: bool = False,
-                  train: bool = True):
+                  train: bool = True, n_segments: int = 0):
+    """``n_segments > 0`` selects the PACKED preference path
+    (data.packing: true): per-(row, segment) logps [B, n_segments] with
+    the batch's pair_mask weighting the pair mean — segment j of a
+    chosen row is the partner of segment j of the rejected row by the
+    joint placement in data/packing.py PackedPreferenceDataset."""
     def seq_logp(model, params, sub, adapters=None, rng=None,
                  with_aux=False):
         # fused hidden @ unembed + gather: no [B, T, V] materialization
         # in any of the four forwards (cf. reference train_dpo.py:36)
+        if n_segments:
+            return model_fused_segment_logprob(
+                model, params, sub, n_segments,
+                lora=adapters, dropout_rng=rng, with_aux=with_aux)
         return model_fused_sequence_logprob(
             model, params, sub["input_ids"], sub["attention_mask"],
             lora=adapters, dropout_rng=rng, with_aux=with_aux)
@@ -76,15 +88,17 @@ def make_dpo_loss(policy_model, ref_model, beta: float,
             seq_logp(ref_model, refp, batch["chosen"]))
         ref_r = jax.lax.stop_gradient(
             seq_logp(ref_model, refp, batch["rejected"]))
+        pv = batch.get("pair_mask") if n_segments else None
         loss, margin = dpo_loss(pi_c, pi_r, ref_c, ref_r,
-                                beta, label_smoothing)
+                                beta, label_smoothing, valid=pv)
         # MoE policies: router balance/z regularization on the two
         # with-grad forwards (0.0 for dense models)
         loss = loss + weighted_moe_aux(policy_model, aux_c, aux_r)
         return loss, {
-            "preference_rate": jnp.mean((margin > 0).astype(jnp.float32)),
-            "margin": jnp.mean(margin),
-            "policy_chosen_logp": jnp.mean(pi_c),
+            "preference_rate": masked_mean(
+                (margin > 0).astype(jnp.float32), pv),
+            "margin": masked_mean(margin, pv),
+            "policy_chosen_logp": masked_mean(pi_c, pv),
         }
     return loss_fn
 
@@ -99,6 +113,7 @@ def main(argv=None) -> None:
     model_cfg = config.get("model", {})
     beta = float(model_cfg.get("beta", 0.1))
     label_smoothing = float(model_cfg.get("label_smoothing", 0.0))
+    packing = bool(config.get("data", {}).get("packing"))
 
     with jax.sharding.set_mesh(mesh):
         policy = load_causal_lm(
@@ -110,6 +125,23 @@ def main(argv=None) -> None:
             ref = load_causal_lm(ref_name, model_cfg, rng)
         else:
             ref = policy  # same weights as starting policy (frozen copy)
+
+        data_cfg = {**config.get("data", {}),
+                    "max_seq_length": policy.config.max_seq_length}
+        train_ds = build_preference_dataset(data_cfg, policy.tokenizer, "train")
+        has_eval = (data_cfg.get("eval_path")
+                    if data_cfg.get("source", "local") == "local"
+                    else data_cfg.get("eval_split"))
+        eval_ds = (build_preference_dataset(data_cfg, policy.tokenizer, "eval")
+                   if has_eval else None)
+        n_segments = 0
+        if packing:
+            train_ds, eval_ds, n_segments = pack_preference_splits(
+                train_ds, eval_ds, policy.config.max_seq_length)
+            log_rank_zero(
+                f"[dla_tpu] packing: {len(train_ds)} pair-rows, "
+                f"{train_ds.packing_efficiency():.1%} token efficiency, "
+                f"<= {n_segments} pairs/row")
 
         use_lora = policy.config.lora_r > 0
         if use_lora:
@@ -126,23 +158,22 @@ def main(argv=None) -> None:
             trainer = Trainer(
                 config=config, mesh=mesh,
                 loss_fn=make_dpo_loss(policy.model, ref.model, beta,
-                                      label_smoothing, lora=True),
+                                      label_smoothing, lora=True,
+                                      n_segments=n_segments),
                 eval_fn=make_dpo_loss(policy.model, ref.model, beta,
                                       label_smoothing, lora=True,
-                                      train=False),
+                                      train=False, n_segments=n_segments),
                 params=adapters, param_specs=lora_specs,
                 frozen=frozen, frozen_specs=frozen_specs)
         else:
             trainer = Trainer(
                 config=config, mesh=mesh,
                 loss_fn=make_dpo_loss(policy.model, ref.model, beta,
-                                      label_smoothing),
+                                      label_smoothing,
+                                      n_segments=n_segments),
                 params=policy.params, param_specs=policy.specs,
                 frozen=ref.params, frozen_specs=ref.specs)
 
-        data_cfg = {**config.get("data", {}),
-                    "max_seq_length": policy.config.max_seq_length}
-        train_ds = build_preference_dataset(data_cfg, policy.tokenizer, "train")
         train_it = ShardedBatchIterator(
             train_ds, trainer.global_batch,
             seed=int(config.get("seed", 0)),
@@ -150,11 +181,7 @@ def main(argv=None) -> None:
             process_count=jax.process_count())
 
         eval_iter_fn = None
-        has_eval = (data_cfg.get("eval_path")
-                    if data_cfg.get("source", "local") == "local"
-                    else data_cfg.get("eval_split"))
-        if has_eval:
-            eval_ds = build_preference_dataset(data_cfg, policy.tokenizer, "eval")
+        if eval_ds is not None:
             micro_global = trainer.micro * trainer.dp
 
             def eval_iter_fn():

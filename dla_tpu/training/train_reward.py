@@ -20,8 +20,9 @@ import jax.numpy as jnp
 
 from dla_tpu.data.iterator import ShardedBatchIterator
 from dla_tpu.data.loaders import build_preference_dataset
+from dla_tpu.data.packing import pack_preference_splits
 from dla_tpu.ops.fused_ce import weighted_moe_aux
-from dla_tpu.ops.losses import pairwise_reward_loss
+from dla_tpu.ops.losses import masked_mean, pairwise_reward_loss
 from dla_tpu.parallel.dist import initialize_distributed
 from dla_tpu.parallel.mesh import mesh_from_config
 from dla_tpu.training.config import config_from_args, make_arg_parser
@@ -32,9 +33,22 @@ from dla_tpu.training.model_io import (
     save_merged_lora_final,
 )
 from dla_tpu.training.trainer import Trainer
+from dla_tpu.utils.logging import log_rank_zero
 
 
-def make_reward_loss(model, lora: bool = False):
+def _side_kwargs(batch, side: str, n_segments: int):
+    """Model.apply kwargs for one side of a (possibly packed) batch."""
+    sub = batch[side]
+    kw = {}
+    if n_segments:
+        kw = {"segment_ids": sub["segment_ids"], "n_segments": n_segments}
+    return sub["input_ids"], sub["attention_mask"], kw
+
+
+def make_reward_loss(model, lora: bool = False, n_segments: int = 0):
+    """``n_segments > 0``: packed preference rows — rewards pool per
+    segment ([B, n_segments]) and the pair mean is pair_mask-weighted
+    (data/packing.py PackedPreferenceDataset)."""
     def loss_fn(params, frozen, batch, rng):
         if lora:
             # trainable = backbone adapters + the (tiny, full-rank)
@@ -45,24 +59,23 @@ def make_reward_loss(model, lora: bool = False):
             del frozen
             full, adapters = params, None
         drng = jax.random.split(rng, 2)
-        chosen, aux_c = model.apply(
-            full, batch["chosen"]["input_ids"],
-            batch["chosen"]["attention_mask"], dropout_rng=drng[0],
-            lora=adapters, with_aux=True)
-        rejected, aux_r = model.apply(
-            full, batch["rejected"]["input_ids"],
-            batch["rejected"]["attention_mask"], dropout_rng=drng[1],
-            lora=adapters, with_aux=True)
-        loss = pairwise_reward_loss(chosen, rejected)
+        ids_c, m_c, kw = _side_kwargs(batch, "chosen", n_segments)
+        ids_r, m_r, kw_r = _side_kwargs(batch, "rejected", n_segments)
+        chosen, aux_c = model.apply(full, ids_c, m_c, dropout_rng=drng[0],
+                                    lora=adapters, with_aux=True, **kw)
+        rejected, aux_r = model.apply(full, ids_r, m_r, dropout_rng=drng[1],
+                                      lora=adapters, with_aux=True, **kw_r)
+        pv = batch.get("pair_mask") if n_segments else None
+        loss = pairwise_reward_loss(chosen, rejected, valid=pv)
         # MoE backbones: router regularization on both with-grad forwards
         loss = loss + weighted_moe_aux(model, aux_c, aux_r)
-        acc = jnp.mean((chosen > rejected).astype(jnp.float32))
-        return loss, {"acc": acc,
-                      "reward_margin": jnp.mean(chosen - rejected)}
+        return loss, {
+            "acc": masked_mean((chosen > rejected).astype(jnp.float32), pv),
+            "reward_margin": masked_mean(chosen - rejected, pv)}
     return loss_fn
 
 
-def make_reward_eval(model, lora: bool = False):
+def make_reward_eval(model, lora: bool = False, n_segments: int = 0):
     def eval_fn(params, frozen, batch, rng):
         del rng
         if lora:
@@ -71,15 +84,14 @@ def make_reward_eval(model, lora: bool = False):
         else:
             del frozen
             full, adapters = params, None
-        chosen = model.apply(full, batch["chosen"]["input_ids"],
-                             batch["chosen"]["attention_mask"],
-                             lora=adapters)
-        rejected = model.apply(full, batch["rejected"]["input_ids"],
-                               batch["rejected"]["attention_mask"],
-                               lora=adapters)
-        loss = pairwise_reward_loss(chosen, rejected)
-        acc = jnp.mean((chosen > rejected).astype(jnp.float32))
-        return loss, {"acc": acc}
+        ids_c, m_c, kw = _side_kwargs(batch, "chosen", n_segments)
+        ids_r, m_r, kw_r = _side_kwargs(batch, "rejected", n_segments)
+        chosen = model.apply(full, ids_c, m_c, lora=adapters, **kw)
+        rejected = model.apply(full, ids_r, m_r, lora=adapters, **kw_r)
+        pv = batch.get("pair_mask") if n_segments else None
+        loss = pairwise_reward_loss(chosen, rejected, valid=pv)
+        return loss, {"acc": masked_mean(
+            (chosen > rejected).astype(jnp.float32), pv)}
     return eval_fn
 
 
@@ -91,8 +103,27 @@ def main(argv=None) -> None:
     from dla_tpu.training.utils import seed_everything
     rng = seed_everything(int(config.get("seed", 0)))
 
+    packing = bool(config.get("data", {}).get("packing"))
     with jax.sharding.set_mesh(mesh):
         bundle = build_reward_model(config.get("model", {}), rng)
+
+        data_cfg = {**config.get("data", {}),
+                    "max_seq_length": bundle.config.max_seq_length}
+        train_ds = build_preference_dataset(data_cfg, bundle.tokenizer, "train")
+        has_eval = (data_cfg.get("eval_path")
+                    if data_cfg.get("source", "local") == "local"
+                    else data_cfg.get("eval_split"))
+        eval_ds = (build_preference_dataset(data_cfg, bundle.tokenizer, "eval")
+                   if has_eval else None)
+        n_segments = 0
+        if packing:
+            train_ds, eval_ds, n_segments = pack_preference_splits(
+                train_ds, eval_ds, bundle.config.max_seq_length)
+            log_rank_zero(
+                f"[dla_tpu] packing: {len(train_ds)} pair-rows, "
+                f"{train_ds.packing_efficiency():.1%} token efficiency, "
+                f"<= {n_segments} pairs/row")
+
         use_lora = bundle.config.lora_r > 0
         if use_lora:
             # adapters + scalar head train; backbone stays frozen (no
@@ -103,21 +134,22 @@ def main(argv=None) -> None:
                 bundle, jax.random.fold_in(rng, 17))
             trainer = Trainer(
                 config=config, mesh=mesh,
-                loss_fn=make_reward_loss(bundle.model, lora=True),
-                eval_fn=make_reward_eval(bundle.model, lora=True),
+                loss_fn=make_reward_loss(bundle.model, lora=True,
+                                         n_segments=n_segments),
+                eval_fn=make_reward_eval(bundle.model, lora=True,
+                                         n_segments=n_segments),
                 params={"lora": adapters, "reward_head": head},
                 param_specs={"lora": lora_specs, "reward_head": head_spec},
                 frozen=bundle.params, frozen_specs=bundle.specs)
         else:
             trainer = Trainer(
                 config=config, mesh=mesh,
-                loss_fn=make_reward_loss(bundle.model),
-                eval_fn=make_reward_eval(bundle.model),
+                loss_fn=make_reward_loss(bundle.model,
+                                         n_segments=n_segments),
+                eval_fn=make_reward_eval(bundle.model,
+                                         n_segments=n_segments),
                 params=bundle.params, param_specs=bundle.specs)
 
-        data_cfg = {**config.get("data", {}),
-                    "max_seq_length": bundle.config.max_seq_length}
-        train_ds = build_preference_dataset(data_cfg, bundle.tokenizer, "train")
         train_it = ShardedBatchIterator(
             train_ds, trainer.global_batch,
             seed=int(config.get("seed", 0)),
@@ -125,11 +157,7 @@ def main(argv=None) -> None:
             process_count=jax.process_count())
 
         eval_iter_fn = None
-        has_eval = (data_cfg.get("eval_path")
-                    if data_cfg.get("source", "local") == "local"
-                    else data_cfg.get("eval_split"))
-        if has_eval:
-            eval_ds = build_preference_dataset(data_cfg, bundle.tokenizer, "eval")
+        if eval_ds is not None:
             micro_global = trainer.micro * trainer.dp
 
             def eval_iter_fn():
