@@ -48,17 +48,42 @@ def build_schedule(opt_cfg: Dict[str, Any]) -> Callable[[int], float]:
 
 def build_optimizer(opt_cfg: Dict[str, Any]
                     ) -> Tuple[optax.GradientTransformation, Callable[[int], float]]:
+    """``optimization.optimizer``: ``adamw`` (default — reference parity,
+    train_sft.py:89-94) or ``adafactor`` — the TPU-native memory-frugal
+    choice: factored second moment (O(rows+cols) per matrix instead of a
+    full fp32 tree), which is what makes ≥1B full-parameter runs fit a
+    single 16G chip (tools/convergence_run.py r5: AdamW's fp32 nu +
+    update transients RESOURCE_EXHAUSTED a 1.07B DPO step that
+    adafactor runs with ~5G to spare)."""
     schedule = build_schedule(opt_cfg)
     max_norm = float(opt_cfg.get("max_grad_norm", 0.0) or 0.0)
     chain = []
     if max_norm > 0:
         chain.append(optax.clip_by_global_norm(max_norm))
-    chain.append(optax.adamw(
-        learning_rate=schedule,
-        b1=float(opt_cfg.get("adam_beta1", 0.9)),
-        b2=float(opt_cfg.get("adam_beta2", 0.95)),
-        eps=float(opt_cfg.get("adam_eps", 1e-8)),
-        weight_decay=float(opt_cfg.get("weight_decay", 0.0)),
-        mu_dtype=opt_cfg.get("adam_moment_dtype"),
-    ))
+    kind = str(opt_cfg.get("optimizer", "adamw")).lower()
+    if kind == "adamw":
+        chain.append(optax.adamw(
+            learning_rate=schedule,
+            b1=float(opt_cfg.get("adam_beta1", 0.9)),
+            b2=float(opt_cfg.get("adam_beta2", 0.95)),
+            eps=float(opt_cfg.get("adam_eps", 1e-8)),
+            weight_decay=float(opt_cfg.get("weight_decay", 0.0)),
+            mu_dtype=opt_cfg.get("adam_moment_dtype"),
+        ))
+    elif kind == "adafactor":
+        chain.append(optax.adafactor(
+            learning_rate=schedule,
+            # parameter-scale multiplication off: the configured
+            # learning_rate then means what it says (the relative-step
+            # default silently rescales by RMS(param), which breaks LR
+            # sweeps and the shared schedule semantics). factored=True
+            # and no momentum stay — the memory profile is the point.
+            multiply_by_parameter_scale=False,
+            weight_decay_rate=float(opt_cfg.get("weight_decay", 0.0))
+            or None,
+        ))
+    else:
+        raise ValueError(
+            f"Unknown optimization.optimizer '{kind}' "
+            "(expected 'adamw' or 'adafactor')")
     return optax.chain(*chain), schedule

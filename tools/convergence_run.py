@@ -74,15 +74,20 @@ def main(steps: int = 300, out_dir: str = None) -> dict:
     on_accel = jax.devices()[0].platform != "cpu"
     if on_accel:
         # same ~1.3B shape as the PPO bench (2048 x 24L, GQA 16q/8kv)
+        # 1.07B (>= the 1B bar): 24L/1.26B OOMs the 15.75G v5e even at
+        # micro 4 — the residents alone are params 2.5G + ref copy 2.5G
+        # + bf16 mu 2.5G + fp32 nu 5G + the fp32 grad accumulator 5G
+        # (trainer.py in-step scan). 20L plus the int8 ref below fits:
+        # 2.14 + 1.1 + 2.14 + 4.28 + 4.28 ~ 13.9G + activations.
         cfg = ModelConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_layers=24, num_heads=16, num_kv_heads=8,
+            num_layers=20, num_heads=16, num_kv_heads=8,
             max_seq_length=256, remat="dots", attention="flash",
             dtype="bfloat16", param_dtype="bfloat16")
-        bs, prompt_len, lr = 16, 64, 1e-5
+        bs, prompt_len, lr, micro, accum = 16, 64, 1e-5, 4, 4
     else:
         cfg = get_model_config("tiny", max_seq_length=64)
-        bs, prompt_len, lr = 8, 8, 1e-3
+        bs, prompt_len, lr, micro, accum = 8, 8, 1e-3, 8, 1
 
     mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
     model = Transformer(cfg)
@@ -104,24 +109,38 @@ def main(steps: int = 300, out_dir: str = None) -> dict:
             "experiment_name": "convergence_1b",
             "optimization": {
                 "total_batch_size": bs,
-                "micro_batch_size": max(1, bs // dp),
+                "micro_batch_size": max(1, micro // dp),
                 "learning_rate": lr, "max_train_steps": steps,
                 "lr_scheduler": "cosine", "warmup_steps": 10,
                 "max_grad_norm": 1.0,
-                # bf16 first moment: the 1.3B full-DPO HBM budget
-                "adam_moment_dtype": "bfloat16",
+                # adafactor: AdamW's fp32 nu (4.3G) + fp32 update
+                # transients pushed even the 20L/int8-ref config over
+                # 15.75G (r5 on-chip); the factored second moment is the
+                # standard TPU answer and leaves headroom
+                "optimizer": "adafactor",
             },
             "logging": {"output_dir": os.path.join(out, "ckpt"),
                         "log_dir": None},
-            "hardware": {"gradient_accumulation_steps": 1},
+            "hardware": {"gradient_accumulation_steps": accum},
         }
-        # frozen ref = the initial policy; Trainer detects the aliased
-        # leaves and copies them, so no second init is paid
+        # frozen ref = the initial policy. On-chip it stores int8
+        # weight-only (the rollout-quant machinery: scoring dequantizes
+        # per-matmul via _weight) — the full-precision ref copy is one
+        # of the residents that OOM'd the 24L run; the POLICY stays
+        # full-precision, so this is still full-parameter DPO. The int8
+        # tree carries extra _wscale leaves, so it gets replicated specs
+        # (it is ~1G; the single-chip mesh replicates everything anyway).
+        if on_accel:
+            ref = jax.jit(model.quantize_weights)(base)
+            from jax.sharding import PartitionSpec as P
+            ref_specs = jax.tree.map(lambda _: P(), ref)
+        else:
+            ref, ref_specs = base, model.partition_specs()
         trainer = Trainer(
             config=config, mesh=mesh,
             loss_fn=make_dpo_loss(model, model, beta=0.1),
             params=base, param_specs=model.partition_specs(),
-            frozen=base, frozen_specs=model.partition_specs())
+            frozen=ref, frozen_specs=ref_specs)
 
         rs = np.random.RandomState(0)
         rows = []
