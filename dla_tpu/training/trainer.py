@@ -9,9 +9,11 @@ once. A phase supplies a pure ``loss_fn(params, frozen, batch, rng) ->
   reference utils.py:55-75)
 - one jitted train step with **in-step gradient accumulation**: the global
   batch arrives as [accum, micro*dp, ...] and a ``lax.scan`` accumulates
-  fp32 grads over microbatches — no Python-side accumulate context
-  (reference accelerator.accumulate, train_sft.py:144), no host sync per
-  microbatch
+  grads over microbatches — fp32 by default, bf16 via
+  ``optimization.grad_accum_dtype`` (the 70B HBM lever; each micro's
+  grads are still computed in fp32 and the post-scan average/update math
+  stays fp32) — no Python-side accumulate context (reference
+  accelerator.accumulate, train_sft.py:144), no host sync per microbatch
 - fp32 grad/optimizer state sharded like the params (= partitioned
   optimizer state), donated buffers for in-place update
 - global-norm clipping + AdamW + schedule (dla_tpu.training.optim)
@@ -73,6 +75,18 @@ class Trainer:
                            hw_cfg.get("gradient_accumulation_steps", 1))
         self.opt_cfg = opt_cfg
         self.accum = int(opt_cfg["gradient_accumulation_steps"])
+        # grad accumulator dtype: fp32 default; bfloat16 halves the
+        # biggest step-transient at 70B scale (the accumulator is a full
+        # param-shaped tree — 8.6G/device fp32 on the v5e-256 70B
+        # config, measured by tools/scale_rehearsal.py r5). bf16 keeps
+        # fp32's exponent range, so only mantissa precision of the SUM
+        # is reduced — each micro's grads are still computed in fp32.
+        self.grad_accum_dtype = jnp.dtype(
+            opt_cfg.get("grad_accum_dtype", "float32"))
+        if self.grad_accum_dtype not in (jnp.float32, jnp.bfloat16):
+            raise ValueError(
+                f"grad_accum_dtype must be float32 or bfloat16, got "
+                f"{opt_cfg['grad_accum_dtype']!r}")
         self.micro = int(opt_cfg.get("micro_batch_size", 1))
         self.dp = data_parallel_size(mesh)
         self.global_batch = check_batch_identity(
@@ -145,14 +159,15 @@ class Trainer:
             mb, r = xs
             (loss, metrics), grads = grad_fn(params, mb, r)
             grads = jax.tree.map(
-                lambda a, g: a + g.astype(jnp.float32), grad_acc, grads)
+                lambda a, g: a + g.astype(self.grad_accum_dtype),
+                grad_acc, grads)
             metric_acc = jax.tree.map(
                 lambda a, m: a + jnp.asarray(m, jnp.float32) / self.accum,
                 metric_acc, metrics)
             return (grads, metric_acc, loss_acc + loss / self.accum), None
 
         zero_grads = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            lambda p: jnp.zeros(p.shape, self.grad_accum_dtype), params)
         rngs = jax.random.split(rng, self.accum)
         # metric structure probe (cheap: eval_shape)
         metric_shapes = jax.eval_shape(
@@ -165,8 +180,11 @@ class Trainer:
         (grads, metrics, loss), _ = jax.lax.scan(
             body, (zero_grads, zero_metrics, jnp.zeros((), jnp.float32)),
             (batch, rngs))
-        # grads were summed over microbatches of mean losses -> average them
-        grads = jax.tree.map(lambda g: g / self.accum, grads)
+        # grads were summed over microbatches of mean losses -> average
+        # them, in fp32 regardless of the accumulator dtype (the
+        # optimizer update math stays full precision)
+        grads = jax.tree.map(
+            lambda g: g.astype(jnp.float32) / self.accum, grads)
 
         updates, new_opt_state = self.optimizer.update(
             grads, opt_state, params)

@@ -81,6 +81,26 @@ def test_sft_end_to_end_loss_falls(tmp_path):
     assert "tokens_per_sec_per_chip" in rec
 
 
+def test_sft_bf16_grad_accum(tmp_path):
+    """optimization.grad_accum_dtype: bfloat16 (the 70B HBM lever —
+    halves the whole-tree accumulation transient): training still
+    converges, and a bogus dtype is refused at trainer construction."""
+    from dla_tpu.training.train_sft import main
+    cfg_path, cfg = _write_sft_config(
+        tmp_path, **{"optimization.grad_accum_dtype": "bfloat16"})
+    main(["--config", str(cfg_path)])
+    losses = _losses(tmp_path / "logs")
+    assert losses and losses[-1][1] < losses[0][1] * 0.95
+
+    import pytest
+
+    from dla_tpu.training.trainer import Trainer
+    with pytest.raises(ValueError, match="grad_accum_dtype"):
+        Trainer(config={**cfg, "optimization": {
+                    **cfg["optimization"], "grad_accum_dtype": "float16"}},
+                mesh=None, loss_fn=None, params=None, param_specs=None)
+
+
 def test_sft_resume_continues(tmp_path):
     from dla_tpu.training.train_sft import main
     cfg_path, cfg = _write_sft_config(tmp_path)
