@@ -443,11 +443,19 @@ def run_decode_bench() -> dict:
 
     on_accel = jax.devices()[0].platform != "cpu"
     if on_accel:
+        # bf16 KV: the r5 on-chip sweep measured int8 KV ALONE as a
+        # regression at this scale (1.655 vs 1.45 ms/token — dequant
+        # work outweighs bandwidth savings while the cache is small
+        # next to the weights; it pays only combined with int8 weights,
+        # tools/sweep_decode.py b8_w8kv8 = 1.23 ms)
+        # bf16 params: the inference/rollout storage dtype (fp32
+        # masters would double the per-step weight read — same
+        # rationale as tools/sweep_decode.py, review r4)
         cfg = ModelConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_layers=24, num_heads=8, num_kv_heads=4,
             max_seq_length=2048, attention="flash", remat="none",
-            kv_cache_dtype="int8")
+            dtype="bfloat16", param_dtype="bfloat16")
         b, prompt, new = 8, 128, 256
     else:
         cfg = ModelConfig(

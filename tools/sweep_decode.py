@@ -108,6 +108,13 @@ VARIANTS = {
     "b8_w8kv8": dict(batch=8, kv_dtype="int8", weights="int8"),
     "b64_n128_w8kv8": dict(batch=64, prompt=128, new=128,
                            kv_dtype="int8", weights="int8"),
+    # r5 ablations at the PPO rollout shape: int8 KV alone REGRESSED at
+    # b8/b32 (dequant overhead > bandwidth savings while the cache is
+    # small next to the weights) — isolate whether the rollout stack
+    # should keep the int8 cache or only the int8 weights
+    "b64_n128_bf16": dict(batch=64, prompt=128, new=128),
+    "b64_n128_w8": dict(batch=64, prompt=128, new=128, weights="int8"),
+    "b8_w8": dict(batch=8, weights="int8"),
 }
 
 
