@@ -251,6 +251,52 @@ def test_packed_distill_ce_equivalence(tmp_path):
                                rtol=2e-4, atol=2e-5)
 
 
+def test_packed_distill_kl_equivalence(tmp_path):
+    """Packed distill-KL == unpacked distill-KL through the REAL
+    make_distill_loss (pins the segment-start KL mask construction in
+    train_distill.py): both are token-means over the identical
+    valid-target set, with the teacher forward segment-masked too."""
+    from dla_tpu.training.train_distill import make_distill_loss
+
+    write_jsonl(tmp_path / "teach.jsonl", _teacher_records())
+    tok = load_tokenizer("byte")
+    base = TeacherRolloutDataset(tok, 48, path=str(tmp_path / "teach.jsonl"))
+    ds = PackedTeacherDataset(base, 48, lazy=False)
+    student = Transformer(get_model_config("tiny"))
+    teacher = Transformer(get_model_config("tiny"))
+    sp = student.init(jax.random.key(4))
+    tp = teacher.init(jax.random.key(5))
+
+    loss_fn = make_distill_loss(student, [teacher], use_kl=True,
+                                temperature=1.0)
+    frozen = {"teacher_0": tp}
+
+    # unpacked: one row per example
+    L = 48
+    n = len(base)
+    ids = np.full((n, L), tok.pad_token_id, np.int32)
+    m = np.zeros((n, L), np.int32)
+    rewards = np.zeros((n,), np.float32)
+    for i in range(n):
+        ex = base[i]
+        k = min(ex["input_ids"].shape[0], L)
+        ids[i, :k] = ex["input_ids"][:k]
+        m[i, :k] = 1
+        rewards[i] = ex["reward"]
+    want, _ = loss_fn(sp, frozen, {
+        "input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(m),
+        "reward": jnp.asarray(rewards)}, jax.random.key(0))
+
+    batch = ds.collate([ds[r] for r in range(len(ds))])
+    got, _ = loss_fn(sp, frozen, {
+        "input_ids": jnp.asarray(batch["input_ids"]),
+        "attention_mask": jnp.asarray(batch["attention_mask"]),
+        "segment_ids": jnp.asarray(batch["segment_ids"]),
+        "reward": jnp.asarray(batch["reward"])}, jax.random.key(0))
+    np.testing.assert_allclose(float(got), float(want),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_packed_dpo_end_to_end(tmp_path):
     """train_dpo with data.packing: true on the 8-device CPU mesh: runs,
     logs pair-weighted metrics, loss finite and falling."""
