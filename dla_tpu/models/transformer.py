@@ -505,7 +505,7 @@ class Transformer:
             return w.astype(self.adtype)
 
         def proj(name, inp):
-            out = inp @ self._weight(layer, name)
+            out = self._dense(layer, name, inp)
             bias = layer.get(f"{name}_bias")
             if bias is not None:
                 out = out + cast(bias)
@@ -626,6 +626,35 @@ class Transformer:
             return (w.astype(jnp.float32)
                     * container[name + "_wscale"]).astype(self.adtype)
         return w.astype(self.adtype)
+
+    def _dense(self, container: Params, name: str,
+               inp: jnp.ndarray) -> jnp.ndarray:
+        """``inp @ weight`` with int8 weight-only storage consumed through
+        the fused Pallas kernel (ops.quant_matmul): the dequantization
+        happens in VMEM, so HBM reads the int8 bytes and nothing else.
+        The ``_weight`` convert*scale path relies on XLA fusing the
+        dequant into the dot — measured on chip (r5 sweep_decode) it does
+        NOT and materializes the bf16 matrix, making int8 rollout decode
+        SLOWER than bf16 (b64 full stack 4.7x roofline). Under a >1-device
+        auto mesh the kernel (no SPMD rule) would replicate the weight, so
+        those contexts keep the XLA path — logged once per shape; the
+        single-chip rollout/bench path is where the int8 bytes matter."""
+        w = container[name]
+        if w.dtype != jnp.int8:
+            return inp @ w.astype(self.adtype)
+        if _flash_mesh() is not None:
+            key = ("int8_dense", name, inp.shape)
+            if key not in _REPLICATED_FLASH_LOGGED and \
+                    jax.process_index() == 0:
+                _REPLICATED_FLASH_LOGGED.add(key)
+                print(f"[dla_tpu][int8] {name} {inp.shape} consumed via "
+                      "the XLA dequant path (multi-device auto mesh; the "
+                      "fused kernel has no SPMD rule)",
+                      file=sys.stderr, flush=True)
+            return inp @ self._weight(container, name)
+        from dla_tpu.ops.quant_matmul import int8_matmul
+        return int8_matmul(inp, w, container[name + "_wscale"]
+                           ).astype(self.adtype)
 
     _WEIGHT_ONLY_MATS = ("wq", "wk", "wv", "wo", "w_gate", "w_up",
                          "w_down", "fc1", "fc2")
@@ -1221,8 +1250,17 @@ class Transformer:
         gemma-2 softcaps final logits: cap * tanh(logits / cap) — applied
         here AND in the chunked fused-CE path (ops.fused_ce reads
         cfg.final_logit_softcap through model.cfg)."""
-        w, bias = self.unembed_params(params)
-        logits = hidden @ w
+        lm = params.get("lm_head")
+        if lm is not None and lm.dtype == jnp.int8:
+            # quantized rollout tree: fused kernel path (the [D, V]
+            # dequant would otherwise materialize 2x the int8 bytes
+            # EVERY decode step)
+            logits = self._dense(params, "lm_head", hidden)
+            bias = params.get("lm_head_bias")
+            bias = None if bias is None else bias.astype(logits.dtype)
+        else:
+            w, bias = self.unembed_params(params)
+            logits = hidden @ w
         if bias is not None:
             logits = logits + bias
         cap = self.cfg.final_logit_softcap
@@ -1295,8 +1333,12 @@ class Transformer:
             "step": jnp.zeros((), jnp.int32),           # decode steps taken
         }
         if self._kv_int8:
-            cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
-            cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+            # scales are stored K-MAJOR [L, B, K, S] — the layout the
+            # Pallas decode kernel consumes — so no [B, S, K] transpose
+            # rides the per-layer decode hot loop (r5 review finding)
+            sshape = (shape[0], batch, cfg.num_kv_heads, max_len)
+            cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
         return cache
 
     def cache_partition_specs(self) -> Params:
@@ -1308,8 +1350,8 @@ class Transformer:
             "step": P(),
         }
         if self._kv_int8:
-            specs["k_scale"] = P(None, ("data", "fsdp"), None, "model")
-            specs["v_scale"] = P(None, ("data", "fsdp"), None, "model")
+            specs["k_scale"] = P(None, ("data", "fsdp"), "model", None)
+            specs["v_scale"] = P(None, ("data", "fsdp"), "model", None)
         return specs
 
     def prefill(self, params: Params, cache: Params,
@@ -1378,8 +1420,11 @@ class Transformer:
             vq, v_s = self._quantize_kv(vs)
             new_cache["k"] = jnp.pad(kq, pad5)
             new_cache["v"] = jnp.pad(vq, pad5)
-            new_cache["k_scale"] = jnp.pad(k_s, pad5[:-1])
-            new_cache["v_scale"] = jnp.pad(v_s, pad5[:-1])
+            # [L, B, T, K] -> K-major [L, B, K, S] (one transpose at
+            # prefill; decode reads it transpose-free every step)
+            pads = ((0, 0), (0, 0), (0, 0), (0, pad))
+            new_cache["k_scale"] = jnp.pad(k_s.transpose(0, 1, 3, 2), pads)
+            new_cache["v_scale"] = jnp.pad(v_s.transpose(0, 1, 3, 2), pads)
         else:
             new_cache["k"] = jnp.pad(ks, pad5)
             new_cache["v"] = jnp.pad(vs, pad5)
@@ -1419,11 +1464,49 @@ class Transformer:
         # the full [L,B,S,K,D] cache through the scan each step, ~4x the
         # necessary HBM traffic on the decode hot loop (the PPO bottleneck,
         # reference src/training/train_rlhf.py:123-124).
+        # int8 caches route through the Pallas decode kernel (dequant in
+        # VMEM): the XLA `_dequantize_kv` path materializes a bf16 copy
+        # of the cache per layer per step — measured on chip (r5
+        # sweep_decode) that made int8 KV a REGRESSION vs bf16 (b64:
+        # 3.77 vs 2.71 ms/token). Kernel gates: static window (gemma-2's
+        # traced per-layer window can't cross pallas_call), no softcap,
+        # lane-aligned head_dim, GQA group <= 8, and no >1-device auto
+        # mesh (pallas has no SPMD rule; replicating the cache would be
+        # worse than the dequant copy).
+        from dla_tpu.ops.decode_kernel import GP as _KGP
+        use_decode_kernel = (
+            self._kv_int8
+            and cfg.head_dim_ % 128 == 0
+            and cfg.num_heads // cfg.num_kv_heads <= _KGP
+            and not cfg.attn_logit_softcap
+            # per-layer alternating windows (gemma-2 pattern > 1) give
+            # every layer a DIFFERENT mask, defeating the once-per-step
+            # bias hoist below (the kernel itself could consume a traced
+            # window — it folds into the bias outside the pallas_call)
+            and not (cfg.sliding_window
+                     and cfg.sliding_window_pattern > 1)
+            and _flash_mesh() is None)
+
+        attn_bias = None
+        if use_decode_kernel:
+            # validity+causality+(uniform static window) as an additive
+            # bias, built ONCE per step — every layer shares it
+            delta = positions - kv_pos                       # [B, S]
+            bmask = cache["valid"] & (delta >= 0)
+            if cfg.sliding_window:
+                bmask = bmask & (delta < cfg.sliding_window)
+            attn_bias = jnp.where(bmask, 0.0, -1e30).astype(jnp.float32)
+
         def body2(carry, xs):
+            k_s = v_s = None
             if self._kv_int8:
                 layer, k_cache, v_cache, k_s, v_s = xs
-                k_cache = self._dequantize_kv(k_cache, k_s)
-                v_cache = self._dequantize_kv(v_cache, v_s)
+                if not use_decode_kernel:
+                    # K-major [B, K, S] storage -> positional [B, S, K]
+                    k_cache = self._dequantize_kv(
+                        k_cache, k_s.transpose(0, 2, 1))
+                    v_cache = self._dequantize_kv(
+                        v_cache, v_s.transpose(0, 2, 1))
             else:
                 layer, k_cache, v_cache = xs
             h_in = carry
@@ -1434,7 +1517,7 @@ class Transformer:
                 return w.astype(self.adtype)
 
             def proj(name, inp):
-                out = inp @ self._weight(layer, name)
+                out = self._dense(layer, name, inp)
                 bias = layer.get(f"{name}_bias")
                 return out if bias is None else out + cast(bias)
 
@@ -1448,13 +1531,20 @@ class Transformer:
             v = proj("wv", hn).reshape(b, 1, cfg.num_kv_heads, dh)
             q = apply_rotary(q, cos, sin, rotary_dim=rd)
             k = apply_rotary(k, cos, sin, rotary_dim=rd)
-            attn = decode_attention(
-                q, k_cache, v_cache, k, v,
-                kv_valid=cache["valid"],
-                q_positions=positions, kv_positions=kv_pos,
-                window=self._layer_window(layer),
-                softmax_scale=self._softmax_scale,
-                logit_softcap=cfg.attn_logit_softcap)
+            if use_decode_kernel:
+                from dla_tpu.ops.decode_kernel import flash_decode_attention
+                attn = flash_decode_attention(
+                    q, k_cache, v_cache, k, v,
+                    bias=attn_bias, k_scale=k_s, v_scale=v_s,
+                    softmax_scale=self._softmax_scale)
+            else:
+                attn = decode_attention(
+                    q, k_cache, v_cache, k, v,
+                    kv_valid=cache["valid"],
+                    q_positions=positions, kv_positions=kv_pos,
+                    window=self._layer_window(layer),
+                    softmax_scale=self._softmax_scale,
+                    logit_softcap=cfg.attn_logit_softcap)
             attn = attn.reshape(b, 1, cfg.num_heads * dh)
             if cfg.arch == "phi":
                 ff = jax.nn.gelu(proj("fc1", hn), approximate=True)
@@ -1488,8 +1578,10 @@ class Transformer:
         zero = jnp.zeros((), jnp.int32)
 
         def write_col(buf, cols, rank5=True):
+            # rank5: KV [L, B, S, K, D], column dim 2; rank4: K-major
+            # scales [L, B, K, S], column is the LAST dim
             idx = (zero, zero, col, zero, zero) if rank5 else \
-                (zero, zero, col, zero)
+                (zero, zero, zero, col)
             return jax.lax.dynamic_update_slice(buf, cols, idx)
 
         # validity/positions after writing this token
@@ -1509,10 +1601,12 @@ class Transformer:
             vq, v_s = self._quantize_kv(v_cols)
             new_cache["k"] = write_col(cache["k"], kq)
             new_cache["v"] = write_col(cache["v"], vq)
-            new_cache["k_scale"] = write_col(cache["k_scale"], k_s,
-                                             rank5=False)
-            new_cache["v_scale"] = write_col(cache["v_scale"], v_s,
-                                             rank5=False)
+            # K-major scale storage [L, B, K, S]: the new column
+            # [L, B, 1, K] transposes to [L, B, K, 1], lands at col
+            new_cache["k_scale"] = write_col(
+                cache["k_scale"], k_s.transpose(0, 1, 3, 2), rank5=False)
+            new_cache["v_scale"] = write_col(
+                cache["v_scale"], v_s.transpose(0, 1, 3, 2), rank5=False)
         else:
             new_cache["k"] = write_col(cache["k"], k_cols)
             new_cache["v"] = write_col(cache["v"], v_cols)
