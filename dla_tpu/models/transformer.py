@@ -1468,34 +1468,38 @@ class Transformer:
         # VMEM): the XLA `_dequantize_kv` path materializes a bf16 copy
         # of the cache per layer per step — measured on chip (r5
         # sweep_decode) that made int8 KV a REGRESSION vs bf16 (b64:
-        # 3.77 vs 2.71 ms/token). Kernel gates: static window (gemma-2's
-        # traced per-layer window can't cross pallas_call), no softcap,
-        # lane-aligned head_dim, GQA group <= 8, and no >1-device auto
-        # mesh (pallas has no SPMD rule; replicating the cache would be
-        # worse than the dequant copy).
+        # 3.77 vs 2.71 ms/token). Kernel gates: lane-aligned head_dim,
+        # GQA group <= 8, and no >1-device auto mesh (pallas has no SPMD
+        # rule; replicating the cache would be worse than the dequant
+        # copy). Softcap is a static kernel param; gemma-2's alternating
+        # per-layer windows become a two-bias select below.
         from dla_tpu.ops.decode_kernel import GP as _KGP
         use_decode_kernel = (
             self._kv_int8
             and cfg.head_dim_ % 128 == 0
             and cfg.num_heads // cfg.num_kv_heads <= _KGP
-            and not cfg.attn_logit_softcap
-            # per-layer alternating windows (gemma-2 pattern > 1) give
-            # every layer a DIFFERENT mask, defeating the once-per-step
-            # bias hoist below (the kernel itself could consume a traced
-            # window — it folds into the bias outside the pallas_call)
-            and not (cfg.sliding_window
-                     and cfg.sliding_window_pattern > 1)
             and _flash_mesh() is None)
 
-        attn_bias = None
+        attn_bias = attn_bias_win = None
         if use_decode_kernel:
-            # validity+causality+(uniform static window) as an additive
-            # bias, built ONCE per step — every layer shares it
+            # validity+causality(+window) as additive biases built ONCE
+            # per step. Uniform-window models (mistral: pattern == 1)
+            # fold the window into the single shared bias; alternating-
+            # window models (gemma-2: pattern > 1) get BOTH biases, and
+            # each layer's traced swa_on flag picks one inside the scan
+            # (a [B, S] select per layer — nothing quadratic, no
+            # re-derivation of the mask from positions).
+            from dla_tpu.ops.decode_kernel import NEG_INF as _KNEG
             delta = positions - kv_pos                       # [B, S]
             bmask = cache["valid"] & (delta >= 0)
             if cfg.sliding_window:
-                bmask = bmask & (delta < cfg.sliding_window)
-            attn_bias = jnp.where(bmask, 0.0, -1e30).astype(jnp.float32)
+                wmask = bmask & (delta < cfg.sliding_window)
+                if cfg.sliding_window_pattern > 1:
+                    attn_bias_win = jnp.where(
+                        wmask, 0.0, _KNEG).astype(jnp.float32)
+                else:
+                    bmask = wmask
+            attn_bias = jnp.where(bmask, 0.0, _KNEG).astype(jnp.float32)
 
         def body2(carry, xs):
             k_s = v_s = None
@@ -1533,10 +1537,17 @@ class Transformer:
             k = apply_rotary(k, cos, sin, rotary_dim=rd)
             if use_decode_kernel:
                 from dla_tpu.ops.decode_kernel import flash_decode_attention
+                bias_l = attn_bias
+                if attn_bias_win is not None:
+                    # gemma-2 alternating SWA: the layer's traced flag
+                    # picks the windowed or full bias
+                    bias_l = jnp.where(layer["swa_on"], attn_bias_win,
+                                       attn_bias)
                 attn = flash_decode_attention(
                     q, k_cache, v_cache, k, v,
-                    bias=attn_bias, k_scale=k_s, v_scale=v_s,
-                    softmax_scale=self._softmax_scale)
+                    bias=bias_l, k_scale=k_s, v_scale=v_s,
+                    softmax_scale=self._softmax_scale,
+                    logit_softcap=cfg.attn_logit_softcap)
             else:
                 attn = decode_attention(
                     q, k_cache, v_cache, k, v,

@@ -44,9 +44,17 @@ GP = 8  # query-group sublane padding
 
 
 def _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref, ks_ref, vs_ref,
-          o_ref, m_ref, l_ref, acc_ref, *, kheads, dh, bs, s, scale):
+          o_ref, m_ref, l_ref, acc_ref, *, kheads, dh, bs, s, scale,
+          softcap=0.0):
     si = pl.program_id(1)
     ns = pl.num_programs(1)
+
+    def cap(x):
+        # gemma-2 logit softcapping: cap * tanh(x / cap), applied to the
+        # SCALED scores before masking (decode_attention's order)
+        if not softcap:
+            return x
+        return softcap * jnp.tanh(x / softcap)
 
     @pl.when(si == 0)
     def _init():
@@ -57,9 +65,9 @@ def _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref, ks_ref, vs_ref,
             dcol = slice(kh * dh, (kh + 1) * dh)
             q = q_ref[0, rows, :]                           # [Gp, D]
             kn = kn_ref[0, dcol][None, :]                   # [1, D]
-            s_self = jax.lax.dot_general(
+            s_self = cap(jax.lax.dot_general(
                 q, kn, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [Gp, 1]
+                preferred_element_type=jnp.float32) * scale)  # [Gp, 1]
             m_ref[rows, :] = jnp.broadcast_to(s_self, (GP, 128))
             l_ref[rows, :] = jnp.ones((GP, 128), jnp.float32)
             acc_ref[rows, :] = jnp.broadcast_to(
@@ -87,9 +95,9 @@ def _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref, ks_ref, vs_ref,
             v_blk = (v_blk.astype(jnp.float32)
                      * vs_ref[0, kh, :][:, None]).astype(jnp.bfloat16)
         v_blk = jnp.where(vmask, v_blk, jnp.zeros_like(v_blk))
-        s_blk = jax.lax.dot_general(
+        s_blk = cap(jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # [Gp, bs]
+            preferred_element_type=jnp.float32) * scale)     # [Gp, bs]
         s_blk = jnp.where(colmask, s_blk + bias, NEG_INF)
 
         m_old = m_ref[rows, :1]                              # [Gp, 1]
@@ -110,8 +118,10 @@ def _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref, ks_ref, vs_ref,
         o_ref[0, :, :] = acc_ref[...] / l_ref[:, :1]
 
 
-@partial(jax.jit, static_argnames=("scale", "block_s", "interpret"))
-def _call(q3, kn2, vn2, bias, kc, vc, ks, vs, scale, block_s, interpret):
+@partial(jax.jit, static_argnames=("scale", "block_s", "interpret",
+                                   "softcap"))
+def _call(q3, kn2, vn2, bias, kc, vc, ks, vs, scale, block_s, interpret,
+          softcap=0.0):
     b, khgp, dh = q3.shape
     kheads = khgp // GP
     s = kc.shape[1]
@@ -136,7 +146,8 @@ def _call(q3, kn2, vn2, bias, kc, vc, ks, vs, scale, block_s, interpret):
         ]
         args += [ks, vs]
 
-    kw = dict(kheads=kheads, dh=dh, bs=bs, s=s, scale=scale)
+    kw = dict(kheads=kheads, dh=dh, bs=bs, s=s, scale=scale,
+              softcap=softcap)
     if quant:
         def kernel(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
                    ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref):
@@ -180,6 +191,7 @@ def flash_decode_attention(
     v_scale: Optional[jnp.ndarray] = None,
     softmax_scale: Optional[float] = None,
     window: Optional[int] = None,
+    logit_softcap: float = 0.0,
     block_s: int = DEFAULT_BLOCK_S,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
@@ -231,6 +243,6 @@ def flash_decode_attention(
         vs = v_scale.astype(jnp.float32)
 
     out = _call(q3, kn2, vn2, bias, kc, vc, ks, vs, float(scale),
-                int(block_s), bool(interpret))
+                int(block_s), bool(interpret), float(logit_softcap))
     out = out.reshape(b, kheads, GP, d)[:, :, :g, :]
     return out.reshape(b, 1, h, d).astype(v_new.dtype)

@@ -155,23 +155,66 @@ def test_decode_step_int8_cache_uses_kernel_and_matches():
                                   np.asarray(cache_x["valid"]))
 
 
-def test_decode_kernel_gate_respects_traced_window():
-    """gemma-2-style alternating windows (traced per-layer scalar) must
-    NOT take the kernel (it cannot consume a traced window): generation
-    still runs and stays finite through the fallback."""
+def test_decode_kernel_softcap_matches_xla():
+    """Static logit softcapping (gemma-2) inside the kernel == the XLA
+    decode_attention softcap path."""
+    b, s, h, kh, d = 2, 200, 8, 4, 128
+    q = jnp.asarray(RNG.randn(b, 1, h, d), jnp.bfloat16)
+    kc = jnp.asarray(RNG.randn(b, s, kh, d), jnp.bfloat16)
+    vc = jnp.asarray(RNG.randn(b, s, kh, d), jnp.bfloat16)
+    kn = jnp.asarray(RNG.randn(b, 1, kh, d), jnp.bfloat16)
+    vn = jnp.asarray(RNG.randn(b, 1, kh, d), jnp.bfloat16)
+    valid = jnp.asarray(RNG.rand(b, s) < 0.8)
+    qpos = jnp.full((b, 1), s // 2, jnp.int32)
+    kpos = jnp.broadcast_to(jnp.arange(s)[None], (b, s)).astype(jnp.int32)
+    kw = dict(kv_valid=valid, q_positions=qpos, kv_positions=kpos)
+    ref = decode_attention(q, kc, vc, kn, vn, logit_softcap=50.0, **kw)
+    out = flash_decode_attention(q, kc, vc, kn, vn, logit_softcap=50.0,
+                                 **kw)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=8e-3)
+
+
+def test_decode_step_gemma2_style_kernel_matches_xla():
+    """gemma-2 composition — int8 cache + softcap + ALTERNATING per-layer
+    windows (traced swa_on select between the two hoisted biases) —
+    through the kernel matches the XLA dequant fallback."""
     cfg = _hd128_cfg(kv_cache_dtype="int8", sliding_window=8,
-                     sliding_window_pattern=2)
+                     sliding_window_pattern=2, attn_logit_softcap=30.0)
     model = Transformer(cfg)
     params = model.init(jax.random.key(0))
-    b, t, n = 1, 8, 3
+    b, t, n = 2, 12, 3
     ids = jnp.asarray(RNG.randint(3, 250, (b, t)), jnp.int32)
     mask = jnp.ones((b, t), jnp.int32)
+    mask = mask.at[1, t - 4:].set(0)
     logits, cache = model.start_decode(params, ids, mask, n)
     tok = jnp.argmax(logits, -1).astype(jnp.int32)
-    for _ in range(n):
-        logits, cache = model.decode_step(params, cache, tok)
-        tok = jnp.argmax(logits, -1).astype(jnp.int32)
-    assert np.isfinite(np.asarray(logits, np.float32)).all()
+    l_kernel, _ = model.decode_step(params, cache, tok)
+
+    from dla_tpu.ops import decode_kernel as dk
+
+    def xla_ref(q, kc, vc, kn, vn, *, bias=None, k_scale=None,
+                v_scale=None, softmax_scale=None, logit_softcap=0.0, **_):
+        b2, s2 = kc.shape[0], kc.shape[1]
+        kd = (kc.astype(jnp.float32)
+              * k_scale.transpose(0, 2, 1)[..., None]).astype(jnp.bfloat16)
+        vd = (vc.astype(jnp.float32)
+              * v_scale.transpose(0, 2, 1)[..., None]).astype(jnp.bfloat16)
+        return decode_attention(
+            q, kd, vd, kn, vn, kv_valid=bias > -1.0,
+            q_positions=jnp.full((b2, 1), 1 << 29, jnp.int32),
+            kv_positions=jnp.zeros((b2, s2), jnp.int32),
+            softmax_scale=softmax_scale, logit_softcap=logit_softcap)
+
+    real = dk.flash_decode_attention
+    dk.flash_decode_attention = xla_ref
+    try:
+        l_xla, _ = model.decode_step(params, cache, tok)
+    finally:
+        dk.flash_decode_attention = real
+    np.testing.assert_allclose(np.asarray(l_kernel, np.float32),
+                               np.asarray(l_xla, np.float32),
+                               atol=0.05, rtol=0.05)
 
 
 # ---------------------------------------------------------------- int8 mm
