@@ -1546,6 +1546,7 @@ class Transformer:
                 attn = flash_decode_attention(
                     q, k_cache, v_cache, k, v,
                     bias=bias_l, k_scale=k_s, v_scale=v_s,
+                    kv_fill=col,  # no valid column at/after the write slot
                     softmax_scale=self._softmax_scale,
                     logit_softcap=cfg.attn_logit_softcap)
             else:

@@ -43,9 +43,9 @@ DEFAULT_BLOCK_S = 512
 GP = 8  # query-group sublane padding
 
 
-def _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref, ks_ref, vs_ref,
-          o_ref, m_ref, l_ref, acc_ref, *, kheads, dh, bs, s, scale,
-          softcap=0.0):
+def _body(lb_ref, q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref, ks_ref,
+          vs_ref, o_ref, m_ref, l_ref, acc_ref, *, kheads, dh, bs, s,
+          scale, softcap=0.0):
     si = pl.program_id(1)
     ns = pl.num_programs(1)
 
@@ -73,45 +73,54 @@ def _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref, ks_ref, vs_ref,
             acc_ref[rows, :] = jnp.broadcast_to(
                 vn_ref[0, dcol][None, :].astype(jnp.float32), (GP, dh))
 
-    # ragged tail: columns past S are garbage loads (may be NaN in
-    # interpret mode) — scores must be REPLACED, not bias-added (NaN +
-    # NEG_INF is still NaN), and garbage V rows must be zeroed (exp()
-    # underflow gives p == 0, but 0 * NaN = NaN inside the dot)
-    col = si * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
-    colmask = col < s                                       # [1, bs]
-    bias = jnp.where(colmask, bias_ref[0, :][None, :], 0.0)
-    vrow = si * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, 1), 0)
-    vmask = vrow < s                                        # [bs, 1]
+    # blocks past the cache fill level are SKIPPED outright: their index
+    # maps clamp to the last active block (no DMA on a revisited block)
+    # and the compute is gated off here — decode's cache read traffic
+    # scales with the actual fill, not the preallocated S
+    @pl.when(si <= lb_ref[0])
+    def _process():
+        # columns past min(S, kv_fill) are garbage loads (ragged tail
+        # padding, or cache tail not yet written — possibly NaN) —
+        # scores must be REPLACED, not bias-added (NaN + NEG_INF is
+        # still NaN), and garbage V rows must be zeroed (exp()
+        # underflow gives p == 0, but 0 * NaN = NaN inside the dot)
+        bound = jnp.minimum(jnp.int32(s), lb_ref[1])
+        col = si * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        colmask = col < bound                               # [1, bs]
+        bias = jnp.where(colmask, bias_ref[0, :][None, :], 0.0)
+        vrow = si * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, 1), 0)
+        vmask = vrow < bound                                # [bs, 1]
 
-    for kh in range(kheads):
-        rows = slice(kh * GP, (kh + 1) * GP)
-        dcol = slice(kh * dh, (kh + 1) * dh)
-        q = q_ref[0, rows, :]                               # [Gp, D]
-        k_blk = k_ref[0, :, dcol]                           # [bs, D]
-        v_blk = v_ref[0, :, dcol]
-        if ks_ref is not None:
-            k_blk = (k_blk.astype(jnp.float32)
-                     * ks_ref[0, kh, :][:, None]).astype(jnp.bfloat16)
-            v_blk = (v_blk.astype(jnp.float32)
-                     * vs_ref[0, kh, :][:, None]).astype(jnp.bfloat16)
-        v_blk = jnp.where(vmask, v_blk, jnp.zeros_like(v_blk))
-        s_blk = cap(jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale)     # [Gp, bs]
-        s_blk = jnp.where(colmask, s_blk + bias, NEG_INF)
+        for kh in range(kheads):
+            rows = slice(kh * GP, (kh + 1) * GP)
+            dcol = slice(kh * dh, (kh + 1) * dh)
+            q = q_ref[0, rows, :]                           # [Gp, D]
+            k_blk = k_ref[0, :, dcol]                       # [bs, D]
+            v_blk = v_ref[0, :, dcol]
+            if ks_ref is not None:
+                k_blk = (k_blk.astype(jnp.float32)
+                         * ks_ref[0, kh, :][:, None]).astype(jnp.bfloat16)
+                v_blk = (v_blk.astype(jnp.float32)
+                         * vs_ref[0, kh, :][:, None]).astype(jnp.bfloat16)
+            v_blk = jnp.where(vmask, v_blk, jnp.zeros_like(v_blk))
+            s_blk = cap(jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale)  # [Gp, bs]
+            s_blk = jnp.where(colmask, s_blk + bias, NEG_INF)
 
-        m_old = m_ref[rows, :1]                              # [Gp, 1]
-        l_old = l_ref[rows, :1]
-        m_new = jnp.maximum(m_old, jnp.max(s_blk, axis=1, keepdims=True))
-        p = jnp.exp(s_blk - m_new)                           # [Gp, bs]
-        corr = jnp.exp(m_old - m_new)                        # [Gp, 1]
-        l_new = l_old * corr + jnp.sum(p, axis=1, keepdims=True)
-        m_ref[rows, :] = jnp.broadcast_to(m_new, (GP, 128))
-        l_ref[rows, :] = jnp.broadcast_to(l_new, (GP, 128))
-        pv = jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [Gp, D]
-        acc_ref[rows, :] = acc_ref[rows, :] * corr + pv
+            m_old = m_ref[rows, :1]                          # [Gp, 1]
+            l_old = l_ref[rows, :1]
+            m_new = jnp.maximum(m_old,
+                                jnp.max(s_blk, axis=1, keepdims=True))
+            p = jnp.exp(s_blk - m_new)                       # [Gp, bs]
+            corr = jnp.exp(m_old - m_new)                    # [Gp, 1]
+            l_new = l_old * corr + jnp.sum(p, axis=1, keepdims=True)
+            m_ref[rows, :] = jnp.broadcast_to(m_new, (GP, 128))
+            l_ref[rows, :] = jnp.broadcast_to(l_new, (GP, 128))
+            pv = jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [Gp, D]
+            acc_ref[rows, :] = acc_ref[rows, :] * corr + pv
 
     @pl.when(si == ns - 1)
     def _fin():
@@ -120,60 +129,79 @@ def _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 @partial(jax.jit, static_argnames=("scale", "block_s", "interpret",
                                    "softcap"))
-def _call(q3, kn2, vn2, bias, kc, vc, ks, vs, scale, block_s, interpret,
-          softcap=0.0):
+def _call(q3, kn2, vn2, bias, kc, vc, ks, vs, kv_fill, scale, block_s,
+          interpret, softcap=0.0):
     b, khgp, dh = q3.shape
     kheads = khgp // GP
     s = kc.shape[1]
     khd = kc.shape[2]
     bs = min(block_s, max(128, -(-s // 128) * 128))
     ns = pl.cdiv(s, bs)
+    # last S-block holding a potentially-valid cache column: KV blocks
+    # past it clamp their index maps to it (a revisited block is not
+    # re-DMA'd) and skip their compute — traffic follows the fill level.
+    # The raw fill rides along so the kernel can hard-mask the unwritten
+    # tail WITHIN the last block (bias alone cannot kill NaN garbage).
+    fill = kv_fill.astype(jnp.int32).reshape(())
+    last_blk = jnp.stack([jnp.clip((fill - 1) // bs, 0, ns - 1), fill])
+
+    def clamp(si, lb):
+        return jnp.minimum(si, lb[0])
 
     in_specs = [
-        pl.BlockSpec((1, khgp, dh), lambda bi, si: (bi, 0, 0)),
-        pl.BlockSpec((1, khd), lambda bi, si: (bi, 0)),
-        pl.BlockSpec((1, khd), lambda bi, si: (bi, 0)),
-        pl.BlockSpec((1, bs), lambda bi, si: (bi, si)),
-        pl.BlockSpec((1, bs, khd), lambda bi, si: (bi, si, 0)),
-        pl.BlockSpec((1, bs, khd), lambda bi, si: (bi, si, 0)),
+        pl.BlockSpec((1, khgp, dh), lambda bi, si, lb: (bi, 0, 0)),
+        pl.BlockSpec((1, khd), lambda bi, si, lb: (bi, 0)),
+        pl.BlockSpec((1, khd), lambda bi, si, lb: (bi, 0)),
+        pl.BlockSpec((1, bs), lambda bi, si, lb: (bi, clamp(si, lb))),
+        pl.BlockSpec((1, bs, khd),
+                     lambda bi, si, lb: (bi, clamp(si, lb), 0)),
+        pl.BlockSpec((1, bs, khd),
+                     lambda bi, si, lb: (bi, clamp(si, lb), 0)),
     ]
     args = [q3, kn2, vn2, bias, kc, vc]
     quant = ks is not None
     if quant:
         in_specs += [
-            pl.BlockSpec((1, kheads, bs), lambda bi, si: (bi, 0, si)),
-            pl.BlockSpec((1, kheads, bs), lambda bi, si: (bi, 0, si)),
+            pl.BlockSpec((1, kheads, bs),
+                         lambda bi, si, lb: (bi, 0, clamp(si, lb))),
+            pl.BlockSpec((1, kheads, bs),
+                         lambda bi, si, lb: (bi, 0, clamp(si, lb))),
         ]
         args += [ks, vs]
 
     kw = dict(kheads=kheads, dh=dh, bs=bs, s=s, scale=scale,
               softcap=softcap)
     if quant:
-        def kernel(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
+        def kernel(lb_ref, q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
                    ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref):
-            _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
+            _body(lb_ref, q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
                   ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, **kw)
     else:
-        def kernel(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
+        def kernel(lb_ref, q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
                    o_ref, m_ref, l_ref, acc_ref):
-            _body(q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
+            _body(lb_ref, q_ref, kn_ref, vn_ref, bias_ref, k_ref, v_ref,
                   None, None, o_ref, m_ref, l_ref, acc_ref, **kw)
 
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b, khgp, dh), jnp.float32),
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(b, ns),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, khgp, dh), lambda bi, si: (bi, 0, 0)),
+        out_specs=pl.BlockSpec((1, khgp, dh),
+                               lambda bi, si, lb: (bi, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((khgp, 128), jnp.float32),   # m
             pltpu.VMEM((khgp, 128), jnp.float32),   # l
             pltpu.VMEM((khgp, dh), jnp.float32),    # acc
         ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, khgp, dh), jnp.float32),
+        grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(*args)
+    )(last_blk, *args)
 
 
 def flash_decode_attention(
@@ -189,6 +217,7 @@ def flash_decode_attention(
     bias: Optional[jnp.ndarray] = None,         # [B, S] fp32 additive
     k_scale: Optional[jnp.ndarray] = None,  # [B, K, S] fp32 (int8 cache)
     v_scale: Optional[jnp.ndarray] = None,
+    kv_fill: Optional[jnp.ndarray] = None,  # scalar: valid cols < fill
     softmax_scale: Optional[float] = None,
     window: Optional[int] = None,
     logit_softcap: float = 0.0,
@@ -203,7 +232,12 @@ def flash_decode_attention(
     in VMEM. Masking comes either as a precomputed additive ``bias``
     [B, S] (0 = attend, NEG_INF = masked; callers looping over layers
     build it ONCE per decode step) or as kv_valid/positions/window from
-    which it is built here. Returns [B, 1, H, D] in v_new.dtype."""
+    which it is built here. ``kv_fill`` (scalar int32) promises every
+    valid cache column sits below it: KV blocks past the fill level are
+    neither read from HBM nor computed, so a right-sized caller (the
+    decode engine: fill = prompt_width + step) pays for the cache it
+    has actually written, not the preallocated max_new_tokens worth.
+    Returns [B, 1, H, D] in v_new.dtype."""
     b, t, h, d = q.shape
     assert t == 1, "flash_decode_attention is single-token by construction"
     _, s, kheads, _ = k_cache.shape
@@ -242,7 +276,10 @@ def flash_decode_attention(
         ks = k_scale.astype(jnp.float32)
         vs = v_scale.astype(jnp.float32)
 
-    out = _call(q3, kn2, vn2, bias, kc, vc, ks, vs, float(scale),
-                int(block_s), bool(interpret), float(logit_softcap))
+    if kv_fill is None:
+        kv_fill = jnp.asarray(s, jnp.int32)  # no bound known: read all
+    out = _call(q3, kn2, vn2, bias, kc, vc, ks, vs, kv_fill,
+                float(scale), int(block_s), bool(interpret),
+                float(logit_softcap))
     out = out.reshape(b, kheads, GP, d)[:, :, :g, :]
     return out.reshape(b, 1, h, d).astype(v_new.dtype)

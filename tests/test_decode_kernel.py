@@ -155,6 +155,40 @@ def test_decode_step_int8_cache_uses_kernel_and_matches():
                                   np.asarray(cache_x["valid"]))
 
 
+def test_decode_kernel_kv_fill_skips_tail_blocks():
+    """With kv_fill set, cache content BEYOND the fill level must be
+    unread: plant NaN there and require identical output to a zeroed
+    tail. S=390 at block_s=128 spans 4 ragged blocks; fill=150 keeps
+    blocks 0-1 active and clamps blocks 2-3 away."""
+    b, s, h, kh, d = 2, 390, 8, 4, 128   # bs=128 -> 4 ragged blocks
+    fill = 150
+    q = jnp.asarray(RNG.randn(b, 1, h, d), jnp.bfloat16)
+    kc = jnp.asarray(RNG.randn(b, s, kh, d), jnp.bfloat16)
+    vc = jnp.asarray(RNG.randn(b, s, kh, d), jnp.bfloat16)
+    kn = jnp.asarray(RNG.randn(b, 1, kh, d), jnp.bfloat16)
+    vn = jnp.asarray(RNG.randn(b, 1, kh, d), jnp.bfloat16)
+    valid = jnp.asarray(RNG.rand(b, s) < 0.8) & (
+        jnp.arange(s)[None, :] < fill)
+    qpos = jnp.full((b, 1), s, jnp.int32)
+    kpos = jnp.broadcast_to(jnp.arange(s)[None], (b, s)).astype(jnp.int32)
+    kw = dict(kv_valid=valid, q_positions=qpos, kv_positions=kpos,
+              kv_fill=jnp.asarray(fill, jnp.int32), block_s=128)
+    poison = jnp.where(jnp.arange(s)[None, :, None, None] >= fill,
+                       jnp.nan, 0.0).astype(jnp.bfloat16)
+    out_clean = flash_decode_attention(q, kc, vc, kn, vn, **kw)
+    out_poison = flash_decode_attention(q, kc + poison, vc + poison,
+                                        kn, vn, **kw)
+    assert np.isfinite(np.asarray(out_poison, np.float32)).all()
+    np.testing.assert_array_equal(np.asarray(out_clean),
+                                  np.asarray(out_poison))
+    # and the bounded result equals the unbounded one
+    out_full = flash_decode_attention(q, kc, vc, kn, vn,
+                                      **{**kw, "kv_fill": None})
+    np.testing.assert_allclose(np.asarray(out_clean, np.float32),
+                               np.asarray(out_full, np.float32),
+                               atol=2e-3)
+
+
 def test_decode_kernel_softcap_matches_xla():
     """Static logit softcapping (gemma-2) inside the kernel == the XLA
     decode_attention softcap path."""
