@@ -86,6 +86,12 @@ class ModelConfig:
     # traffic on the bandwidth-bound decode loop; dequantize fuses into
     # the attention einsum). Training/prefill attention is unaffected.
     kv_cache_dtype: str = "bfloat16"
+    # Pallas decode-attention kernel selection: "auto" engages it for
+    # int8 caches (where in-VMEM dequant is the measured win); "on"
+    # additionally routes bf16 caches through it (fill-bounded reads vs
+    # the XLA einsum's full-S reads — sweepable per chip); "off" forces
+    # the XLA decode_attention path everywhere.
+    decode_kernel: str = "auto"
     # flash kernel tile sizes (0 = the kernel's measured default, 512).
     # 512-wide blocks measured ~1.8x faster than 128 on v5e; exposed so
     # new chip generations / unusual shapes can retune without a fork.
@@ -145,6 +151,11 @@ class ModelConfig:
                 f"kv_cache_dtype must be 'bfloat16' or 'int8', got "
                 f"{self.kv_cache_dtype!r} — a typo here would silently "
                 "run the full-precision cache")
+        if self.decode_kernel not in ("auto", "on", "off"):
+            raise ValueError(
+                f"decode_kernel must be 'auto', 'on' or 'off', got "
+                f"{self.decode_kernel!r} — a typo here would silently "
+                "fall back to the XLA decode path")
         if self.num_experts > 0:
             if self.arch != "llama":
                 raise ValueError(

@@ -1474,11 +1474,30 @@ class Transformer:
         # copy). Softcap is a static kernel param; gemma-2's alternating
         # per-layer windows become a two-bias select below.
         from dla_tpu.ops.decode_kernel import GP as _KGP
-        use_decode_kernel = (
-            self._kv_int8
+        kernel_eligible = (
+            cfg.decode_kernel != "off"
             and cfg.head_dim_ % 128 == 0
             and cfg.num_heads // cfg.num_kv_heads <= _KGP
             and _flash_mesh() is None)
+        # "auto": int8 caches only (in-VMEM dequant is the measured
+        # win); "on": bf16 caches too (fill-bounded reads vs the XLA
+        # einsum's full-S reads)
+        use_decode_kernel = kernel_eligible and (
+            self._kv_int8 or cfg.decode_kernel == "on")
+        if cfg.decode_kernel == "on" and not kernel_eligible:
+            # an EXPLICIT kernel request degrading to the XLA path must
+            # not be silent: a sweep recording "kernel" numbers would
+            # actually measure the einsum (same one-time-per-shape
+            # discipline as the int8-dense fallback log above)
+            key = ("decode_kernel_on", cfg.head_dim_, cfg.num_heads,
+                   tokens.shape)
+            if key not in _REPLICATED_FLASH_LOGGED and \
+                    jax.process_index() == 0:
+                _REPLICATED_FLASH_LOGGED.add(key)
+                print("[dla_tpu][decode] decode_kernel: 'on' requested "
+                      "but ineligible (head_dim % 128 != 0, GQA group "
+                      f"> {_KGP}, or multi-device auto mesh) — decoding "
+                      "via the XLA path", file=sys.stderr, flush=True)
 
         attn_bias = attn_bias_win = None
         if use_decode_kernel:

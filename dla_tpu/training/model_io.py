@@ -64,7 +64,7 @@ def _arch_overrides(model_cfg: Dict[str, Any]) -> Dict[str, Any]:
         out["attention"] = ("flash" if model_cfg["use_flash_attention"]
                             else "xla")
     for key in ("dtype", "param_dtype", "remat", "vocab_size", "attention",
-                "kv_cache_dtype",
+                "kv_cache_dtype", "decode_kernel",
                 "context_parallel", "arch", "rotary_pct", "attention_bias",
                 "sliding_window", "sliding_window_pattern",
                 "attn_logit_softcap", "final_logit_softcap",

@@ -280,6 +280,48 @@ def test_int8_matmul_leading_dims_and_1d_scale():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_decode_kernel_on_bf16_cache_matches_off():
+    """decode_kernel: \"on\" routes a bf16 cache through the kernel;
+    results must match decode_kernel: \"off\" (the XLA path) on the
+    same params — multi-step, with a padded row."""
+    import dataclasses as dc
+    cfg_on = _hd128_cfg(decode_kernel="on")
+    cfg_off = dc.replace(cfg_on, decode_kernel="off")
+    m_on, m_off = Transformer(cfg_on), Transformer(cfg_off)
+    params = m_on.init(jax.random.key(2))
+    b, t, n = 2, 10, 3
+    ids = jnp.asarray(RNG.randint(3, 250, (b, t)), jnp.int32)
+    mask = jnp.ones((b, t), jnp.int32)
+    mask = mask.at[0, t - 2:].set(0)
+    l_on, c_on = m_on.start_decode(params, ids, mask, n)
+    l_off, c_off = m_off.start_decode(params, ids, mask, n)
+    np.testing.assert_array_equal(np.asarray(l_on), np.asarray(l_off))
+    tok = jnp.argmax(l_on, -1).astype(jnp.int32)
+
+    # spy on the kernel so a silently-closed gate cannot make this test
+    # vacuously compare XLA against XLA
+    from dla_tpu.ops import decode_kernel as dk
+    calls = []
+    real = dk.flash_decode_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    dk.flash_decode_attention = spy
+    try:
+        for _ in range(n):
+            l_on, c_on = m_on.decode_step(params, c_on, tok)
+            l_off, c_off = m_off.decode_step(params, c_off, tok)
+            np.testing.assert_allclose(np.asarray(l_on, np.float32),
+                                       np.asarray(l_off, np.float32),
+                                       atol=0.05, rtol=0.05)
+            tok = jnp.argmax(l_on, -1).astype(jnp.int32)
+    finally:
+        dk.flash_decode_attention = real
+    assert calls, "decode_kernel='on' never reached the Pallas kernel"
+
+
 def test_int8_matmul_blocks_shrink_to_fit_vmem():
     """Big-K shapes (7B/70B intermediate sizes) must auto-shrink the N
     block instead of overflowing VMEM — `_dense` cannot pass block

@@ -22,6 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def run_variant(name: str, *, batch=8, prompt=128, new=256,
                 kv_dtype="bfloat16", weights="bfloat16",
+                decode_kernel="auto",
                 hidden=1024, inter=2816, layers=24,
                 heads=8, kv_heads=4) -> dict:
     import jax
@@ -39,7 +40,7 @@ def run_variant(name: str, *, batch=8, prompt=128, new=256,
         num_layers=layers, num_heads=heads, num_kv_heads=kv_heads,
         max_seq_length=4096, attention="flash", remat="none",
         dtype="bfloat16", param_dtype="bfloat16",
-        kv_cache_dtype=kv_dtype)
+        kv_cache_dtype=kv_dtype, decode_kernel=decode_kernel)
     model = Transformer(cfg)
     params = model.init(jax.random.key(0))
     if weights == "int8":   # the rollout_quantize_weights path
@@ -115,6 +116,12 @@ VARIANTS = {
     "b64_n128_bf16": dict(batch=64, prompt=128, new=128),
     "b64_n128_w8": dict(batch=64, prompt=128, new=128, weights="int8"),
     "b8_w8": dict(batch=8, weights="int8"),
+    # bf16 cache THROUGH the pallas decode kernel (decode_kernel: on):
+    # fill-bounded reads vs the XLA einsum's full-S reads — decides
+    # whether "on" should become the bf16 default
+    "b64_n128_bf16_kernel": dict(batch=64, prompt=128, new=128,
+                                 decode_kernel="on"),
+    "b8_bf16_kernel": dict(batch=8, decode_kernel="on"),
 }
 
 
