@@ -36,7 +36,16 @@ from dla_tpu.ops.sampling import sample_token
 @dataclasses.dataclass(frozen=True)
 class GenerationConfig:
     """Mirrors the reference's generation_params / sampling blocks
-    (config/rlhf_config.yaml:19-22, config/eval_config.yaml generation)."""
+    (config/rlhf_config.yaml:19-22, config/eval_config.yaml generation).
+
+    ``early_exit_chunk``: 0 keeps the per-step early-exit while_loop;
+    C > 0 runs a while_loop over CHUNKS of C scan steps instead —
+    the inner loop gets lax.scan's tighter codegen (profile_decode
+    measured the per-step while_loop ~14% slower per step on-chip)
+    while early exit keeps a C-token granularity. Outputs are
+    bit-identical to both other schedules (same pre-split rng keys
+    indexed by absolute step; finished rows emit pad with a zero
+    mask)."""
     max_new_tokens: int = 128
     temperature: float = 1.0
     top_p: float = 1.0
@@ -44,6 +53,7 @@ class GenerationConfig:
     do_sample: bool = True
     eos_token_id: int = 2
     pad_token_id: int = 0
+    early_exit_chunk: int = 0
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]], **defaults) -> "GenerationConfig":
@@ -104,7 +114,51 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig):
             logits, cache = model.decode_step(params, cache, tok)
             return tok, emit_mask, logits, cache, done
 
-        if gen.eos_token_id is not None and gen.eos_token_id >= 0:
+        if (gen.eos_token_id is not None and gen.eos_token_id >= 0
+                and gen.early_exit_chunk > 0 and n > 0):
+            # chunked early exit: while_loop over chunks, lax.scan of C
+            # steps inside. Inner steps get scan's codegen; the done
+            # check runs between chunks. Steps past n in the final
+            # ragged chunk compute into clamped/padded slots that are
+            # sliced away (their emit mask is zero; the cache is dead
+            # after generation), so outputs match the per-step paths.
+            c = min(int(gen.early_exit_chunk), n)
+            nc = -(-n // c)
+            toks0 = jnp.full((nc * c, b), gen.pad_token_id, jnp.int32)
+            emits0 = jnp.zeros((nc * c, b), bool)
+
+            def chunk_cond(state):
+                chunk, _, _, done, _, _ = state
+                return (chunk < nc) & ~jnp.all(done)
+
+            def chunk_body(state):
+                chunk, logits, cache, done, toks, emits = state
+
+                def inner(carry, i):
+                    logits, cache, done = carry
+                    step = chunk * c + i
+                    # absolute step indexes the same pre-split keys;
+                    # ragged-tail steps (>= n) reuse the last key (n-1)
+                    # (their output is pad with a zero mask either way)
+                    tok, emit_mask, logits, cache, done = step_fn(
+                        jnp.minimum(step, n - 1), logits, cache, done)
+                    emit_mask = emit_mask & (step < n)
+                    tok = jnp.where(step < n, tok, gen.pad_token_id)
+                    return (logits, cache, done), (tok, emit_mask)
+
+                (logits, cache, done), (ctoks, cemits) = jax.lax.scan(
+                    inner, (logits, cache, done), jnp.arange(c))
+                toks = jax.lax.dynamic_update_slice(
+                    toks, ctoks, (chunk * c, 0))
+                emits = jax.lax.dynamic_update_slice(
+                    emits, cemits, (chunk * c, 0))
+                return chunk + 1, logits, cache, done, toks, emits
+
+            *_, toks, emits = jax.lax.while_loop(
+                chunk_cond, chunk_body,
+                (jnp.int32(0), logits, cache, done0, toks0, emits0))
+            toks, emits = toks[:n], emits[:n]
+        elif gen.eos_token_id is not None and gen.eos_token_id >= 0:
             # early exit: a while_loop that stops once every row has hit
             # EOS — real savings for eval/teacher-gen/rollout batches
             # whose sequences finish before max_new_tokens. Identical

@@ -142,6 +142,35 @@ def test_early_exit_while_matches_scan_path(model_and_params):
                                       np.asarray(out_while[k]), err_msg=k)
 
 
+def test_chunked_early_exit_matches_per_step_while(model_and_params):
+    """early_exit_chunk > 0 (while over chunks, scan of C steps inside)
+    must be bit-identical to the per-step while path — both when EOS
+    fires mid-sequence (incl. a ragged final chunk, C not dividing n)
+    and when it never fires."""
+    model, params = model_and_params
+    import dataclasses
+
+    from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
+
+    rs = np.random.RandomState(11)
+    ids = jnp.asarray(rs.randint(3, 100, (2, 8)), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    for eos, n, c in [(-1, 6, 4), (0, 6, 4), (0, 7, 3), (0, 5, 8)]:
+        base = GenerationConfig(max_new_tokens=n, do_sample=True,
+                                temperature=1.0, pad_token_id=0,
+                                eos_token_id=eos if eos >= 0
+                                else model.cfg.vocab_size + 7)
+        ref = jax.jit(build_generate_fn(model, base))(
+            params, ids, mask, jax.random.key(5))
+        chunked = dataclasses.replace(base, early_exit_chunk=c)
+        out = jax.jit(build_generate_fn(model, chunked))(
+            params, ids, mask, jax.random.key(5))
+        for k in ref:
+            np.testing.assert_array_equal(
+                np.asarray(ref[k]), np.asarray(out[k]),
+                err_msg=f"{k} eos={eos} n={n} c={c}")
+
+
 def test_early_exit_actually_exits_and_matches_masked_scan(
         model_and_params):
     """When EOS really fires mid-sequence, the while path must equal the

@@ -97,13 +97,14 @@ def main() -> None:
         3, cfg.vocab_size - 1, (b, prompt)), jnp.int32)
     mask = jnp.ones((b, prompt), jnp.int32)
 
-    def engine_ms(eos):
+    def engine_ms(eos, chunk=0):
         # differential over max_new_tokens: cancels RTT AND prefill
         from dla_tpu.eval.eval_latency import _sync
 
         def best_of(n_new):
             gen = GenerationConfig(max_new_tokens=n_new, do_sample=True,
-                                   temperature=1.0, eos_token_id=eos)
+                                   temperature=1.0, eos_token_id=eos,
+                                   early_exit_chunk=chunk)
             fn = jax.jit(build_generate_fn(model, gen))
             _sync(fn(params, ids, mask, jax.random.key(0)))
             best = float("inf")
@@ -116,7 +117,9 @@ def main() -> None:
         return (best_of(new) - best_of(new // 2)) / (new // 2) * 1000
 
     res["engine(scan)"] = engine_ms(-1)
-    res["engine(while)"] = engine_ms(cfg.vocab_size + 7)  # unreachable eos
+    unreachable = cfg.vocab_size + 7  # eos never fires: all n steps run
+    res["engine(while)"] = engine_ms(unreachable)
+    res["engine(chunk16)"] = engine_ms(unreachable, chunk=16)
 
     # ---- isolated decode_step loop (no prefill in the timing) --------
     # timed from the fresh post-prefill state; fill level does not move
