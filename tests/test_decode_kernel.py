@@ -326,14 +326,27 @@ def test_int8_matmul_blocks_shrink_to_fit_vmem():
     """Big-K shapes (7B/70B intermediate sizes) must auto-shrink the N
     block instead of overflowing VMEM — `_dense` cannot pass block
     overrides (r5 review finding)."""
-    from dla_tpu.ops.quant_matmul import _VMEM_BUDGET, _pick_blocks
+    from dla_tpu.ops.quant_matmul import (
+        _VMEM_BUDGET,
+        DEFAULT_BLOCK_M,
+        DEFAULT_BLOCK_N,
+        _pick_blocks,
+    )
     for m, k, n in [(256, 11008, 4096), (64, 28672, 8192),
                     (8192, 2816, 1024), (64, 1024, 32000)]:
-        bm, bn = _pick_blocks(m, k, n, 256, 512)
+        bm, bn = _pick_blocks(m, k, n, DEFAULT_BLOCK_M, DEFAULT_BLOCK_N)
         assert bm * k * 2 + 2 * k * bn + 2 * bm * bn * 2 <= _VMEM_BUDGET
         assert bn >= 128 and bm >= 16
-    # small shapes keep the defaults (no needless grid fragmentation)
-    assert _pick_blocks(64, 2816, 2816, 256, 512) == (64, 512)
+    # moderate shapes keep the shipped default N tile (no needless grid
+    # fragmentation)...
+    assert _pick_blocks(64, 2816, 2816, DEFAULT_BLOCK_M, DEFAULT_BLOCK_N
+                        ) == (64, DEFAULT_BLOCK_N)
+    # ...and small-N projections clamp the tile to the (lane-aligned)
+    # array instead of buffering phantom columns
+    assert _pick_blocks(64, 1024, 256, DEFAULT_BLOCK_M, DEFAULT_BLOCK_N
+                        ) == (64, 256)
+    assert _pick_blocks(8, 1024, 100, DEFAULT_BLOCK_M, DEFAULT_BLOCK_N
+                        ) == (16, 128)
 
 
 def test_quantized_tree_decode_matches_fp_within_tolerance():
