@@ -25,8 +25,19 @@ from dla_tpu.ops.rotary import validate_rope_scaling
 
 def _validated_rope_scaling(hf_cfg):
     """rope_scaling from a config.json, normalized/refused by the one
-    whitelist ops/rotary.py implements (None for default-type dicts)."""
-    return validate_rope_scaling(hf_cfg.get("rope_scaling"))
+    whitelist ops/rotary.py implements (None for default-type dicts).
+    YaRN dicts omitting original_max_position_embeddings get the
+    checkpoint's max_position_embeddings injected — HF's own fallback,
+    which ops/rotary cannot see from inside the op."""
+    rs = validate_rope_scaling(hf_cfg.get("rope_scaling"))
+    rope_type = rs and str(rs.get("rope_type")
+                           or rs.get("type") or "").lower()
+    if (rope_type == "yarn"
+            and "original_max_position_embeddings" not in rs
+            and "max_position_embeddings" in hf_cfg):
+        rs["original_max_position_embeddings"] = int(
+            hf_cfg["max_position_embeddings"])
+    return rs
 
 
 def hf_config_to_model_config(hf_cfg: Dict[str, Any], **overrides) -> ModelConfig:

@@ -24,29 +24,92 @@ def validate_rope_scaling(scaling: Optional[Dict[str, Any]]
                     or scaling.get("type") or "default").lower()
     if rope_type in ("default", "none"):
         return None
-    if rope_type not in ("llama3", "linear"):
+    if rope_type not in ("llama3", "linear", "yarn"):
         raise NotImplementedError(
             f"rope_scaling type '{rope_type}' is not supported "
-            "(implemented: llama3, linear)")
+            "(implemented: llama3, linear, yarn)")
     return dict(scaling)
 
 
-def _scale_inv_freq(inv_freq: jnp.ndarray,
-                    scaling: Dict[str, Any]) -> jnp.ndarray:
-    """Frequency remapping for extended-context checkpoints.
+def _scale_inv_freq(inv_freq: jnp.ndarray, scaling: Dict[str, Any],
+                    head_dim: int, theta: float
+                    ) -> Tuple[jnp.ndarray, float]:
+    """Frequency remapping for extended-context checkpoints. Returns
+    (scaled inv_freq, attention scale multiplier for cos/sin).
 
     ``llama3`` (llama-3.1/3.2, HF modeling_rope_utils
     _compute_llama3_parameters): wavelengths shorter than the
     high-frequency cutoff keep their frequency, longer than the
     low-frequency cutoff divide by ``factor``, and the band between
     interpolates smoothly. ``linear`` divides every frequency by
-    ``factor`` (position-interpolation scaling).
+    ``factor`` (position-interpolation scaling). ``yarn`` (qwen2.5-1M
+    and friends, HF _compute_yarn_parameters): NTK-by-parts — dims
+    whose full rotations at the ORIGINAL context exceed ``beta_fast``
+    extrapolate (unchanged), dims below ``beta_slow`` interpolate
+    (divide by factor), a linear ramp blends the band between; cos/sin
+    additionally scale by ``attention_factor`` (default
+    0.1*ln(factor)+1), the YaRN temperature on attention entropy.
     """
     rope_type = str(scaling.get("rope_type")
                     or scaling.get("type") or "default").lower()
     factor = float(scaling.get("factor", 1.0))
     if rope_type == "linear":
-        return inv_freq / factor
+        return inv_freq / factor, 1.0
+    if rope_type == "yarn":
+        # mirrors HF modeling_rope_utils._compute_yarn_parameters
+        # key for key (incl. mscale/mscale_all_dim, truncate, and the
+        # `or`-style beta defaults); parity pinned against
+        # ROPE_INIT_FUNCTIONS["yarn"] in tests/test_qwen2_import.py
+        beta_fast = float(scaling.get("beta_fast") or 32.0)
+        beta_slow = float(scaling.get("beta_slow") or 1.0)
+        if "original_max_position_embeddings" not in scaling:
+            # HF falls back to the MODEL's max_position_embeddings,
+            # which this op cannot see — the HF importer injects it
+            # (hf_import._validated_rope_scaling); a hand-built config
+            # must carry it explicitly rather than get a silent guess
+            raise ValueError(
+                "yarn rope_scaling needs original_max_position_"
+                "embeddings (the HF importer injects the checkpoint's "
+                "max_position_embeddings when the dict omits it)")
+        old_ctx = float(scaling["original_max_position_embeddings"])
+
+        def get_mscale(scale: float, m: float = 1.0) -> float:
+            if scale <= 1.0:
+                return 1.0
+            return 0.1 * m * math.log(scale) + 1.0
+
+        attn = scaling.get("attention_factor")
+        if attn is None:
+            mscale = scaling.get("mscale")
+            mscale_all = scaling.get("mscale_all_dim")
+            if mscale and mscale_all:
+                attn = float(get_mscale(factor, mscale)
+                             / get_mscale(factor, mscale_all))
+            else:
+                attn = get_mscale(factor)
+        else:
+            attn = float(attn)
+
+        def correction_dim(n_rot: float) -> float:
+            # the (fractional) dim index whose wavelength completes
+            # n_rot rotations over the original context
+            return (head_dim
+                    * math.log(old_ctx / (n_rot * 2.0 * math.pi))
+                    / (2.0 * math.log(theta)))
+
+        low = correction_dim(beta_fast)
+        high = correction_dim(beta_slow)
+        if scaling.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, head_dim - 1)
+        if low == high:
+            high += 0.001  # HF's degenerate-ramp guard
+        ramp = (jnp.arange(head_dim // 2, dtype=jnp.float32) - low) \
+            / (high - low)
+        extrap_mask = 1.0 - jnp.clip(ramp, 0.0, 1.0)
+        scaled = (inv_freq / factor * (1.0 - extrap_mask)
+                  + inv_freq * extrap_mask)
+        return scaled, attn
     # validate_rope_scaling is the one whitelist; anything else reaching
     # here is a programming error, not a user-config error
     assert rope_type == "llama3", rope_type
@@ -59,7 +122,7 @@ def _scale_inv_freq(inv_freq: jnp.ndarray,
                     + smooth * inv_freq)
     out = jnp.where(wavelen > old_ctx / low, inv_freq / factor,
                     interpolated)
-    return jnp.where(wavelen < old_ctx / high, inv_freq, out)
+    return jnp.where(wavelen < old_ctx / high, inv_freq, out), 1.0
 
 
 def rotary_angles(positions: jnp.ndarray, head_dim: int,
@@ -67,13 +130,17 @@ def rotary_angles(positions: jnp.ndarray, head_dim: int,
                   scaling: Optional[Dict[str, Any]] = None,
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """positions [..., T] int -> (cos, sin) each [..., T, head_dim//2], fp32.
-    ``scaling``: HF ``rope_scaling`` dict (llama3 / linear), see
+    ``scaling``: HF ``rope_scaling`` dict (llama3 / linear / yarn), see
     _scale_inv_freq."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     scaling = validate_rope_scaling(scaling)  # the ONE whitelist
+    attn_scale = 1.0
     if scaling:
-        inv_freq = _scale_inv_freq(inv_freq, scaling)
+        inv_freq, attn_scale = _scale_inv_freq(inv_freq, scaling,
+                                               head_dim, theta)
     ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
+    if attn_scale != 1.0:
+        return jnp.cos(ang) * attn_scale, jnp.sin(ang) * attn_scale
     return jnp.cos(ang), jnp.sin(ang)
 
 

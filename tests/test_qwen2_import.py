@@ -96,6 +96,104 @@ def test_qwen2_import_matches_hf_logits(tiny_qwen_dir):
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-4)
 
 
+def test_qwen2_yarn_rope_matches_hf():
+    """YaRN rope scaling (qwen2.5-1M-style long-context checkpoints):
+    scaled inv_freq and the attention factor must match transformers'
+    _compute_yarn_parameters exactly, and a yarn-configured tiny model
+    must hit logits parity end to end."""
+    import jax.numpy as jnp
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from dla_tpu.ops.rotary import _scale_inv_freq, validate_rope_scaling
+
+    # unit parity across factors / contexts / head dims, plus the
+    # deepseek-style mscale pair, truncate=False fractional bounds,
+    # and the factor<=1 mscale guard
+    cases = [
+        dict(factor=4.0, original_max_position_embeddings=64),
+        dict(factor=2.5, original_max_position_embeddings=128),
+        dict(factor=32.0, original_max_position_embeddings=32),
+        dict(factor=40.0, original_max_position_embeddings=64,
+             mscale=1.0, mscale_all_dim=1.0),
+        dict(factor=8.0, original_max_position_embeddings=64,
+             mscale=0.707, mscale_all_dim=1.2),
+        dict(factor=4.0, original_max_position_embeddings=64,
+             truncate=False),
+        dict(factor=1.0, original_max_position_embeddings=64),
+        dict(factor=4.0, original_max_position_embeddings=64,
+             attention_factor=2.5),
+    ]
+    for hd, theta in [(8, 1e6), (16, 1e4), (64, 1e6)]:
+        for case in cases:
+            sc = {"rope_type": "yarn", **case}
+            c = Qwen2Config(
+                vocab_size=160, hidden_size=hd * 4, intermediate_size=96,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, rope_theta=theta,
+                max_position_embeddings=int(
+                    case["original_max_position_embeddings"]
+                    * case["factor"]),
+                rope_scaling=dict(sc))
+            inv_hf, att_hf = ROPE_INIT_FUNCTIONS["yarn"](c, device="cpu")
+            inv0 = 1.0 / (theta ** (jnp.arange(0, hd, 2,
+                                               dtype=jnp.float32) / hd))
+            inv_j, att_j = _scale_inv_freq(
+                inv0, validate_rope_scaling(sc), hd, theta)
+            np.testing.assert_allclose(
+                np.asarray(inv_j), inv_hf.numpy(), rtol=1e-6,
+                err_msg=f"hd={hd} {case}")
+            assert abs(att_j - float(att_hf)) < 1e-9, (hd, case)
+
+    # a yarn dict omitting original_max_position_embeddings gets the
+    # checkpoint's max_position_embeddings injected at import (HF's own
+    # fallback), and the bare op refuses rather than guessing
+    from dla_tpu.models.hf_import import _validated_rope_scaling
+    injected = _validated_rope_scaling(
+        {"rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+         "max_position_embeddings": 1024})
+    assert injected["original_max_position_embeddings"] == 1024
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="original_max_position"):
+        _scale_inv_freq(
+            1.0 / (1e6 ** (jnp.arange(0, 8, 2, dtype=jnp.float32) / 8)),
+            {"rope_type": "yarn", "factor": 4.0}, 8, 1e6)
+
+    # end-to-end logits parity on a yarn-configured tiny qwen2
+    from dla_tpu.models.hf_import import (
+        hf_config_to_model_config,
+        import_hf_weights,
+        read_hf_config,
+    )
+    from dla_tpu.models.transformer import Transformer
+    import tempfile
+
+    hf_cfg = Qwen2Config(
+        vocab_size=160, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=1e6,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 64})
+    torch.manual_seed(1)
+    hf_model = Qwen2ForCausalLM(hf_cfg).eval()
+    with tempfile.TemporaryDirectory() as d:
+        hf_model.save_pretrained(d, safe_serialization=True)
+        cfg = hf_config_to_model_config(
+            read_hf_config(d), dtype="float32", param_dtype="float32",
+            remat="none")
+        assert cfg.rope_scaling and \
+            cfg.rope_scaling.get("rope_type") == "yarn"
+        params = import_hf_weights(d, cfg)
+    model = Transformer(cfg)
+    rs = np.random.RandomState(3)
+    ids = rs.randint(0, 160, (2, 90))  # past the original 64 context
+    ours = np.asarray(model.apply(params, jnp.asarray(ids, np.int32)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-4)
+
+
 def test_qwen2_preset_param_tree_matches_specs():
     import jax
     from dla_tpu.models.config import get_model_config
