@@ -30,13 +30,27 @@ def _validated_rope_scaling(hf_cfg):
     checkpoint's max_position_embeddings injected — HF's own fallback,
     which ops/rotary cannot see from inside the op."""
     rs = validate_rope_scaling(hf_cfg.get("rope_scaling"))
-    rope_type = rs and str(rs.get("rope_type")
-                           or rs.get("type") or "").lower()
+    rope_type = rs and rs["rope_type"]  # normalized by validate
     if (rope_type == "yarn"
             and "original_max_position_embeddings" not in rs
             and "max_position_embeddings" in hf_cfg):
         rs["original_max_position_embeddings"] = int(
             hf_cfg["max_position_embeddings"])
+    if rope_type == "longrope":
+        # phi-3 keeps the pretraining context at the TOP level of
+        # config.json and derives the attention factor from the
+        # extension ratio (HF _compute_longrope_parameters); fold both
+        # into the dict so ops/rotary needs no config back-reference.
+        # The TOP-LEVEL value wins over a dict-level one — HF reads the
+        # config attribute for both the switch point and the factor.
+        max_pos = hf_cfg.get("max_position_embeddings")
+        orig = hf_cfg.get("original_max_position_embeddings")
+        if orig:
+            rs["original_max_position_embeddings"] = int(orig)
+            if max_pos:
+                rs["factor"] = float(max_pos) / float(orig)
+        elif ("original_max_position_embeddings" not in rs and max_pos):
+            rs["original_max_position_embeddings"] = int(max_pos)
     return rs
 
 

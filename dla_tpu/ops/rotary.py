@@ -24,11 +24,16 @@ def validate_rope_scaling(scaling: Optional[Dict[str, Any]]
                     or scaling.get("type") or "default").lower()
     if rope_type in ("default", "none"):
         return None
-    if rope_type not in ("llama3", "linear", "yarn"):
+    if rope_type == "su":  # phi-3's pre-release name for longrope
+        rope_type = "longrope"
+    if rope_type not in ("llama3", "linear", "yarn", "longrope"):
         raise NotImplementedError(
             f"rope_scaling type '{rope_type}' is not supported "
-            "(implemented: llama3, linear, yarn)")
-    return dict(scaling)
+            "(implemented: llama3, linear, yarn, longrope)")
+    out = dict(scaling)
+    out["rope_type"] = rope_type   # normalized: consumers read ONE key
+    out.pop("type", None)
+    return out
 
 
 def _scale_inv_freq(inv_freq: jnp.ndarray, scaling: Dict[str, Any],
@@ -50,8 +55,7 @@ def _scale_inv_freq(inv_freq: jnp.ndarray, scaling: Dict[str, Any],
     additionally scale by ``attention_factor`` (default
     0.1*ln(factor)+1), the YaRN temperature on attention entropy.
     """
-    rope_type = str(scaling.get("rope_type")
-                    or scaling.get("type") or "default").lower()
+    rope_type = scaling["rope_type"]  # normalized by validate_rope_scaling
     factor = float(scaling.get("factor", 1.0))
     if rope_type == "linear":
         return inv_freq / factor, 1.0
@@ -125,19 +129,58 @@ def _scale_inv_freq(inv_freq: jnp.ndarray, scaling: Dict[str, Any],
     return jnp.where(wavelen < old_ctx / high, inv_freq, out), 1.0
 
 
+def _longrope_inv_freq(inv_freq: jnp.ndarray, scaling: Dict[str, Any],
+                       positions: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, float]:
+    """LongRoPE (phi-3 128k, HF _compute_longrope_parameters +
+    longrope_frequency_update): per-dim rescale factor LISTS, the short
+    list while max(position)+1 <= original context and the long list
+    beyond — a TRACED select, matching HF's dynamic frequency update
+    (their switch mid-generation and ours agree). cos/sin scale by
+    attention_factor (default sqrt(1 + ln(factor)/ln(original_ctx)))."""
+    if "original_max_position_embeddings" not in scaling:
+        raise ValueError(
+            "longrope rope_scaling needs original_max_position_"
+            "embeddings (the HF importer injects it from the "
+            "checkpoint's top-level config)")
+    orig = int(scaling["original_max_position_embeddings"])
+    half = inv_freq.shape[0]
+    if "short_factor" not in scaling or "long_factor" not in scaling:
+        raise ValueError("longrope rope_scaling needs short_factor and "
+                         "long_factor per-dim rescale lists")
+    short = jnp.asarray(scaling["short_factor"], jnp.float32)
+    long = jnp.asarray(scaling["long_factor"], jnp.float32)
+    if short.shape != (half,) or long.shape != (half,):
+        raise ValueError(
+            f"longrope factor lists must have rotary_dim/2 = {half} "
+            f"entries, got short {short.shape} long {long.shape}")
+    factor = float(scaling.get("factor") or 1.0)
+    attn = scaling.get("attention_factor")
+    if attn is None:
+        attn = 1.0 if factor <= 1.0 else \
+            math.sqrt(1.0 + math.log(factor) / math.log(orig))
+    seq_len = jnp.max(positions) + 1
+    ext = jnp.where(seq_len > orig, long, short)
+    return inv_freq / ext, float(attn)
+
+
 def rotary_angles(positions: jnp.ndarray, head_dim: int,
                   theta: float = 10000.0,
                   scaling: Optional[Dict[str, Any]] = None,
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """positions [..., T] int -> (cos, sin) each [..., T, head_dim//2], fp32.
-    ``scaling``: HF ``rope_scaling`` dict (llama3 / linear / yarn), see
-    _scale_inv_freq."""
+    ``scaling``: HF ``rope_scaling`` dict (llama3 / linear / yarn /
+    longrope), see _scale_inv_freq / _longrope_inv_freq."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     scaling = validate_rope_scaling(scaling)  # the ONE whitelist
     attn_scale = 1.0
     if scaling:
-        inv_freq, attn_scale = _scale_inv_freq(inv_freq, scaling,
-                                               head_dim, theta)
+        if scaling["rope_type"] == "longrope":
+            inv_freq, attn_scale = _longrope_inv_freq(
+                inv_freq, scaling, positions)
+        else:
+            inv_freq, attn_scale = _scale_inv_freq(inv_freq, scaling,
+                                                   head_dim, theta)
     ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
     if attn_scale != 1.0:
         return jnp.cos(ang) * attn_scale, jnp.sin(ang) * attn_scale

@@ -187,10 +187,10 @@ def test_unknown_rope_scaling_refused():
     base = dict(model_type="llama", vocab_size=128, hidden_size=32,
                 intermediate_size=64, num_hidden_layers=2,
                 num_attention_heads=4, num_key_value_heads=2)
-    with pytest.raises(NotImplementedError, match="longrope"):
+    with pytest.raises(NotImplementedError, match="dynamic"):
         hf_config_to_model_config(
             {**base,
-             "rope_scaling": {"rope_type": "longrope", "factor": 2.0}})
+             "rope_scaling": {"rope_type": "dynamic", "factor": 2.0}})
     # default-type scaling dicts are a no-op, not an error
     assert hf_config_to_model_config(
         {**base, "rope_scaling": {"rope_type": "default"}}

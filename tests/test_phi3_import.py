@@ -92,3 +92,72 @@ def test_phi3_decode_matches_forward(tiny_phi3_dir):
         want.append(nxt)
         seq.append(nxt)
     assert got == want
+
+
+def test_phi3_longrope_matches_hf():
+    """LongRoPE (phi-3 128k): per-dim factor lists, short below the
+    original context and long beyond (a traced select matching HF's
+    dynamic frequency update), cos/sin scaled by the derived attention
+    factor. Unit parity vs ROPE_INIT_FUNCTIONS['longrope'] on both
+    branches, then end-to-end logits parity on a tiny longrope phi-3."""
+    import jax.numpy as jnp
+    from transformers import Phi3Config, Phi3ForCausalLM
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from dla_tpu.models.hf_import import _validated_rope_scaling
+    from dla_tpu.ops.rotary import _longrope_inv_freq
+
+    hd, theta, orig, ext = 16, 10000.0, 32, 4
+    rng = np.random.RandomState(0)
+    short = (1.0 + rng.rand(hd // 2) * 0.2).round(4).tolist()
+    long = (2.0 + rng.rand(hd // 2) * 3.0).round(4).tolist()
+    hf_cfg = Phi3Config(
+        vocab_size=160, hidden_size=hd * 4, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=orig * ext,
+        original_max_position_embeddings=orig,
+        rope_theta=theta, pad_token_id=0, tie_word_embeddings=False,
+        rope_scaling={"type": "longrope", "short_factor": short,
+                      "long_factor": long},
+        attn_implementation="eager")
+
+    scaling = _validated_rope_scaling(hf_cfg.to_dict())
+    assert scaling["rope_type"] == "longrope"
+    assert scaling["original_max_position_embeddings"] == orig
+    assert scaling["factor"] == ext
+    inv0 = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    for seq_len in (orig - 4, orig * 2):
+        inv_hf, att_hf = ROPE_INIT_FUNCTIONS["longrope"](
+            hf_cfg, device="cpu", seq_len=seq_len)
+        positions = jnp.arange(seq_len)[None, :]
+        inv_j, att_j = _longrope_inv_freq(inv0, scaling, positions)
+        np.testing.assert_allclose(np.asarray(inv_j), inv_hf.numpy(),
+                                   rtol=1e-6, err_msg=f"seq={seq_len}")
+        assert abs(att_j - float(att_hf)) < 1e-9
+
+    # end to end, on BOTH sides of the original context
+    import tempfile
+
+    from dla_tpu.models.hf_import import (
+        hf_config_to_model_config,
+        import_hf_weights,
+        read_hf_config,
+    )
+    from dla_tpu.models.transformer import Transformer
+
+    torch.manual_seed(2)
+    hf_model = Phi3ForCausalLM(hf_cfg).eval()
+    with tempfile.TemporaryDirectory() as d:
+        hf_model.save_pretrained(d, safe_serialization=True)
+        cfg = hf_config_to_model_config(
+            read_hf_config(d), dtype="float32", param_dtype="float32",
+            remat="none")
+        params = import_hf_weights(d, cfg)
+    model = Transformer(cfg)
+    for t in (orig - 8, orig + 24):   # short branch, then long branch
+        ids = np.random.RandomState(4).randint(0, 160, (2, t))
+        ours = np.asarray(model.apply(params, jnp.asarray(ids, np.int32)))
+        with torch.no_grad():
+            theirs = hf_model(torch.tensor(ids)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=3e-4,
+                                   err_msg=f"T={t}")
