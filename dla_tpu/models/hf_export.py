@@ -103,6 +103,16 @@ def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
         out["attention_bias"] = True
     if cfg.rope_scaling:
         out["rope_scaling"] = dict(cfg.rope_scaling)
+        if (cfg.rope_scaling.get("rope_type") == "longrope"
+                and "original_max_position_embeddings"
+                in cfg.rope_scaling):
+            # transformers reads the short/long switch point and the
+            # derived attention factor from the TOP-LEVEL attribute
+            # only (verified 4.57: a dict-level value is ignored) — a
+            # reload that missed this would silently use max_position_
+            # embeddings as the switch and never apply long_factor
+            out["original_max_position_embeddings"] = int(
+                cfg.rope_scaling["original_max_position_embeddings"])
     if cfg.sliding_window:
         out["sliding_window"] = int(cfg.sliding_window)
         if _hf_model_type(cfg) == "qwen2":

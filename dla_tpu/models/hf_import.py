@@ -36,6 +36,13 @@ def _validated_rope_scaling(hf_cfg):
             and "max_position_embeddings" in hf_cfg):
         rs["original_max_position_embeddings"] = int(
             hf_cfg["max_position_embeddings"])
+    if (rope_type == "dynamic"
+            and "max_position_embeddings" not in rs
+            and "max_position_embeddings" in hf_cfg):
+        # dynamic NTK stretches relative to the TRAINED context, which
+        # lives at the top level of config.json
+        rs["max_position_embeddings"] = int(
+            hf_cfg["max_position_embeddings"])
     if rope_type == "longrope":
         # phi-3 keeps the pretraining context at the TOP level of
         # config.json and derives the attention factor from the

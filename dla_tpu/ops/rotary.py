@@ -26,10 +26,12 @@ def validate_rope_scaling(scaling: Optional[Dict[str, Any]]
         return None
     if rope_type == "su":  # phi-3's pre-release name for longrope
         rope_type = "longrope"
-    if rope_type not in ("llama3", "linear", "yarn", "longrope"):
+    if rope_type not in ("llama3", "linear", "yarn", "longrope",
+                         "dynamic"):
         raise NotImplementedError(
             f"rope_scaling type '{rope_type}' is not supported "
-            "(implemented: llama3, linear, yarn, longrope)")
+            "(implemented: llama3, linear, yarn, longrope, dynamic — "
+            "the full HF ROPE_INIT_FUNCTIONS family)")
     out = dict(scaling)
     out["rope_type"] = rope_type   # normalized: consumers read ONE key
     out.pop("type", None)
@@ -164,13 +166,38 @@ def _longrope_inv_freq(inv_freq: jnp.ndarray, scaling: Dict[str, Any],
     return inv_freq / ext, float(attn)
 
 
+def _dynamic_ntk_inv_freq(scaling: Dict[str, Any],
+                          positions: jnp.ndarray, head_dim: int,
+                          theta: float) -> jnp.ndarray:
+    """Dynamic NTK scaling (HF _compute_dynamic_ntk_parameters +
+    dynamic_rope_update): the wavelength base stretches continuously
+    once the current sequence exceeds the trained context —
+    base' = base * ((factor * seq / max_pos) - (factor - 1))^(d/(d-2)),
+    with seq = max(max(position)+1, max_pos), a TRACED quantity (below
+    the trained context the multiplier is exactly 1). attention scale
+    is unused for this type."""
+    if "max_position_embeddings" not in scaling:
+        raise ValueError(
+            "dynamic rope_scaling needs max_position_embeddings (the "
+            "HF importer injects it from the checkpoint config)")
+    max_pos = float(scaling["max_position_embeddings"])
+    factor = float(scaling["factor"])
+    seq = jnp.maximum(jnp.max(positions).astype(jnp.float32) + 1.0,
+                      max_pos)
+    base = theta * ((factor * seq / max_pos) - (factor - 1.0)) \
+        ** (head_dim / (head_dim - 2.0))
+    return 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+
+
 def rotary_angles(positions: jnp.ndarray, head_dim: int,
                   theta: float = 10000.0,
                   scaling: Optional[Dict[str, Any]] = None,
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """positions [..., T] int -> (cos, sin) each [..., T, head_dim//2], fp32.
     ``scaling``: HF ``rope_scaling`` dict (llama3 / linear / yarn /
-    longrope), see _scale_inv_freq / _longrope_inv_freq."""
+    longrope / dynamic — the full HF family), see _scale_inv_freq /
+    _longrope_inv_freq / _dynamic_ntk_inv_freq."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     scaling = validate_rope_scaling(scaling)  # the ONE whitelist
     attn_scale = 1.0
@@ -178,6 +205,9 @@ def rotary_angles(positions: jnp.ndarray, head_dim: int,
         if scaling["rope_type"] == "longrope":
             inv_freq, attn_scale = _longrope_inv_freq(
                 inv_freq, scaling, positions)
+        elif scaling["rope_type"] == "dynamic":
+            inv_freq = _dynamic_ntk_inv_freq(scaling, positions,
+                                             head_dim, theta)
         else:
             inv_freq, attn_scale = _scale_inv_freq(inv_freq, scaling,
                                                    head_dim, theta)

@@ -130,3 +130,41 @@ def test_export_refuses_adapter_checkpoint(tmp_path):
     ck.save(1, {"params": adapters}, aux={"model_config": cfg.to_dict()})
     with pytest.raises(ValueError, match="merged"):
         export_checkpoint(tmp_path / "ckpt" / "latest", tmp_path / "out")
+
+
+def test_export_longrope_round_trips_through_transformers(tmp_path):
+    """LongRoPE export must surface original_max_position_embeddings at
+    the TOP level of config.json — transformers reads the short/long
+    switch point and derived attention factor only from there (a
+    dict-level value is silently ignored; verified 4.57). Logits parity
+    on reload BEYOND the original context pins it."""
+    import dataclasses
+    import json
+
+    orig, ext, hd = 16, 4, 16
+    short = [1.0 + 0.05 * i for i in range(hd // 2)]
+    long = [2.0 + 0.3 * i for i in range(hd // 2)]
+    cfg = get_model_config(
+        "tiny-gqa", hidden_size=hd * 4, num_heads=4, num_kv_heads=2,
+        max_seq_length=orig * ext,
+        rope_scaling={"rope_type": "longrope", "short_factor": short,
+                      "long_factor": long, "factor": float(ext),
+                      "original_max_position_embeddings": orig})
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(2))
+    d = export_hf_weights(params, cfg, tmp_path / "hf_lr")
+    conf = json.loads((d / "config.json").read_text())
+    assert conf["original_max_position_embeddings"] == orig
+
+    from transformers import LlamaForCausalLM
+    hf_model = LlamaForCausalLM.from_pretrained(
+        str(d), torch_dtype=torch.float32, attn_implementation="eager"
+        ).eval()
+    rs = np.random.RandomState(1)
+    for t in (orig - 4, orig + 12):   # short branch, then long branch
+        ids = rs.randint(0, cfg.vocab_size, (2, t))
+        ours = np.asarray(model.apply(params, jnp.asarray(ids, jnp.int32)))
+        with torch.no_grad():
+            theirs = hf_model(torch.tensor(ids)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=3e-4,
+                                   err_msg=f"T={t}")

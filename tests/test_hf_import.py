@@ -180,6 +180,61 @@ def test_llama31_rope_scaling_logits_parity(tmp_path):
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-4)
 
 
+def test_dynamic_ntk_rope_matches_hf():
+    """Dynamic NTK rope scaling: traced base stretch past the trained
+    context, unit parity with ROPE_INIT_FUNCTIONS['dynamic'] on both
+    sides, end-to-end logits parity on a tiny llama run BEYOND its
+    max_position_embeddings."""
+    import jax.numpy as jnp
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from dla_tpu.models.hf_import import (
+        _validated_rope_scaling,
+        hf_config_to_model_config,
+        import_hf_weights,
+        read_hf_config,
+    )
+    from dla_tpu.ops.rotary import _dynamic_ntk_inv_freq
+
+    hd, theta, max_pos = 16, 10000.0, 32
+    hf_cfg = LlamaConfig(
+        vocab_size=160, hidden_size=hd * 4, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=max_pos, rope_theta=theta,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "dynamic", "factor": 4.0})
+    scaling = _validated_rope_scaling(hf_cfg.to_dict())
+    assert scaling["max_position_embeddings"] == max_pos
+    for seq_len in (max_pos - 8, max_pos * 3):
+        inv_hf, _ = ROPE_INIT_FUNCTIONS["dynamic"](
+            hf_cfg, device="cpu", seq_len=seq_len)
+        inv_j = _dynamic_ntk_inv_freq(
+            scaling, jnp.arange(seq_len)[None, :], hd, theta)
+        np.testing.assert_allclose(np.asarray(inv_j), inv_hf.numpy(),
+                                   rtol=1e-6, err_msg=f"seq={seq_len}")
+
+    import tempfile
+    torch.manual_seed(5)
+    hf_model = LlamaForCausalLM(hf_cfg).eval()
+    with tempfile.TemporaryDirectory() as d:
+        hf_model.save_pretrained(d, safe_serialization=True)
+        cfg = hf_config_to_model_config(
+            read_hf_config(d), dtype="float32", param_dtype="float32",
+            remat="none", max_seq_length=96)
+        params = import_hf_weights(d, cfg)
+    from dla_tpu.models.transformer import Transformer
+    model = Transformer(cfg)
+    for t in (max_pos - 8, max_pos + 16):  # static base, stretched base
+        ids = np.random.RandomState(6).randint(0, 160, (2, t))
+        ours = np.asarray(model.apply(params, jnp.asarray(ids, np.int32)))
+        with torch.no_grad():
+            theirs = hf_model(torch.tensor(ids)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=3e-4,
+                                   err_msg=f"T={t}")
+
+
 def test_unknown_rope_scaling_refused():
     import pytest
     from dla_tpu.models.hf_import import hf_config_to_model_config
@@ -187,10 +242,10 @@ def test_unknown_rope_scaling_refused():
     base = dict(model_type="llama", vocab_size=128, hidden_size=32,
                 intermediate_size=64, num_hidden_layers=2,
                 num_attention_heads=4, num_key_value_heads=2)
-    with pytest.raises(NotImplementedError, match="dynamic"):
+    with pytest.raises(NotImplementedError, match="made_up"):
         hf_config_to_model_config(
             {**base,
-             "rope_scaling": {"rope_type": "dynamic", "factor": 2.0}})
+             "rope_scaling": {"rope_type": "made_up", "factor": 2.0}})
     # default-type scaling dicts are a no-op, not an error
     assert hf_config_to_model_config(
         {**base, "rope_scaling": {"rope_type": "default"}}
