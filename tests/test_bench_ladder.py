@@ -190,3 +190,41 @@ def test_sweep_variants_bind_to_run_variant():
             sig.bind(name, **kw)  # raises TypeError on a bad kwarg
         for c in cited:
             assert c in mod.VARIANTS, f"BASELINE.md cites {fname}:{c}"
+
+
+def test_sweep_decode_run_variant_smoke():
+    """tools/sweep_decode.py run_variant end to end at toy scale on CPU:
+    the artifact row must carry the metric fields BASELINE.md quotes,
+    with finite positive values and a prefill-subtracted ms/token."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import sweep_decode
+
+    row = sweep_decode.run_variant(
+        "smoke", batch=2, prompt=8, new=4, hidden=32, inter=64,
+        layers=2, heads=2, kv_heads=1)
+    # host-timer noise can push the prefill-SUBTRACTED fields near zero
+    # on a contended CPU; the unsubtracted ones must be strictly positive
+    for key in ("ms_per_token_incl_prefill", "roofline_ms"):
+        assert row[key] > 0, (key, row)
+    import math
+    for key in ("ms_per_token", "decode_tok_s_chip", "x_roofline"):
+        assert math.isfinite(row[key]), (key, row)
+    assert row["params_m"] >= 0
+    assert row["variant"] == "smoke"
+
+
+def test_sweep_decode_int8_variant_smoke():
+    """The int8-weights + int8-KV variant path (quantize_weights + the
+    kernel gates) survives the same toy-scale drive."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import sweep_decode
+
+    row = sweep_decode.run_variant(
+        "smoke8", batch=2, prompt=8, new=4, hidden=32, inter=64,
+        layers=2, heads=2, kv_heads=1, kv_dtype="int8", weights="int8")
+    assert row["ms_per_token"] > 0
+    assert row["kv"] == "int8" and row["weights"] == "int8"
