@@ -186,10 +186,31 @@ def main(argv=None) -> None:
     model_extra = {k: v for k, v in config.get("model", {}).items()}
 
     results: Dict[str, Dict[str, Dict[str, float]]] = {}
+    # generation.draft_model: speculative decoding for every evaluated
+    # model — a small same-tokenizer checkpoint proposes, each target
+    # verifies blockwise (dla_tpu/generation/speculative.py; exact:
+    # outputs are distributed as plain target decoding)
+    draft_bundle = None
+    if gen_cfg.get("draft_model"):
+        log_rank_zero("[dla_tpu][eval] speculative draft: "
+                      f"{gen_cfg['draft_model']}")
+        draft_bundle = load_causal_lm(
+            str(gen_cfg["draft_model"]), model_extra,
+            jax.random.fold_in(rng, 17))
+
     for model_name, model_path in config["models"].items():
         log_rank_zero(f"[dla_tpu][eval] loading {model_name}: {model_path}")
         bundle = load_causal_lm(str(model_path), model_extra, rng)
-        engine = GenerationEngine(bundle.model, bundle.tokenizer, gen)
+        if draft_bundle is not None:
+            from dla_tpu.generation.speculative import SpeculativeEngine
+            engine = SpeculativeEngine(
+                bundle.model, draft_bundle.model, draft_bundle.params,
+                bundle.tokenizer, gen,
+                gamma=int(gen_cfg.get("speculative_gamma", 4)),
+                alloc_factor=float(
+                    gen_cfg.get("speculative_alloc_factor", 2.0)))
+        else:
+            engine = GenerationEngine(bundle.model, bundle.tokenizer, gen)
         model_metrics: Dict[str, Dict[str, float]] = {}
         for bench_name, bench_cfg in config["benchmarks"].items():
             limit = bench_cfg.get("max_samples") or args.max_prompts

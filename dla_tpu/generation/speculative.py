@@ -1,0 +1,271 @@
+"""Speculative decoding: a small draft model proposes, the target
+verifies a whole block in one forward (models/transformer.py
+``decode_block``), and the standard acceptance rule keeps the TARGET
+distribution exact — greedy outputs are bit-identical to plain greedy
+decoding no matter how bad the draft is, and sampled outputs are
+distributed exactly as target sampling (accept d with prob
+min(1, p(d)/q(d)); on reject, resample from norm(max(p - q, 0))). The
+one-hot probability convention (ops.sampling.filtered_probs) folds
+greedy into the same rule.
+
+The reference has no counterpart (its rollouts call HF generate
+token-by-token, src/training/train_rlhf.py:123-124); this is a
+beyond-parity inference capability for eval / teacher generation where
+a smaller same-tokenizer draft checkpoint exists.
+
+Static-shape design: each round advances BOTH caches by exactly
+``gamma`` physical columns ([pending, d_1 .. d_{gamma-1}]); rejected
+suffixes are retracted (columns invalidated, lengths rolled back) but
+the physical cursor never rewinds — speculative decoding trades cache
+columns for fewer serial steps. Cache capacity is
+``alloc_factor * max_new_tokens`` columns; when acceptance is poor the
+loop can exhaust them before committing max_new_tokens and rows come
+back shorter (masks stay correct). rounds, block size, and every
+buffer are static; the round loop is a ``lax.while_loop`` with
+all-done early exit.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dla_tpu.generation.engine import (
+    GenerationConfig,
+    encode_prompt_batch,
+    left_align,
+)
+from dla_tpu.models.transformer import Transformer
+from dla_tpu.ops.sampling import filtered_probs
+
+
+def build_speculative_generate_fn(
+    target: Transformer,
+    draft: Transformer,
+    gen: GenerationConfig,
+    *,
+    gamma: int = 4,
+    alloc_factor: float = 2.0,
+):
+    """Returns a jittable
+    ``fn(target_params, draft_params, input_ids, attention_mask, rng)``
+    with the same output dict as engine.build_generate_fn, plus
+    ``accepted_tokens`` / ``verify_rounds`` acceptance telemetry.
+    ``gamma``: tokens per verification block (gamma - 1 draft
+    proposals; must be >= 2 — at 1 there is nothing speculative)."""
+    if gamma < 2:
+        raise ValueError("speculative decoding needs gamma >= 2; use the "
+                         "plain generation engine for gamma == 1")
+    if target.cfg.vocab_size != draft.cfg.vocab_size:
+        raise ValueError(
+            f"target/draft vocab mismatch: {target.cfg.vocab_size} vs "
+            f"{draft.cfg.vocab_size} (same tokenizer required)")
+    filt = dict(temperature=gen.temperature, top_p=gen.top_p,
+                top_k=gen.top_k, do_sample=gen.do_sample)
+    eos = gen.eos_token_id if (gen.eos_token_id is not None
+                               and gen.eos_token_id >= 0) else None
+    pad = gen.pad_token_id
+
+    def sample_from(key, probs):  # categorical over a prob vector [B, V]
+        return jax.random.categorical(
+            key, jnp.log(probs + 1e-30), axis=-1).astype(jnp.int32)
+
+    def generate(tparams, dparams, input_ids, attention_mask, rng):
+        b, p_width = input_ids.shape
+        n = gen.max_new_tokens
+        alloc = int(alloc_factor * n) + gamma
+        rounds = max(1, alloc // gamma)
+
+        t_logits, t_cache = target.start_decode(
+            tparams, input_ids, attention_mask, alloc)
+        _, d_cache = draft.start_decode(
+            dparams, input_ids, attention_mask, alloc)
+
+        k_p0, k_draft, k_u, k_re = jax.random.split(rng, 4)
+        draft_keys = jax.random.split(k_draft, rounds * gamma
+                                      ).reshape(rounds, gamma)
+        u_keys = jax.random.split(k_u, rounds)
+        re_keys = jax.random.split(k_re, rounds)
+
+        # the first pending token comes straight from the target's
+        # prefill logits — emitted immediately (buffer slot 0)
+        p0 = sample_from(k_p0, filtered_probs(t_logits, **filt))
+        toks = jnp.full((b, n), pad, jnp.int32)
+        emits = jnp.zeros((b, n), bool)
+        toks = toks.at[:, 0].set(p0)
+        emits = emits.at[:, 0].set(True)
+        done0 = jnp.zeros((b,), bool) | (p0 == eos if eos is not None
+                                         else False)
+        ptr0 = jnp.ones((b,), jnp.int32)
+
+        def round_body(state):
+            (rnd, t_cache, d_cache, pending, done, ptr, toks, emits,
+             acc_total) = state
+            done_at_entry = done
+
+            # ---- draft phase: gamma sequential steps, gamma - 1 used
+            def draft_step(carry, i):
+                cur, d_cache = carry
+                dl, d_cache = draft.decode_step(dparams, d_cache, cur)
+                q = filtered_probs(dl, **filt)              # [B, V]
+                nxt = sample_from(draft_keys[rnd, i], q)
+                return (nxt, d_cache), (nxt, q)
+
+            (_, d_cache), (props, qprobs) = jax.lax.scan(
+                draft_step, (pending, d_cache), jnp.arange(gamma))
+            # props[i] = d_{i+1}; the last proposal is never verified
+            # (symmetry: both caches advance exactly gamma columns)
+            d_toks = jnp.moveaxis(props, 0, 1)[:, :gamma - 1]   # [B,g-1]
+            q_d = jnp.moveaxis(qprobs, 0, 1)[:, :gamma - 1]     # [B,g-1,V]
+
+            # ---- verify: one target forward over the whole block
+            block = jnp.concatenate([pending[:, None], d_toks], axis=1)
+            t_log, t_cache = target.decode_block(tparams, t_cache, block)
+            p_all = filtered_probs(t_log, **filt)           # [B, g, V]
+            p_d = p_all[:, :gamma - 1]                      # dist for d_i
+
+            # ---- acceptance: longest all-accepted prefix
+            gather = jnp.take_along_axis
+            p_at = gather(p_d, d_toks[..., None], axis=-1)[..., 0]
+            q_at = gather(q_d, d_toks[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(u_keys[rnd], (b, gamma - 1))
+            accept = u * q_at < p_at          # u < p/q, q > 0 by sampling
+            k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                        axis=1)                               # [B] 0..g-1
+
+            # ---- next pending: bonus sample (all accepted) or the
+            # residual distribution at the reject position
+            j = jnp.minimum(k, gamma - 2)                     # [B]
+            p_j = gather(p_d, j[:, None, None].repeat(p_d.shape[-1], 2),
+                         axis=1)[:, 0]                        # [B, V]
+            q_j = gather(q_d, j[:, None, None].repeat(q_d.shape[-1], 2),
+                         axis=1)[:, 0]
+            resid = jnp.maximum(p_j - q_j, 0.0)
+            rs = jnp.sum(resid, axis=-1, keepdims=True)
+            resid = jnp.where(rs > 1e-9, resid / (rs + 1e-30), p_j)
+            bonus = p_all[:, gamma - 1]
+            nxt_dist = jnp.where((k == gamma - 1)[:, None], bonus, resid)
+            pending_next = sample_from(re_keys[rnd], nxt_dist)
+
+            # ---- retract the rejected suffix in BOTH caches: the
+            # pending column plus k accepted proposals stay
+            keep = 1 + k
+            t_cache = Transformer.retract_block(t_cache, keep, gamma)
+            d_cache = Transformer.retract_block(d_cache, keep, gamma)
+
+            # ---- emit [d_1..d_k, pending_next], honoring EOS + N cap
+            commit = jnp.concatenate(
+                [d_toks, pending_next[:, None]], axis=1)      # [B, g]
+            idx = jnp.arange(gamma)[None, :]
+            is_next = idx == k[:, None]
+            commit = jnp.where(is_next, pending_next[:, None], commit)
+            live = (idx <= k[:, None]) & ~done[:, None]
+            if eos is not None:
+                hit = commit == eos
+                # positions strictly after the first live EOS die
+                after = jnp.cumsum(
+                    jnp.cumsum((hit & live).astype(jnp.int32), 1), 1) > 1
+                live = live & ~after
+                done = done | jnp.any(hit & live, axis=1)
+            slots = ptr[:, None] + jnp.cumsum(live.astype(jnp.int32),
+                                              axis=1) - 1
+            slots = jnp.where(live, slots, n)        # n -> dropped
+            toks = toks.at[jnp.arange(b)[:, None], slots].set(
+                commit, mode="drop")
+            emits = emits.at[jnp.arange(b)[:, None], slots].set(
+                True, mode="drop")
+            committed = jnp.sum(live, axis=1)
+            ptr = jnp.minimum(ptr + committed, n)
+            done = done | (ptr >= n)
+            # telemetry: accepted proposals from rows LIVE at round
+            # entry only (done rows keep spinning with garbage k until
+            # the loop exits)
+            acc_total = acc_total + jnp.sum(jnp.where(done_at_entry,
+                                                      0, k))
+            return (rnd + 1, t_cache, d_cache, pending_next, done, ptr,
+                    toks, emits, acc_total)
+
+        def cond(state):
+            rnd, done = state[0], state[4]
+            return (rnd < rounds) & ~jnp.all(done)
+
+        state = (jnp.int32(0), t_cache, d_cache, p0, done0, ptr0, toks,
+                 emits, jnp.zeros((), jnp.int32))
+        (rnd, _, _, _, _, ptr, toks, emits, acc_total) = \
+            jax.lax.while_loop(cond, round_body, state)
+
+        response_mask = emits.astype(jnp.int32)
+        raw_ids = jnp.concatenate([input_ids, toks], axis=1)
+        raw_mask = jnp.concatenate(
+            [attention_mask.astype(jnp.int32), response_mask], axis=1)
+        sequences, sequence_mask = left_align(raw_ids, raw_mask)
+        return {
+            "sequences": sequences,
+            "sequence_mask": sequence_mask,
+            "response_tokens": toks,
+            "response_mask": response_mask,
+            "lengths": jnp.sum(raw_mask, axis=1),
+            "accepted_tokens": acc_total,
+            "verify_rounds": rnd,
+        }
+
+    return generate
+
+
+class SpeculativeEngine:
+    """GenerationEngine-shaped wrapper (same ``generate_text`` surface,
+    so eval/teacher-gen batch loops take either) holding the draft
+    model + params alongside the target."""
+
+    def __init__(self, target: Transformer, draft: Transformer,
+                 draft_params, tokenizer, gen: GenerationConfig,
+                 *, gamma: int = 4, alloc_factor: float = 2.0):
+        self.model = target
+        self.tokenizer = tokenizer
+        self.draft_params = draft_params
+        self.gen = dataclasses.replace(
+            gen,
+            eos_token_id=tokenizer.eos_token_id,
+            pad_token_id=tokenizer.pad_token_id)
+        self._fn = jax.jit(build_speculative_generate_fn(
+            target, draft, self.gen, gamma=gamma,
+            alloc_factor=alloc_factor))
+
+    def encode_prompts(self, prompts, max_prompt_len: int):
+        return encode_prompt_batch(self.tokenizer, prompts,
+                                   max_prompt_len)
+
+    def generate_text(self, params, prompts, max_prompt_len: int,
+                      rng) -> Tuple[list, Dict[str, Any]]:
+        import numpy as np
+        ids, mask = self.encode_prompts(prompts, max_prompt_len)
+        out = self._fn(params, self.draft_params, jnp.asarray(ids),
+                       jnp.asarray(mask), rng)
+        # a row that neither delivered max_new_tokens nor stopped on
+        # EOS was TRUNCATED by cache-column exhaustion (poor draft
+        # acceptance vs alloc_factor) — never let that pass silently
+        # into eval metrics or distill data
+        rmask = np.asarray(out["response_mask"]).astype(bool)
+        rtoks = np.asarray(out["response_tokens"])
+        counts = rmask.sum(axis=1)
+        last = rtoks[np.arange(len(counts)),
+                     np.maximum(counts - 1, 0)]
+        truncated = ((counts < self.gen.max_new_tokens)
+                     & (last != self.tokenizer.eos_token_id))
+        if truncated.any():
+            import sys
+            print(f"[dla_tpu][speculative] {int(truncated.sum())}/"
+                  f"{len(counts)} rows truncated by cache-column "
+                  "exhaustion (low draft acceptance); raise "
+                  "alloc_factor or drop the draft model",
+                  file=sys.stderr, flush=True)
+        texts = []
+        resp = np.asarray(out["response_tokens"])
+        rmask = np.asarray(out["response_mask"])
+        for i in range(len(prompts)):
+            toks = [int(t) for t, m in zip(resp[i], rmask[i])
+                    if m and t != self.tokenizer.eos_token_id]
+            texts.append(self.tokenizer.decode(toks))
+        return texts, out

@@ -1430,6 +1430,69 @@ class Transformer:
             new_cache["v"] = jnp.pad(vs, pad5)
         return logits, new_cache
 
+    def _unpack_decode_xs(self, xs, dequantize: bool):
+        """Unstack one decode-scan slice: (layer, k_cache, v_cache,
+        k_scale, v_scale); int8 caches optionally dequantized here (the
+        XLA path — the Pallas kernel takes raw int8 + scales)."""
+        k_s = v_s = None
+        if self._kv_int8:
+            layer, k_cache, v_cache, k_s, v_s = xs
+            if dequantize:
+                # K-major [B, K, S] storage -> positional [B, S, K]
+                k_cache = self._dequantize_kv(
+                    k_cache, k_s.transpose(0, 2, 1))
+                v_cache = self._dequantize_kv(
+                    v_cache, v_s.transpose(0, 2, 1))
+        else:
+            layer, k_cache, v_cache = xs
+        return layer, k_cache, v_cache, k_s, v_s
+
+    def _decode_layer(self, layer: Params, h_in: jnp.ndarray,
+                      cos, sin, attend):
+        """The per-layer decode computation SHARED by decode_step (one
+        token) and decode_block (G tokens): norms, projections, MLP,
+        and every arch branch — only the attention backend differs, and
+        ``attend(q, k, v) -> [B, T, H, D]`` supplies it. Keeping this
+        single ensures a new arch branch lands in both paths (the
+        'G == 1 is semantically decode_step' contract)."""
+        cfg = self.cfg
+        b, t, _ = h_in.shape
+        dh = cfg.head_dim_
+
+        def cast(w):
+            return w.astype(self.adtype)
+
+        def proj(name, inp):
+            out = self._dense(layer, name, inp)
+            bias = layer.get(f"{name}_bias")
+            return out if bias is None else out + cast(bias)
+
+        if cfg.arch == "phi":
+            hn = layer_norm(h_in, layer["ln"], layer["ln_bias"],
+                            cfg.rms_norm_eps)
+        else:
+            hn = rms_norm(h_in, layer["attn_norm"], cfg.rms_norm_eps)
+        q = proj("wq", hn).reshape(b, t, cfg.num_heads, dh)
+        k = proj("wk", hn).reshape(b, t, cfg.num_kv_heads, dh)
+        v = proj("wv", hn).reshape(b, t, cfg.num_kv_heads, dh)
+        q = apply_rotary(q, cos, sin, rotary_dim=cfg.rotary_dim_)
+        k = apply_rotary(k, cos, sin, rotary_dim=cfg.rotary_dim_)
+        attn = attend(q, k, v).reshape(b, t, cfg.num_heads * dh)
+        if cfg.arch == "phi":
+            ff = jax.nn.gelu(proj("fc1", hn), approximate=True)
+            return h_in + proj("wo", attn) + proj("fc2", ff), (k, v)
+        attn_out = proj("wo", attn)
+        if cfg.arch == "gemma2":
+            attn_out = rms_norm(attn_out, layer["attn_post_norm"],
+                                cfg.rms_norm_eps)
+        x1 = h_in + attn_out
+        hn2 = rms_norm(x1, layer["mlp_norm"], cfg.rms_norm_eps)
+        mlp_out = self._mlp(layer, hn2, proj)[0]  # aux unused at decode
+        if cfg.arch == "gemma2":
+            mlp_out = rms_norm(mlp_out, layer["mlp_post_norm"],
+                               cfg.rms_norm_eps)
+        return x1 + mlp_out, (k, v)
+
     def decode_step(self, params: Params, cache: Params,
                     tokens: jnp.ndarray,  # [B] the tokens just sampled
                     ) -> Tuple[jnp.ndarray, Params]:
@@ -1521,78 +1584,35 @@ class Transformer:
             attn_bias = jnp.where(bmask, 0.0, _KNEG).astype(jnp.float32)
 
         def body2(carry, xs):
-            k_s = v_s = None
-            if self._kv_int8:
-                layer, k_cache, v_cache, k_s, v_s = xs
-                if not use_decode_kernel:
-                    # K-major [B, K, S] storage -> positional [B, S, K]
-                    k_cache = self._dequantize_kv(
-                        k_cache, k_s.transpose(0, 2, 1))
-                    v_cache = self._dequantize_kv(
-                        v_cache, v_s.transpose(0, 2, 1))
-            else:
-                layer, k_cache, v_cache = xs
-            h_in = carry
-            dh = cfg.head_dim_
-            rd = cfg.rotary_dim_
+            layer, k_cache, v_cache, k_s, v_s = self._unpack_decode_xs(
+                xs, dequantize=not use_decode_kernel)
 
-            def cast(w):
-                return w.astype(self.adtype)
-
-            def proj(name, inp):
-                out = self._dense(layer, name, inp)
-                bias = layer.get(f"{name}_bias")
-                return out if bias is None else out + cast(bias)
-
-            if cfg.arch == "phi":
-                hn = layer_norm(h_in, layer["ln"], layer["ln_bias"],
-                                cfg.rms_norm_eps)
-            else:
-                hn = rms_norm(h_in, layer["attn_norm"], cfg.rms_norm_eps)
-            q = proj("wq", hn).reshape(b, 1, cfg.num_heads, dh)
-            k = proj("wk", hn).reshape(b, 1, cfg.num_kv_heads, dh)
-            v = proj("wv", hn).reshape(b, 1, cfg.num_kv_heads, dh)
-            q = apply_rotary(q, cos, sin, rotary_dim=rd)
-            k = apply_rotary(k, cos, sin, rotary_dim=rd)
-            if use_decode_kernel:
-                from dla_tpu.ops.decode_kernel import flash_decode_attention
-                bias_l = attn_bias
-                if attn_bias_win is not None:
-                    # gemma-2 alternating SWA: the layer's traced flag
-                    # picks the windowed or full bias
-                    bias_l = jnp.where(layer["swa_on"], attn_bias_win,
-                                       attn_bias)
-                attn = flash_decode_attention(
-                    q, k_cache, v_cache, k, v,
-                    bias=bias_l, k_scale=k_s, v_scale=v_s,
-                    kv_fill=col,  # no valid column at/after the write slot
-                    softmax_scale=self._softmax_scale,
-                    logit_softcap=cfg.attn_logit_softcap)
-            else:
-                attn = decode_attention(
+            def attend(q, k, v):
+                if use_decode_kernel:
+                    from dla_tpu.ops.decode_kernel import (
+                        flash_decode_attention,
+                    )
+                    bias_l = attn_bias
+                    if attn_bias_win is not None:
+                        # gemma-2 alternating SWA: the layer's traced
+                        # flag picks the windowed or full bias
+                        bias_l = jnp.where(layer["swa_on"],
+                                           attn_bias_win, attn_bias)
+                    return flash_decode_attention(
+                        q, k_cache, v_cache, k, v,
+                        bias=bias_l, k_scale=k_s, v_scale=v_s,
+                        kv_fill=col,  # no valid col at/after write slot
+                        softmax_scale=self._softmax_scale,
+                        logit_softcap=cfg.attn_logit_softcap)
+                return decode_attention(
                     q, k_cache, v_cache, k, v,
                     kv_valid=cache["valid"],
                     q_positions=positions, kv_positions=kv_pos,
                     window=self._layer_window(layer),
                     softmax_scale=self._softmax_scale,
                     logit_softcap=cfg.attn_logit_softcap)
-            attn = attn.reshape(b, 1, cfg.num_heads * dh)
-            if cfg.arch == "phi":
-                ff = jax.nn.gelu(proj("fc1", hn), approximate=True)
-                x2 = h_in + proj("wo", attn) + proj("fc2", ff)
-                return x2, (k, v)
-            attn_out = proj("wo", attn)
-            if cfg.arch == "gemma2":
-                attn_out = rms_norm(attn_out, layer["attn_post_norm"],
-                                    cfg.rms_norm_eps)
-            x1 = h_in + attn_out
-            hn2 = rms_norm(x1, layer["mlp_norm"], cfg.rms_norm_eps)
-            mlp_out = self._mlp(layer, hn2, proj)[0]  # aux unused at decode
-            if cfg.arch == "gemma2":
-                mlp_out = rms_norm(mlp_out, layer["mlp_post_norm"],
-                                   cfg.rms_norm_eps)
-            x2 = x1 + mlp_out
-            return x2, (k, v)
+
+            return self._decode_layer(layer, carry, cos, sin, attend)
 
         xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
               cache["k"], cache["v"])
@@ -1655,3 +1675,126 @@ class Transformer:
         cache["pos"] = jnp.broadcast_to(
             jnp.arange(max_len)[None, :], (b, max_len)).astype(jnp.int32)
         return logits, cache
+
+    def decode_block(self, params: Params, cache: Params,
+                     tokens: jnp.ndarray,  # [B, G] a block of tokens
+                     ) -> Tuple[jnp.ndarray, Params]:
+        """Multi-token decode step: score a block of G tokens in ONE
+        forward against the cache (intra-block causal via
+        ops.attention.block_decode_attention), writing all G KV columns
+        once. Returns (logits [B, G, V], cache) where logits[:, i] is
+        the next-token distribution AFTER tokens[:, :i+1] — the
+        verification forward of speculative decoding. The write is
+        TENTATIVE: every new column is marked valid and lengths advance
+        by G; a caller that rejects a per-row suffix retracts it with
+        ``retract_block`` (columns invalidated, lengths corrected).
+        G == 1 is semantically decode_step."""
+        cfg = self.cfg
+        b, g = tokens.shape
+        if "prompt_width" not in cache:
+            raise ValueError(
+                "decode_block requires a cache produced by start_decode()")
+        lengths0 = cache["lengths"]                        # [B]
+        positions = lengths0[:, None] + jnp.arange(g)[None, :]  # [B, G]
+        x = self._embed(params, tokens)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
+        col0 = cache["prompt_width"] + cache["step"]
+        kv_pos = cache["pos"]
+        from dla_tpu.ops.attention import block_decode_attention
+        if self._kv_int8:
+            # block verify dequantizes via the XLA path (the Pallas
+            # decode kernel is single-token); speculative decoding with
+            # an int8 cache pays the materialization decode_step's
+            # kernel exists to avoid — say so once rather than letting
+            # a benchmark silently measure the slow path
+            key = ("decode_block_int8", tokens.shape)
+            if key not in _REPLICATED_FLASH_LOGGED and \
+                    jax.process_index() == 0:
+                _REPLICATED_FLASH_LOGGED.add(key)
+                print("[dla_tpu][decode] decode_block with an int8 KV "
+                      "cache uses the XLA dequant path (the fused "
+                      "kernel is single-token); prefer bf16 caches for "
+                      "speculative decoding", file=sys.stderr, flush=True)
+
+        def body(carry, xs):
+            layer, k_cache, v_cache, _, _ = self._unpack_decode_xs(
+                xs, dequantize=True)
+
+            def attend(q, k, v):
+                return block_decode_attention(
+                    q, k_cache, v_cache, k, v,
+                    kv_valid=cache["valid"],
+                    q_positions=positions, kv_positions=kv_pos,
+                    window=self._layer_window(layer),
+                    softmax_scale=self._softmax_scale,
+                    logit_softcap=cfg.attn_logit_softcap)
+
+            return self._decode_layer(layer, carry, cos, sin, attend)
+
+        xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
+              cache["k"], cache["v"])
+        if self._kv_int8:
+            xs = xs + (cache["k_scale"], cache["v_scale"])
+        x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
+        h = self._final_norm(params, x)
+        logits = self.unembed(params, h)                   # [B, G, V]
+
+        zero = jnp.zeros((), jnp.int32)
+        max_len = cache["k"].shape[2]
+
+        def write_cols(buf, cols, rank5=True):
+            idx = (zero, zero, col0, zero, zero) if rank5 else \
+                (zero, zero, zero, col0)
+            return jax.lax.dynamic_update_slice(buf, cols, idx)
+
+        colmask = jax.nn.one_hot(  # [B?, S] no: [S] per col block
+            col0 + jnp.arange(g), max_len, dtype=jnp.int32).sum(0)[None, :]
+        valid_next = cache["valid"] | (colmask > 0)
+        # logical position of physical col col0+i for row b is
+        # lengths0[b] + i: scatter the block's positions in
+        block_pos = jnp.zeros_like(kv_pos)
+        block_pos = jax.lax.dynamic_update_slice(
+            block_pos, positions, (zero, col0))
+        kv_pos_next = jnp.where(colmask > 0, block_pos, kv_pos)
+
+        new_cache = {
+            "valid": valid_next,
+            "lengths": lengths0 + g,
+            "step": cache["step"] + g,
+            "prompt_width": cache["prompt_width"],
+            "pos": kv_pos_next,
+        }
+        if self._kv_int8:
+            kq, k_s = self._quantize_kv(k_cols)
+            vq, v_s = self._quantize_kv(v_cols)
+            new_cache["k"] = write_cols(cache["k"], kq)
+            new_cache["v"] = write_cols(cache["v"], vq)
+            new_cache["k_scale"] = write_cols(
+                cache["k_scale"], k_s.transpose(0, 1, 3, 2), rank5=False)
+            new_cache["v_scale"] = write_cols(
+                cache["v_scale"], v_s.transpose(0, 1, 3, 2), rank5=False)
+        else:
+            new_cache["k"] = write_cols(cache["k"], k_cols)
+            new_cache["v"] = write_cols(cache["v"], v_cols)
+        return logits, new_cache
+
+    @staticmethod
+    def retract_block(cache: Params, keep: jnp.ndarray,  # [B] 0..G
+                      g: int) -> Params:
+        """Undo the tentative acceptance of the LAST decode_block: per
+        row, only the first ``keep[b]`` of its G columns stay valid;
+        lengths roll back to pre-block + keep. The KV bytes of rejected
+        columns stay in place (invalid, never attended) and are
+        overwritten by... nothing — speculative decoding advances the
+        physical cursor by G every round, trading cache columns for
+        fewer serial steps."""
+        col0 = cache["prompt_width"] + cache["step"] - g
+        max_len = cache["valid"].shape[1]
+        off = jnp.arange(max_len)[None, :] - col0          # [1, S]
+        in_block = (off >= 0) & (off < g)
+        keep_mask = off < keep[:, None]                    # [B, S]
+        valid = jnp.where(in_block, cache["valid"] & keep_mask,
+                          cache["valid"])
+        return {**cache, "valid": valid,
+                "lengths": cache["lengths"] - g + keep}

@@ -203,6 +203,67 @@ def chunked_causal_attention(
     return outs.transpose(1, 0, 2, 3, 4).reshape(b, tp, h, d)[:, :t]
 
 
+def block_decode_attention(
+    q: jnp.ndarray,       # [B, G, H, D]  the block's queries
+    k_cache: jnp.ndarray,  # [B, S, K, D]  cache BEFORE this block's write
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,    # [B, G, K, D]  the block's keys (rotary applied)
+    v_new: jnp.ndarray,
+    *,
+    kv_valid: jnp.ndarray,        # [B, S] valid cache columns (1=attend)
+    q_positions: jnp.ndarray,     # [B, G] absolute position per query
+    kv_positions: jnp.ndarray,    # [B, S] logical position per cache column
+    softmax_scale: Optional[float] = None,
+    window: Optional[int] = None,
+    logit_softcap: float = 0.0,
+) -> jnp.ndarray:
+    """decode_attention generalized from one query token to a block of
+    G: joint softmax over the un-updated cache PLUS the block's own
+    keys (intra-block causal on absolute positions), WITHOUT writing
+    the cache — the caller writes all G columns once, outside the
+    layer loop. This is the verification step of speculative decoding
+    (score G draft tokens in ONE forward) and degenerates to
+    decode_attention semantics at G = 1. Returns [B, G, H, D]."""
+    b, g, h, d = q.shape
+    _, s, kheads, _ = k_cache.shape
+    groups = h // kheads
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    qg = q.reshape(b, g, kheads, groups, d)
+    # [B, K, Gr, G, S] scores against the existing cache
+    scores = jnp.einsum("bgkrd,bskd->bkrgs", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    # [B, K, Gr, G, G] scores against the block's own keys
+    self_scores = jnp.einsum("bgkrd,btkd->bkrgt", qg, k_new,
+                             preferred_element_type=jnp.float32) * scale
+    if logit_softcap:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+        self_scores = logit_softcap * jnp.tanh(
+            self_scores / logit_softcap)
+
+    delta = q_positions[:, :, None] - kv_positions[:, None, :]  # [B,G,S]
+    mask = kv_valid[:, None, :].astype(bool) & (delta >= 0)
+    if window is not None:
+        mask = mask & (delta < window)
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    sdelta = q_positions[:, :, None] - q_positions[:, None, :]  # [B,G,G]
+    smask = sdelta >= 0
+    if window is not None:
+        smask = smask & (sdelta < window)
+    self_scores = jnp.where(smask[:, None, None, :, :], self_scores,
+                            NEG_INF)
+
+    joint = jnp.concatenate([scores, self_scores], axis=-1)
+    joint = joint - jnp.max(joint, axis=-1, keepdims=True)
+    weights = jnp.exp(joint)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    w_cache = weights[..., :s].astype(v_cache.dtype)
+    w_self = weights[..., s:].astype(v_new.dtype)
+    out = jnp.einsum("bkrgs,bskd->bgkrd", w_cache, v_cache)
+    out = out + jnp.einsum("bkrgt,btkd->bgkrd", w_self, v_new)
+    return out.reshape(b, g, h, d)
+
+
 def decode_attention(
     q: jnp.ndarray,       # [B, 1, H, D]  the current token's query
     k_cache: jnp.ndarray,  # [B, S, K, D]  cache BEFORE this step's write

@@ -47,6 +47,29 @@ def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(keep, logits, NEG_INF)
 
 
+def filtered_probs(
+    logits: jnp.ndarray,  # [..., V]
+    *,
+    temperature: float = 1.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
+    do_sample: bool = True,
+) -> jnp.ndarray:
+    """The probability vector ``sample_token`` draws from, materialized:
+    softmax of the temperature/top-k/top-p-filtered logits — or a
+    one-hot at the argmax for greedy decoding (so speculative
+    decoding's accept ratio p/q and residual max(p-q, 0) cover greedy
+    and sampling with ONE rule). fp32 [..., V], rows sum to 1."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample or temperature == 0.0:
+        return jax.nn.one_hot(jnp.argmax(logits, axis=-1),
+                              logits.shape[-1], dtype=jnp.float32)
+    logits = apply_temperature(logits, temperature)
+    logits = top_k_mask(logits, top_k)
+    logits = top_p_mask(logits, top_p)
+    return jax.nn.softmax(logits, axis=-1)
+
+
 def sample_token(
     rng: jax.Array,
     logits: jnp.ndarray,  # [B, V]

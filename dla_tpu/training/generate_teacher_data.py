@@ -55,6 +55,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     # --output_path
     p.add_argument("--shard_index", type=int, default=0)
     p.add_argument("--shard_count", type=int, default=1)
+    # speculative decoding: a small same-tokenizer checkpoint proposes,
+    # the teacher verifies blockwise — exact (outputs distributed as
+    # plain teacher sampling), dla_tpu/generation/speculative.py
+    p.add_argument("--draft_model_name_or_path", default=None)
+    p.add_argument("--speculative_gamma", type=int, default=4)
     return p.parse_args(argv)
 
 
@@ -63,11 +68,18 @@ def main(argv=None) -> None:
     rng = seed_everything(args.seed)
     model_cfg = {"tokenizer": args.tokenizer} if args.tokenizer else {}
     bundle = load_causal_lm(args.model_name_or_path, model_cfg, rng)
-    engine = GenerationEngine(
-        bundle.model, bundle.tokenizer,
-        GenerationConfig(max_new_tokens=args.max_new_tokens,
-                         temperature=args.temperature, top_p=args.top_p,
-                         do_sample=args.temperature > 0))
+    gen = GenerationConfig(max_new_tokens=args.max_new_tokens,
+                           temperature=args.temperature, top_p=args.top_p,
+                           do_sample=args.temperature > 0)
+    if args.draft_model_name_or_path:
+        from dla_tpu.generation.speculative import SpeculativeEngine
+        draft = load_causal_lm(args.draft_model_name_or_path, model_cfg,
+                               jax.random.fold_in(rng, 17))
+        engine = SpeculativeEngine(
+            bundle.model, draft.model, draft.params, bundle.tokenizer,
+            gen, gamma=args.speculative_gamma)
+    else:
+        engine = GenerationEngine(bundle.model, bundle.tokenizer, gen)
 
     rm_bundle = None
     score_fn = None

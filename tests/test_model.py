@@ -180,3 +180,57 @@ def test_tied_embeddings():
     assert "lm_head" not in params
     logits = model.apply(params, jnp.ones((1, 4), jnp.int32))
     assert logits.shape == (1, 4, cfg.vocab_size)
+
+
+def test_decode_block_matches_sequential_steps_and_retracts():
+    """decode_block(G tokens) == G decode_step calls (logits, cache KV,
+    validity, positions), and retract_block rolls back a per-row suffix
+    exactly (the speculative-decoding verify/reject primitive)."""
+    import numpy as np
+
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+
+    cfg = ModelConfig(
+        vocab_size=120, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_length=64,
+        attention="xla", remat="none", dtype="float32",
+        param_dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.RandomState(0)
+    b, t, g = 2, 10, 4
+    ids = jnp.asarray(rng.randint(3, 110, (b, t)), jnp.int32)
+    mask = jnp.ones((b, t), jnp.int32)
+    mask = mask.at[1, t - 3:].set(0)
+    _, cache0 = model.start_decode(params, ids, mask, 12)
+    toks = jnp.asarray(rng.randint(3, 110, (b, g)), jnp.int32)
+
+    c = cache0
+    lseq = []
+    for i in range(g):
+        l, c = model.decode_step(params, c, toks[:, i])
+        lseq.append(l)
+    lseq = jnp.stack(lseq, 1)
+    lblk, cblk = model.decode_block(params, cache0, toks)
+    np.testing.assert_allclose(np.asarray(lblk), np.asarray(lseq),
+                               atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(cblk["valid"]),
+                                  np.asarray(c["valid"]))
+    assert bool(jnp.where(cblk["valid"], cblk["pos"] == c["pos"],
+                          True).all())
+    np.testing.assert_allclose(np.asarray(cblk["k"]), np.asarray(c["k"]),
+                               atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(cblk["lengths"]),
+                                  np.asarray(c["lengths"]))
+
+    keep = jnp.asarray([2, 0], jnp.int32)
+    r = model.retract_block(cblk, keep, g)
+    col0 = int(cache0["prompt_width"])
+    want = np.asarray(cblk["valid"]).copy()
+    want[0, col0 + 2:col0 + 4] = False
+    want[1, col0:col0 + 4] = False
+    np.testing.assert_array_equal(np.asarray(r["valid"]), want)
+    np.testing.assert_array_equal(
+        np.asarray(r["lengths"]),
+        np.asarray(cblk["lengths"]) - g + np.asarray(keep))

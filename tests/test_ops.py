@@ -341,3 +341,50 @@ def test_sample_token_greedy_and_temperature():
     draws = [int(sample_token(jax.random.key(i), logits, temperature=1.0)[0])
              for i in range(20)]
     assert draws.count(1) >= 15
+
+
+def test_block_decode_attention_matches_concat_reference():
+    """block_decode_attention == causal_attention over [cache ++ block]
+    with validity folded in, and degenerates to decode_attention at
+    G=1 — windowed and unwindowed."""
+    import numpy as np
+
+    from dla_tpu.ops.attention import (
+        block_decode_attention,
+        causal_attention,
+        decode_attention,
+    )
+    rng = np.random.RandomState(0)
+    b, s, g, h, kh, d = 2, 24, 4, 4, 2, 16
+    q = jnp.asarray(rng.randn(b, g, h, d), jnp.float32)
+    kc = jnp.asarray(rng.randn(b, s, kh, d), jnp.float32)
+    vc = jnp.asarray(rng.randn(b, s, kh, d), jnp.float32)
+    kn = jnp.asarray(rng.randn(b, g, kh, d), jnp.float32)
+    vn = jnp.asarray(rng.randn(b, g, kh, d), jnp.float32)
+    valid = jnp.asarray(rng.rand(b, s) < 0.8)
+    lengths = jnp.asarray([15, 9], jnp.int32)
+    qpos = lengths[:, None] + jnp.arange(g)[None, :]
+    kpos = jnp.broadcast_to(jnp.arange(s)[None], (b, s)).astype(jnp.int32)
+
+    o1 = block_decode_attention(q[:, :1], kc, vc, kn[:, :1], vn[:, :1],
+                                kv_valid=valid, q_positions=qpos[:, :1],
+                                kv_positions=kpos)
+    o1r = decode_attention(q[:, :1], kc, vc, kn[:, :1], vn[:, :1],
+                           kv_valid=valid, q_positions=qpos[:, :1],
+                           kv_positions=kpos)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o1r), atol=1e-5)
+
+    k_all = jnp.concatenate([kc, kn], 1)
+    v_all = jnp.concatenate([vc, vn], 1)
+    valid_all = jnp.concatenate([valid, jnp.ones((b, g), bool)], 1)
+    pos_all = jnp.concatenate([kpos, qpos], 1)
+    segmask = jnp.broadcast_to(valid_all[:, None, :], (b, g, s + g))
+    for win in (None, 6):
+        ref = causal_attention(q, k_all, v_all, kv_segment_mask=segmask,
+                               q_positions=qpos, kv_positions=pos_all,
+                               window=win)
+        out = block_decode_attention(q, kc, vc, kn, vn, kv_valid=valid,
+                                     q_positions=qpos, kv_positions=kpos,
+                                     window=win)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, err_msg=f"win={win}")
