@@ -189,22 +189,32 @@ def main(argv=None) -> None:
     # generation.draft_model: speculative decoding for every evaluated
     # model — a small same-tokenizer checkpoint proposes, each target
     # verifies blockwise (dla_tpu/generation/speculative.py; exact:
-    # outputs are distributed as plain target decoding)
+    # outputs are distributed as plain target decoding). The special
+    # value "int8" self-speculates: the draft is the target's own
+    # weight-quantized tree (no second checkpoint; near-total
+    # acceptance, draft steps at int8 weight-read cost)
+    draft_spec = gen_cfg.get("draft_model")
     draft_bundle = None
-    if gen_cfg.get("draft_model"):
-        log_rank_zero("[dla_tpu][eval] speculative draft: "
-                      f"{gen_cfg['draft_model']}")
+    if draft_spec and str(draft_spec) != "int8":
+        log_rank_zero(f"[dla_tpu][eval] speculative draft: {draft_spec}")
         draft_bundle = load_causal_lm(
-            str(gen_cfg["draft_model"]), model_extra,
-            jax.random.fold_in(rng, 17))
+            str(draft_spec), model_extra, jax.random.fold_in(rng, 17))
 
     for model_name, model_path in config["models"].items():
         log_rank_zero(f"[dla_tpu][eval] loading {model_name}: {model_path}")
         bundle = load_causal_lm(str(model_path), model_extra, rng)
-        if draft_bundle is not None:
+        if draft_spec:
             from dla_tpu.generation.speculative import SpeculativeEngine
+            if draft_bundle is not None:
+                d_model, d_params = draft_bundle.model, draft_bundle.params
+            else:   # "int8": self-speculation via the quantized tree
+                log_rank_zero(f"[dla_tpu][eval] {model_name}: "
+                              "self-speculative decoding (int8 draft of "
+                              "the target's own weights)")
+                d_model = bundle.model
+                d_params = bundle.model.quantize_weights(bundle.params)
             engine = SpeculativeEngine(
-                bundle.model, draft_bundle.model, draft_bundle.params,
+                bundle.model, d_model, d_params,
                 bundle.tokenizer, gen,
                 gamma=int(gen_cfg.get("speculative_gamma", 4)),
                 alloc_factor=float(

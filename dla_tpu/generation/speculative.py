@@ -102,7 +102,7 @@ def build_speculative_generate_fn(
 
         def round_body(state):
             (rnd, t_cache, d_cache, pending, done, ptr, toks, emits,
-             acc_total) = state
+             acc_total, prop_total) = state
             done_at_entry = done
 
             # ---- draft phase: gamma sequential steps, gamma - 1 used
@@ -179,21 +179,24 @@ def build_speculative_generate_fn(
             committed = jnp.sum(live, axis=1)
             ptr = jnp.minimum(ptr + committed, n)
             done = done | (ptr >= n)
-            # telemetry: accepted proposals from rows LIVE at round
-            # entry only (done rows keep spinning with garbage k until
-            # the loop exits)
-            acc_total = acc_total + jnp.sum(jnp.where(done_at_entry,
-                                                      0, k))
+            # telemetry: accepted proposals and proposal SLOTS from rows
+            # LIVE at round entry only (done rows keep spinning with
+            # garbage k until the loop exits) — acceptance rate is
+            # accepted_tokens / proposal_slots, unbiased by stragglers
+            live_rows = (~done_at_entry).astype(jnp.int32)
+            acc_total = acc_total + jnp.sum(live_rows * k)
+            prop_total = prop_total + jnp.sum(live_rows) * (gamma - 1)
             return (rnd + 1, t_cache, d_cache, pending_next, done, ptr,
-                    toks, emits, acc_total)
+                    toks, emits, acc_total, prop_total)
 
         def cond(state):
             rnd, done = state[0], state[4]
             return (rnd < rounds) & ~jnp.all(done)
 
         state = (jnp.int32(0), t_cache, d_cache, p0, done0, ptr0, toks,
-                 emits, jnp.zeros((), jnp.int32))
-        (rnd, _, _, _, _, ptr, toks, emits, acc_total) = \
+                 emits, jnp.zeros((), jnp.int32),
+                 jnp.zeros((), jnp.int32))
+        (rnd, _, _, _, _, ptr, toks, emits, acc_total, prop_total) = \
             jax.lax.while_loop(cond, round_body, state)
 
         response_mask = emits.astype(jnp.int32)
@@ -208,6 +211,7 @@ def build_speculative_generate_fn(
             "response_mask": response_mask,
             "lengths": jnp.sum(raw_mask, axis=1),
             "accepted_tokens": acc_total,
+            "proposal_slots": prop_total,  # live-row proposals offered
             "verify_rounds": rnd,
         }
 

@@ -228,3 +228,22 @@ def test_sweep_decode_int8_variant_smoke():
         layers=2, heads=2, kv_heads=1, kv_dtype="int8", weights="int8")
     assert row["ms_per_token"] > 0
     assert row["kv"] == "int8" and row["weights"] == "int8"
+
+
+def test_sweep_decode_selfspec_variant_smoke():
+    """Self-speculative variant: int8 tree drafts for its own target;
+    must deliver tokens with a sane acceptance rate at toy scale."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import sweep_decode
+
+    row = sweep_decode.run_variant(
+        "smoke_spec", batch=2, prompt=8, new=6, hidden=32, inter=64,
+        layers=2, heads=2, kv_heads=1, speculative="selfint8", gamma=3)
+    assert row["emitted"] > 0
+    assert 0.0 <= row["accept_rate"] <= 1.0
+    assert row["spec"] == "selfint8"
+    assert row["verify_rounds"] >= 1
+    import math
+    assert math.isfinite(row["ms_per_token"])  # prefill-subtracted

@@ -59,9 +59,8 @@ def test_greedy_same_draft_bit_identical_and_all_accepted(models):
                                   np.asarray(out["response_tokens"]))
     np.testing.assert_array_equal(np.asarray(ref["response_mask"]),
                                   np.asarray(out["response_mask"]))
-    rounds = int(out["verify_rounds"])
-    # a perfect draft accepts every proposal in every round
-    assert int(out["accepted_tokens"]) == rounds * 3 * ids.shape[0]
+    # a perfect draft accepts every proposal slot it is offered
+    assert int(out["accepted_tokens"]) == int(out["proposal_slots"]) > 0
 
 
 def test_greedy_any_draft_exact(models):
@@ -142,8 +141,7 @@ def test_sampling_same_draft_accepts_everything(models):
     out = jax.jit(build_speculative_generate_fn(
         target, target, gen, gamma=4))(
         tp, tp, ids, mask, jax.random.key(7))
-    rounds = int(out["verify_rounds"])
-    assert int(out["accepted_tokens"]) == rounds * 3 * ids.shape[0]
+    assert int(out["accepted_tokens"]) == int(out["proposal_slots"]) > 0
     toks = np.asarray(out["response_tokens"])
     m = np.asarray(out["response_mask"]).astype(bool)
     assert m.all()  # full acceptance delivers every requested token
@@ -162,8 +160,7 @@ def test_sampling_divergent_draft_emits_valid_stream(models):
     out = jax.jit(build_speculative_generate_fn(
         target, draft, gen, gamma=4, alloc_factor=4.0))(
         tp, dp, ids, mask, jax.random.key(9))
-    rounds = int(out["verify_rounds"])
-    assert 0 <= int(out["accepted_tokens"]) <= rounds * 3 * ids.shape[0]
+    assert 0 <= int(out["accepted_tokens"]) <= int(out["proposal_slots"])
     m = np.asarray(out["response_mask"]).astype(bool)
     toks = np.asarray(out["response_tokens"])
     assert ((toks[m] >= 0) & (toks[m] < target.cfg.vocab_size)).all()
