@@ -197,3 +197,30 @@ def test_speculative_engine_generates_text(models):
                                    jax.random.key(0))
     assert len(texts) == 2 and all(isinstance(t, str) for t in texts)
     assert int(out["verify_rounds"]) >= 1
+
+
+def test_greedy_exact_on_gemma2_style_target(models):
+    """Speculative greedy exactness must survive the hardest arch
+    composition: logit softcapping + alternating per-layer sliding
+    windows (traced swa_on) in BOTH decode_step and decode_block."""
+    _, _, draft, dp = models
+    cfg = ModelConfig(
+        vocab_size=120, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_length=128,
+        attention="xla", remat="none", dtype="float32",
+        param_dtype="float32", sliding_window=6,
+        sliding_window_pattern=2, attn_logit_softcap=30.0)
+    target = Transformer(cfg)
+    tp = target.init(jax.random.key(8))
+    ids, mask = _prompts()
+    gen = GenerationConfig(max_new_tokens=10, do_sample=False,
+                           eos_token_id=-1, pad_token_id=0)
+    ref = jax.jit(build_generate_fn(target, gen))(
+        tp, ids, mask, jax.random.key(1))
+    out = jax.jit(build_speculative_generate_fn(
+        target, draft, gen, gamma=3, alloc_factor=4.0))(
+        tp, dp, ids, mask, jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(ref["response_tokens"]),
+                                  np.asarray(out["response_tokens"]))
+    np.testing.assert_array_equal(np.asarray(ref["response_mask"]),
+                                  np.asarray(out["response_mask"]))
