@@ -478,6 +478,58 @@ def run_decode_bench() -> dict:
     }
 
 
+def run_serving_bench() -> dict:
+    """Continuous-batching serving throughput: requests/s and TTFT/ITL
+    percentiles under a Poisson arrival trace through the paged-KV
+    engine (dla_tpu/serving) — the rollout-side counterpart of the
+    decode bench's fixed-batch ms/token."""
+    import jax
+    from dla_tpu.eval.eval_latency import measure_serving
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    if on_accel:
+        cfg = ModelConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=24, num_heads=8, num_kv_heads=4,
+            max_seq_length=2048, attention="flash", remat="none",
+            dtype="bfloat16", param_dtype="bfloat16")
+        srv = {"num_requests": 32, "arrival_rate": 32.0, "new_tokens": 64,
+               "prompt_len_min": 32, "prompt_len_max": 128,
+               "page_size": 16, "num_pages": 512, "num_slots": 8,
+               "max_model_len": 256, "max_prefill_batch": 4}
+    else:
+        cfg = ModelConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=192,
+            num_layers=2, num_heads=4, num_kv_heads=4,
+            max_seq_length=128, remat="none", dtype="float32",
+            param_dtype="float32")
+        srv = {"num_requests": 6, "arrival_rate": 100.0, "new_tokens": 8,
+               "prompt_len_min": 4, "prompt_len_max": 16,
+               "page_size": 4, "num_pages": 64, "num_slots": 2,
+               "max_model_len": 32, "max_prefill_batch": 2}
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    row = measure_serving(model, params, srv)
+    return {
+        "metric": "serving_requests_per_s",
+        "value": round(row["requests_per_second"], 3),
+        "unit": "req/s",
+        "detail": {"requests_per_s": round(row["requests_per_second"], 3),
+                   "ttft_ms_p50": round(row["ttft_ms_p50"], 2),
+                   "ttft_ms_p95": round(row["ttft_ms_p95"], 2),
+                   "itl_ms_p50": round(row["itl_ms_p50"], 3),
+                   "page_occupancy": round(row["page_occupancy_peak"], 4),
+                   "serve_tok_s": round(row["serve_tokens_per_second"], 1),
+                   "preemptions": int(row["preemptions"]),
+                   "num_slots": row["num_slots"],
+                   "num_requests": row["num_requests"],
+                   "arrival_rate": row["arrival_rate"],
+                   "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def _child_env(mode: str) -> dict:
     from _cpuhost import prepend_pythonpath, scrubbed_cpu_env
     if mode == "cpu":
@@ -552,7 +604,7 @@ def _emit_and_maybe_extra() -> None:
     if not os.environ.get("DLA_BENCH_EXTRA"):
         return
     extra = [headline]
-    for fn in (run_ppo_bench, run_decode_bench):
+    for fn in (run_ppo_bench, run_decode_bench, run_serving_bench):
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — extras must not kill the line

@@ -34,6 +34,10 @@ from dla_tpu.utils.logging import log_rank_zero
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description="dla_tpu latency benchmark")
     p.add_argument("--config", required=True)
+    p.add_argument("--serving", action="store_true",
+                   help="also run the continuous-batching serving engine "
+                        "on a synthetic Poisson arrival trace (equivalent "
+                        "to latency.serving.enabled: true)")
     return p.parse_args(argv)
 
 
@@ -126,6 +130,77 @@ def measure_decode(model, params, batch_size: int, prompt_len: int,
     }
 
 
+def measure_serving(model, params, srv: Dict) -> Dict[str, float]:
+    """Continuous-batching engine under a synthetic Poisson arrival
+    trace: per-request TTFT and inter-token-latency percentiles
+    (p50/p95), sustained request/token throughput, preemption count and
+    peak page-pool occupancy. Open-loop arrivals — a request's TTFT
+    clock starts at its SCHEDULED arrival, so queueing delay under load
+    is measured, not hidden."""
+    from dla_tpu.serving import ServingConfig, ServingEngine
+
+    n = int(srv.get("num_requests", 16))
+    rate = float(srv.get("arrival_rate", 16.0))     # requests / second
+    new_tokens = int(srv.get("new_tokens", 32))
+    pmin = int(srv.get("prompt_len_min", 8))
+    pmax = int(srv.get("prompt_len_max", 64))
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)          # run to length
+    scfg = ServingConfig(
+        page_size=int(srv.get("page_size", 16)),
+        num_pages=int(srv.get("num_pages", 256)),
+        num_slots=int(srv.get("num_slots", 8)),
+        max_model_len=int(srv.get("max_model_len", 256)),
+        max_prefill_batch=int(srv.get("max_prefill_batch", 4)))
+    eng = ServingEngine(model, params, gen, scfg)
+    rs = np.random.RandomState(int(srv.get("seed", 0)))
+    prompts = [list(rs.randint(3, model.cfg.vocab_size - 1,
+                               (rs.randint(pmin, pmax + 1),)))
+               for _ in range(n)]
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n))
+
+    # warm the compile caches — the decode step and EVERY prefill bucket
+    # the trace will hit — on the same engine instance, then zero the
+    # instrument panel: percentiles must measure serving, not XLA
+    slot_w = eng.cache.geom.slot_window
+    for width in sorted({eng.scheduler.bucket_width(len(p))
+                         for p in prompts}):
+        plen = min(width, slot_w - 1)   # leave room for the 1 new token
+        eng.submit([3 + (i % 251) for i in range(plen)], 1)
+    eng.run_until_drained()
+    from dla_tpu.serving.metrics import ServingMetrics
+    eng.metrics = ServingMetrics()
+
+    t0 = time.perf_counter()
+    submitted = 0
+    while submitted < n or eng.has_work():
+        now = time.perf_counter() - t0
+        while submitted < n and arrivals[submitted] <= now:
+            eng.submit(prompts[submitted], new_tokens,
+                       arrival_time=t0 + arrivals[submitted])
+            submitted += 1
+        if not eng.has_work():
+            continue   # open-loop: idle-spin until the next arrival
+        eng.step()
+    dt = time.perf_counter() - t0
+    snap = eng.metrics.snapshot()
+    return {
+        "num_requests": n,
+        "arrival_rate": rate,
+        "new_tokens": new_tokens,
+        "num_slots": scfg.num_slots,
+        "duration_s": dt,
+        "requests_per_second": n / dt,
+        "serve_tokens_per_second": snap["serving/tokens_generated"] / dt,
+        "ttft_ms_p50": snap["serving/ttft_ms_p50"],
+        "ttft_ms_p95": snap["serving/ttft_ms_p95"],
+        "itl_ms_p50": snap["serving/itl_ms_p50"],
+        "itl_ms_p95": snap["serving/itl_ms_p95"],
+        "preemptions": snap["serving/preemptions"],
+        "page_occupancy_peak": snap["serving/page_occupancy_peak"],
+    }
+
+
 def main(argv=None) -> None:
     args = parse_args(argv)
     config = load_config(args.config)
@@ -166,6 +241,16 @@ def main(argv=None) -> None:
                 log_rank_zero(f"[dla_tpu][latency] decode: "
                               f"{entry['decode']['decode_tokens_per_second']:.0f}"
                               " tok/s")
+            srv = lat.get("serving", {})
+            if args.serving or srv.get("enabled", False):
+                entry["serving"] = measure_serving(
+                    bundle.model, bundle.params, srv)
+                log_rank_zero(
+                    f"[dla_tpu][latency] serving: "
+                    f"{entry['serving']['requests_per_second']:.2f} req/s "
+                    f"ttft p50 {entry['serving']['ttft_ms_p50']:.1f} ms "
+                    f"itl p50 {entry['serving']['itl_ms_p50']:.2f} ms "
+                    f"({entry['serving']['preemptions']:.0f} preemptions)")
         finally:
             # a mid-grid failure must not lose the already-captured trace
             if trace_dir:

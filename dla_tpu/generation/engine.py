@@ -86,6 +86,43 @@ def encode_prompt_batch(tokenizer, prompts, width: int):
     return ids, mask
 
 
+def build_prefill_step(model: Transformer, max_new_tokens: int):
+    """Public single-step prefill: ``fn(params, input_ids,
+    attention_mask) -> (logits [B, V], cache)`` — ``start_decode`` with
+    the decode budget bound statically so the result jits per prompt
+    shape. Shared by the fixed-batch generate loop and any caller that
+    drives decode one step at a time (eval harness, serving engine)."""
+    def prefill_step(params, input_ids, attention_mask):
+        return model.start_decode(
+            params, input_ids, attention_mask, max_new_tokens)
+    return prefill_step
+
+
+def build_decode_step(model: Transformer, gen: GenerationConfig):
+    """Public single-step sampled decode: ``fn(rng, params, logits,
+    cache, done) -> (tok, emit_mask, logits, cache, done)``.
+
+    This is THE step of autoregressive generation — sample from the
+    incoming logits, hold finished rows at pad, advance the KV cache —
+    factored out of ``build_generate_fn`` so the fixed-batch scan/while
+    schedules and step-at-a-time drivers (latency percentile harness,
+    serving scheduler) run the exact same math. ``build_generate_fn``
+    composes its loops from this function, so the factoring is
+    bit-identical by construction (pinned by the existing generation
+    tests)."""
+    def decode_step(rng, params, logits, cache, done):
+        tok = sample_token(
+            rng, logits,
+            temperature=gen.temperature, top_p=gen.top_p,
+            top_k=gen.top_k, do_sample=gen.do_sample)
+        tok = jnp.where(done, gen.pad_token_id, tok)
+        emit_mask = ~done
+        done = done | (tok == gen.eos_token_id)
+        logits, cache = model.decode_step(params, cache, tok)
+        return tok, emit_mask, logits, cache, done
+    return decode_step
+
+
 def build_generate_fn(model: Transformer, gen: GenerationConfig):
     """Returns a jittable ``fn(params, input_ids, attention_mask, rng)`` ->
     dict of device arrays:
@@ -94,6 +131,8 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig):
       response_tokens/response_mask [B, N]
       lengths [B] total real tokens (prompt + generated, incl. eos)
     """
+    single_step = build_decode_step(model, gen)
+
     def generate(params, input_ids, attention_mask, rng):
         b, p_width = input_ids.shape
         n = gen.max_new_tokens
@@ -104,15 +143,7 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig):
         done0 = jnp.zeros((b,), bool)
 
         def step_fn(step, logits, cache, done):
-            tok = sample_token(
-                rngs[step], logits,
-                temperature=gen.temperature, top_p=gen.top_p,
-                top_k=gen.top_k, do_sample=gen.do_sample)
-            tok = jnp.where(done, gen.pad_token_id, tok)
-            emit_mask = ~done
-            done = done | (tok == gen.eos_token_id)
-            logits, cache = model.decode_step(params, cache, tok)
-            return tok, emit_mask, logits, cache, done
+            return single_step(rngs[step], params, logits, cache, done)
 
         if (gen.eos_token_id is not None and gen.eos_token_id >= 0
                 and gen.early_exit_chunk > 0 and n > 0):
@@ -224,6 +255,11 @@ class GenerationEngine:
             eos_token_id=tokenizer.eos_token_id,
             pad_token_id=tokenizer.pad_token_id)
         self._fn = jax.jit(build_generate_fn(model, self.gen))
+        # public single-step surface: the same prefill/decode step the
+        # fused generate loop runs, jitted for step-at-a-time drivers
+        self.prefill_step = jax.jit(
+            build_prefill_step(model, self.gen.max_new_tokens))
+        self.decode_step = jax.jit(build_decode_step(model, self.gen))
 
     def encode_prompts(self, prompts, max_prompt_len: int):
         return encode_prompt_batch(self.tokenizer, prompts, max_prompt_len)

@@ -90,14 +90,24 @@ def _constrain(x, spec):
 
 
 def _ambient_mesh():
-    """The ambient abstract mesh, or None when absent/empty/unavailable."""
+    """The ambient mesh, or None when absent/empty/unavailable.
+
+    Prefers ``jax.sharding.get_abstract_mesh`` (jax >= 0.5); on older
+    jax — where that symbol is a deprecation stub or missing — the
+    ``with mesh:`` context lives in ``thread_resources.env.physical_mesh``
+    (a concrete Mesh, which every consumer here accepts: ``auto_axes``
+    treats it as all-auto and shard_map takes it directly)."""
     try:
         mesh = jax.sharding.get_abstract_mesh()
-        if mesh is None or mesh.empty:
+    except (ValueError, RuntimeError, AttributeError):
+        try:
+            from jax._src.mesh import thread_resources
+            mesh = thread_resources.env.physical_mesh
+        except (ImportError, AttributeError, ValueError, RuntimeError):
             return None
-        return mesh
-    except (ValueError, RuntimeError):
+    if mesh is None or mesh.empty:
         return None
+    return mesh
 
 
 def _sequence_axis_size() -> int:
@@ -1354,22 +1364,17 @@ class Transformer:
             specs["v_scale"] = P(None, ("data", "fsdp"), "model", None)
         return specs
 
-    def prefill(self, params: Params, cache: Params,
-                input_ids: jnp.ndarray, attention_mask: jnp.ndarray,
-                ) -> Tuple[jnp.ndarray, Params]:
-        """Run the prompt through the model, writing the cache at [0, T).
+    def prefill_external(self, params: Params, input_ids: jnp.ndarray,
+                         attention_mask: jnp.ndarray,
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """The cache-layout-agnostic half of prefill: run the prompt
+        forward and hand back the raw KV columns instead of writing any
+        particular cache. Returns (last-real-token logits [B, V],
+        ks [L, B, T, KH, D], vs [L, B, T, KH, D]) in activation dtype.
 
-        Prompts are right-padded to T; pad positions are marked invalid in
-        the cache and the returned logits come from the last *real* token.
-        Returns (last-real-token logits [B, V], cache).
-
-        When the flash backend is on and T tiles its blocks, prefill runs
-        the blockwise kernel with NO [B, T, T] mask materialization —
-        right padding makes the causal structure sufficient: every pad key
-        sits above the causal diagonal of every real query, and pad-query
-        rows are garbage nothing consumes (VERDICT round-1 item 6; the 32k
-        long-context rollout path stays O(T) HBM like training).
-        """
+        ``prefill`` packs these into the contiguous cache; the serving
+        engine (dla_tpu/serving) scatters them into its block-paged
+        pool — one forward, two cache layouts."""
         cfg = self.cfg
         b, t = input_ids.shape
         positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
@@ -1406,7 +1411,28 @@ class Transformer:
         last_idx = jnp.maximum(lengths - 1, 0)
         last_h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
         logits = self.unembed(params, last_h)
+        return logits, ks, vs
 
+    def prefill(self, params: Params, cache: Params,
+                input_ids: jnp.ndarray, attention_mask: jnp.ndarray,
+                ) -> Tuple[jnp.ndarray, Params]:
+        """Run the prompt through the model, writing the cache at [0, T).
+
+        Prompts are right-padded to T; pad positions are marked invalid in
+        the cache and the returned logits come from the last *real* token.
+        Returns (last-real-token logits [B, V], cache).
+
+        When the flash backend is on and T tiles its blocks, prefill runs
+        the blockwise kernel with NO [B, T, T] mask materialization —
+        right padding makes the causal structure sufficient: every pad key
+        sits above the causal diagonal of every real query, and pad-query
+        rows are garbage nothing consumes (VERDICT round-1 item 6; the 32k
+        long-context rollout path stays O(T) HBM like training).
+        """
+        b, t = input_ids.shape
+        logits, ks, vs = self.prefill_external(
+            params, input_ids, attention_mask)
+        lengths = attention_mask.astype(jnp.int32).sum(axis=1)
         max_len = cache["k"].shape[2]
         pad = max_len - t
         pad5 = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
@@ -1662,6 +1688,60 @@ class Transformer:
             new_cache["k"] = write_col(cache["k"], k_cols)
             new_cache["v"] = write_col(cache["v"], v_cols)
         return logits, new_cache
+
+    def decode_step_paged(self, params: Params, view: Params,
+                          tokens: jnp.ndarray,  # [B] the tokens just sampled
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One decode step against an EXTERNALLY-gathered KV view — the
+        cache-layout-agnostic sibling of ``decode_step``. The serving
+        engine's block-paged pool (dla_tpu/serving/kv_blocks.py) gathers
+        each sequence's pages into a [B, S] window via its block table and
+        hands the result here; this method never writes a cache — it
+        returns the step's fresh KV columns for the caller to scatter
+        back into whatever layout it owns.
+
+        ``view``:
+          k, v     [L, B, S, KH, D]  gathered cache (activation dtype)
+          valid    [B, S]            columns that may be attended
+          pos      [B, S]            logical position per column
+          lengths  [B]               true tokens so far = this query's pos
+
+        Returns (logits [B, V], k_cols [L, B, 1, KH, D], v_cols). Rows
+        whose view is garbage (freed serving slots) compute garbage that
+        the caller masks — static shapes, no recompilation as requests
+        come and go. int8 KV paging is not plumbed yet: serving pages
+        store the activation dtype."""
+        cfg = self.cfg
+        if self._kv_int8:
+            raise NotImplementedError(
+                "decode_step_paged serves activation-dtype pages; "
+                "kv_cache_dtype=int8 is only wired into the contiguous "
+                "decode_step path")
+        positions = view["lengths"][:, None]               # [B, 1]
+        x = self._embed(params, tokens[:, None])
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
+
+        def body(carry, xs):
+            layer, k_cache, v_cache = xs
+
+            def attend(q, k, v):
+                return decode_attention(
+                    q, k_cache, v_cache, k, v,
+                    kv_valid=view["valid"],
+                    q_positions=positions, kv_positions=view["pos"],
+                    window=self._layer_window(layer),
+                    softmax_scale=self._softmax_scale,
+                    logit_softcap=cfg.attn_logit_softcap)
+
+            return self._decode_layer(layer, carry, cos, sin, attend)
+
+        xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
+              view["k"], view["v"])
+        x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
+        h = self._final_norm(params, x)
+        logits = self.unembed(params, h[:, 0])
+        return logits, k_cols, v_cols
 
     def start_decode(self, params: Params, input_ids: jnp.ndarray,
                      attention_mask: jnp.ndarray, max_new_tokens: int,

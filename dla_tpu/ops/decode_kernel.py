@@ -38,6 +38,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax >= 0.5 renamed TPUCompilerParams -> CompilerParams; accept either
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 DEFAULT_BLOCK_S = 512
 GP = 8  # query-group sublane padding
@@ -198,7 +202,7 @@ def _call(q3, kn2, vn2, bias, kc, vc, ks, vs, kv_fill, scale, block_s,
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, khgp, dh), jnp.float32),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(last_blk, *args)

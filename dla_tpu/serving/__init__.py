@@ -1,0 +1,43 @@
+"""Continuous-batching inference core (dla_tpu/serving).
+
+The serving layer decouples REQUEST admission from STEP execution — the
+property that lets a static-shape, never-recompiled decode loop serve
+requests that arrive, finish, and get evicted at arbitrary times
+(Podracer-style decoupling, arxiv 2104.06272; vLLM-style paged KV).
+
+Modules:
+  kv_blocks  block-paged KV cache: fixed-size page pool + host-side
+             allocator + the in-graph block-table gather/scatter
+  scheduler  request lifecycle state machine (WAITING -> PREFILL ->
+             DECODE -> FINISHED/EVICTED), FCFS + longest-prefix
+             bucketing, eviction-on-OOM
+  server     the host engine loop driving jitted prefill/decode steps
+  metrics    queue depth, TTFT, inter-token latency, page occupancy,
+             preemption counters
+"""
+from dla_tpu.serving.kv_blocks import (
+    PageAllocator,
+    PagedKVCache,
+    PageGeometry,
+)
+from dla_tpu.serving.metrics import ServingMetrics
+from dla_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+    SchedulerConfig,
+)
+from dla_tpu.serving.server import ServingConfig, ServingEngine
+
+__all__ = [
+    "PageAllocator",
+    "PagedKVCache",
+    "PageGeometry",
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "SchedulerConfig",
+    "ServingConfig",
+    "ServingEngine",
+    "ServingMetrics",
+]

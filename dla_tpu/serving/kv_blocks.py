@@ -1,0 +1,182 @@
+"""Block-paged KV cache: a fixed page pool shared by every in-flight
+sequence, so sequences of wildly different lengths never reserve
+worst-case contiguous cache.
+
+Layout: one preallocated pool ``k_pages``/``v_pages`` of shape
+[L, num_pages, page_size, KH, D]. Each decode SLOT (a row of the
+static-shape decode batch) owns a block table row — ``pages_per_slot``
+physical page ids — and the in-graph gather
+
+    k_view = k_pages[:, block_table]           # [L, B, P/slot, ps, KH, D]
+             .reshape(L, B, S, KH, D)          # S = pages_per_slot * ps
+
+rebuilds the contiguous [B, S] window ``Transformer.decode_step_paged``
+consumes. The gather is the whole trick: attention math stays
+layout-agnostic, the pool stays fixed-size, and page ownership is pure
+host-side bookkeeping (PageAllocator) that never touches the graph.
+
+Physical page 0 is RESERVED as the trash page: free slots' block tables
+point at it, so the static-shape decode step can let inactive rows
+write/read garbage there without branching. The allocator never hands
+page 0 out.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the fixed page pool.
+
+    Pages are fixed-size, so there is no external fragmentation — any
+    interleaving of alloc/free keeps every free page usable. Allocation
+    is all-or-nothing: a request that cannot get ALL ``n`` pages gets
+    none (no partial reservations to unwind on admission failure).
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the trash page)")
+        self.num_pages = num_pages
+        # page 0 reserved: free slots alias it for garbage traffic
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._used: set = set()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._used)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (excludes the reserved trash page)."""
+        return self.num_pages - 1
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of allocatable pages currently owned."""
+        return self.used_count / max(1, self.capacity)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None if the pool cannot supply all of them."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._used.update(pages)
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p not in self._used:
+                raise ValueError(f"double free / foreign page {p}")
+            self._used.discard(p)
+            self._free.append(p)
+
+
+@dataclasses.dataclass(frozen=True)
+class PageGeometry:
+    """Static shape parameters of a paged pool — everything the jitted
+    serving steps specialize on."""
+    page_size: int
+    num_pages: int
+    num_slots: int
+    pages_per_slot: int
+
+    @property
+    def slot_window(self) -> int:
+        """S: the per-slot logical window the gather materializes."""
+        return self.pages_per_slot * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` (ceil)."""
+        return -(-n_tokens // self.page_size)
+
+
+class PagedKVCache:
+    """Device pool + host metadata mirror for the serving decode batch.
+
+    Device state (jitted steps read/write):
+      k_pages, v_pages  [L, num_pages, page_size, KH, D]
+
+    Host mirror (authoritative, numpy — the scheduler mutates it and the
+    engine ships it to device per step; decode-step updates are
+    deterministic (+1 length, one valid column) so the host applies them
+    itself rather than fetching arrays back):
+      block_tables  [num_slots, pages_per_slot] int32 physical page ids
+      valid         [num_slots, S] attendable columns
+      pos           [num_slots, S] logical position per column
+      lengths       [num_slots]    true tokens so far
+      tokens        [num_slots]    last sampled token (next step's input)
+    """
+
+    def __init__(self, model, geom: PageGeometry):
+        cfg = model.cfg
+        self.geom = geom
+        self.dtype = model.adtype
+        shape = (cfg.num_layers, geom.num_pages, geom.page_size,
+                 cfg.num_kv_heads, cfg.head_dim_)
+        self.k_pages = jnp.zeros(shape, self.dtype)
+        self.v_pages = jnp.zeros(shape, self.dtype)
+        s = geom.slot_window
+        self.block_tables = np.zeros(
+            (geom.num_slots, geom.pages_per_slot), np.int32)
+        self.valid = np.zeros((geom.num_slots, s), bool)
+        self.pos = np.zeros((geom.num_slots, s), np.int32)
+        self.lengths = np.zeros((geom.num_slots,), np.int32)
+        self.tokens = np.zeros((geom.num_slots,), np.int32)
+        self.allocator = PageAllocator(geom.num_pages)
+
+    # ---------------------------------------------------- slot lifecycle
+
+    def open_slot(self, slot: int, pages: List[int], prompt_len: int,
+                  padded_len: int, first_token: int) -> None:
+        """Bind ``pages`` to ``slot`` and set prompt metadata: columns
+        [0, prompt_len) valid at positions 0..prompt_len-1 (prompts are
+        right-padded to ``padded_len``; pad columns hold garbage KV and
+        stay invalid). ``first_token`` is the token sampled from the
+        prefill logits — the first decode step's input."""
+        self.block_tables[slot] = 0
+        self.block_tables[slot, :len(pages)] = pages
+        self.valid[slot] = False
+        self.valid[slot, :prompt_len] = True
+        self.pos[slot] = 0
+        self.pos[slot, :padded_len] = np.arange(padded_len)
+        self.lengths[slot] = prompt_len
+        self.tokens[slot] = first_token
+
+    def close_slot(self, slot: int) -> None:
+        """Reset a slot to trash-page aliasing (pages are freed by the
+        scheduler, which owns the request -> pages mapping)."""
+        self.block_tables[slot] = 0
+        self.valid[slot] = False
+        self.pos[slot] = 0
+        self.lengths[slot] = 0
+        self.tokens[slot] = 0
+
+    def advance_slot(self, slot: int, token: int) -> None:
+        """Apply one decode step's deterministic metadata update: the
+        step wrote this slot's KV at column ``lengths`` with logical
+        position ``lengths``; ``token`` was sampled and becomes the next
+        step's input."""
+        col = int(self.lengths[slot])
+        self.valid[slot, col] = True
+        self.pos[slot, col] = col
+        self.lengths[slot] = col + 1
+        self.tokens[slot] = token
+
+    def slot_page_index(self, slot: int) -> int:
+        """Block-table index the NEXT decode write for ``slot`` needs
+        (its write column / page_size)."""
+        return int(self.lengths[slot]) // self.geom.page_size

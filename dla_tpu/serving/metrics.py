@@ -1,0 +1,99 @@
+"""Serving metrics surface: counters, gauges, and bounded histograms for
+the quantities that tell you whether a serving deployment is healthy —
+queue depth, time-to-first-token, inter-token latency, page-pool
+occupancy, preemption count.
+
+Everything exports through dla_tpu/utils/logging.py: ``snapshot()``
+returns a flat dict a ``MetricsLogger`` writes as one JSONL row (and to
+wandb when enabled); percentiles come from ``utils.logging.percentile``
+so serving and eval_latency report the same statistic.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Optional
+
+from dla_tpu.utils.logging import MetricsLogger, latency_summary
+
+
+class Counter:
+    """Monotonic event count."""
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-set value plus the observed peak (peak matters for capacity
+    questions like "did the page pool ever fill?")."""
+
+    def __init__(self):
+        self.value = 0.0
+        self.peak = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+        self.peak = max(self.peak, self.value)
+
+
+class Histogram:
+    """Windowed latency sample store (last ``window`` observations) with
+    p50/p95/mean via the shared percentile helper. A serving process
+    runs indefinitely; the bound keeps the store O(1) while the window
+    is wide enough that percentiles track current behavior."""
+
+    def __init__(self, window: int = 4096):
+        self.samples: deque = deque(maxlen=window)
+        self.total_count = 0
+
+    def record(self, v: float) -> None:
+        self.samples.append(float(v))
+        self.total_count += 1
+
+    def summary(self, prefix: str = "") -> Dict[str, float]:
+        return latency_summary(self.samples, prefix)
+
+
+class ServingMetrics:
+    """The serving engine's instrument panel. The engine records; anyone
+    (CLI harness, bench, tests) reads ``snapshot()`` or streams rows
+    through ``report()``."""
+
+    def __init__(self):
+        self.queue_depth = Gauge()
+        self.active_requests = Gauge()
+        self.page_occupancy = Gauge()
+        self.ttft_ms = Histogram()
+        self.itl_ms = Histogram()
+        self.requests_submitted = Counter()
+        self.requests_finished = Counter()
+        self.preemptions = Counter()
+        self.decode_steps = Counter()
+        self.prefill_batches = Counter()
+        self.tokens_generated = Counter()
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {
+            "serving/queue_depth": self.queue_depth.value,
+            "serving/queue_depth_peak": self.queue_depth.peak,
+            "serving/active_requests": self.active_requests.value,
+            "serving/page_occupancy": self.page_occupancy.value,
+            "serving/page_occupancy_peak": self.page_occupancy.peak,
+            "serving/requests_submitted": float(
+                self.requests_submitted.value),
+            "serving/requests_finished": float(self.requests_finished.value),
+            "serving/preemptions": float(self.preemptions.value),
+            "serving/decode_steps": float(self.decode_steps.value),
+            "serving/prefill_batches": float(self.prefill_batches.value),
+            "serving/tokens_generated": float(self.tokens_generated.value),
+        }
+        out.update(self.ttft_ms.summary("serving/ttft_ms_"))
+        out.update(self.itl_ms.summary("serving/itl_ms_"))
+        return out
+
+    def report(self, logger: Optional[MetricsLogger], step: int) -> None:
+        if logger is not None:
+            logger.log(self.snapshot(), step)

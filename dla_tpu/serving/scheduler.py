@@ -1,0 +1,274 @@
+"""Continuous-batching request scheduler: the lifecycle state machine
+that decides, each engine step, which waiting requests prefill into
+freed decode slots and which in-flight requests must yield pages.
+
+States:  WAITING -> PREFILL -> DECODE -> FINISHED
+                        ^         |
+                        +-- EVICTED (preempted on page-pool OOM; the
+                            request keeps its generated tokens, re-enters
+                            the queue head, and RECOMPUTES its whole
+                            prefix — prompt + generated-so-far — on
+                            re-admission)
+
+Admission policy: FCFS with LONGEST-PREFIX BUCKETING — the queue head
+fixes the prefill bucket (prompt width rounded up to a power-of-two page
+count), then a bounded lookahead pulls queued requests that pad to the
+same bucket into the same prefill batch. One compiled prefill per bucket
+width, full FCFS fairness for the head, and the lookahead bound keeps a
+stream of short prompts from starving a long one.
+
+Backpressure: admission requires the FULL prompt page count plus one
+decode page up front (no admission that would immediately preempt
+someone). Mid-decode page exhaustion preempts the YOUNGEST running
+request (LIFO eviction — it has the least sunk compute and its
+recompute is the cheapest), freeing pages for requests ahead of it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from dla_tpu.serving.kv_blocks import PagedKVCache
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+    EVICTED = "evicted"
+
+
+_rid_counter = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request moving through the serving engine."""
+    prompt_tokens: List[int]
+    max_new_tokens: int
+    rid: int = dataclasses.field(default_factory=lambda: next(_rid_counter))
+    arrival_time: float = 0.0
+    state: RequestState = RequestState.WAITING
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    pages: List[int] = dataclasses.field(default_factory=list)
+    evictions: int = 0
+    finish_reason: Optional[str] = None   # "eos" | "length"
+    # wall-clock marks for TTFT / inter-token latency metrics
+    first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
+
+    @property
+    def prefix_tokens(self) -> List[int]:
+        """What a (re-)prefill must run: prompt plus everything already
+        generated — the recompute contract of eviction."""
+        return self.prompt_tokens + self.generated
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    max_prefill_batch: int = 4     # requests per bucketed prefill call
+    lookahead: int = 16            # queue scan depth for bucket-mates
+    decode_reserve_pages: int = 1  # pages beyond the prompt required to admit
+
+
+class Scheduler:
+    """Pure host-side state machine over a PagedKVCache's allocator and
+    slots. The engine loop calls, per step:
+
+      1. ``release(req)``      for finished requests (slots/pages back)
+      2. ``ensure_decode_pages()``  grow running requests' block tables,
+                                    preempting on OOM
+      3. ``next_prefill_batch()``   FCFS+bucketed admission into free
+                                    slots
+    """
+
+    def __init__(self, cache: PagedKVCache, cfg: SchedulerConfig,
+                 bucket_widths: List[int]):
+        self.cache = cache
+        self.cfg = cfg
+        # ascending padded prompt widths (multiples of page_size); a
+        # prompt buckets to the smallest width that holds it
+        self.bucket_widths = sorted(bucket_widths)
+        self.queue: Deque[Request] = deque()
+        self.running: Dict[int, Request] = {}   # slot -> request
+        self.free_slots: List[int] = list(
+            range(cache.geom.num_slots - 1, -1, -1))
+        self.preemptions = 0
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, req: Request) -> None:
+        geom = self.cache.geom
+        need = len(req.prompt_tokens) + req.max_new_tokens
+        if need > geom.slot_window:
+            raise ValueError(
+                f"request {req.rid}: prompt+max_new ({need}) exceeds the "
+                f"slot window ({geom.slot_window} = {geom.pages_per_slot} "
+                f"pages x {geom.page_size})")
+        if not req.prompt_tokens:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        req.state = RequestState.WAITING
+        self.queue.append(req)
+
+    def bucket_width(self, prefix_len: int) -> int:
+        for w in self.bucket_widths:
+            if prefix_len <= w:
+                return w
+        raise ValueError(
+            f"prefix length {prefix_len} exceeds the largest prefill "
+            f"bucket {self.bucket_widths[-1]}")
+
+    # ---------------------------------------------------------- admission
+
+    def next_prefill_batch(self) -> List[Request]:
+        """FCFS + longest-prefix bucketing: the queue head fixes the
+        bucket; a bounded lookahead fills the batch with same-bucket
+        requests. Each admitted request gets a slot plus ALL its prompt
+        pages and the decode reserve — all-or-nothing, so a half-admitted
+        batch can't deadlock the pool. Admitted requests move to PREFILL
+        with pages+slot bound; the engine runs the actual forward."""
+        batch: List[Request] = []
+        if not self.queue or not self.free_slots:
+            return batch
+        head = self.queue[0]
+        width = self.bucket_width(len(head.prefix_tokens))
+        geom = self.cache.geom
+        limit = min(self.cfg.max_prefill_batch, len(self.free_slots))
+        scanned = 0
+        picked_ids = set()
+        for req in list(self.queue):
+            if len(batch) >= limit:
+                break
+            if scanned >= self.cfg.lookahead and batch:
+                break
+            scanned += 1
+            if self.bucket_width(len(req.prefix_tokens)) != width:
+                # bucketing never skips AHEAD of the head: only requests
+                # behind it may ride along, so FCFS holds for the head
+                continue
+            # cap at the block table's width: a max-width prompt whose
+            # reserve would overflow the table just starts reserve-less
+            n_pages = min(geom.pages_for(width)
+                          + self.cfg.decode_reserve_pages,
+                          geom.pages_per_slot)
+            pages = self.cache.allocator.alloc(n_pages)
+            if pages is None:
+                break  # backpressure: pool can't take another prefill
+            req.pages = pages
+            req.slot = self.free_slots.pop()
+            req.state = RequestState.PREFILL
+            picked_ids.add(req.rid)
+            batch.append(req)
+        if picked_ids:
+            self.queue = deque(
+                r for r in self.queue if r.rid not in picked_ids)
+        return batch
+
+    def activate(self, req: Request) -> None:
+        """PREFILL -> DECODE once the engine has run the prefill forward
+        and opened the slot."""
+        req.state = RequestState.DECODE
+        self.running[req.slot] = req
+
+    # --------------------------------------------------- page-pool safety
+
+    def ensure_decode_pages(self) -> List[Request]:
+        """Before a decode step: every running request whose next write
+        column crosses into an unallocated page gets one. On exhaustion,
+        preempt the youngest running request (free its slot AND pages)
+        and retry; the preempted requests are returned (already re-queued
+        at the head, FIFO among themselves)."""
+        evicted: List[Request] = []
+        for slot in sorted(self.running):
+            req = self.running.get(slot)
+            if req is None:
+                continue   # evicted while growing an earlier slot
+            while self._needs_page(req):
+                page = self.cache.allocator.alloc(1)
+                if page is not None:
+                    # table entry i holds req.pages[i]; the new page
+                    # lands at the next free entry
+                    req.pages.extend(page)
+                    self.cache.block_tables[
+                        slot, len(req.pages) - 1] = page[0]
+                    continue
+                victim = self._youngest_running(exclude_rid=None)
+                if victim is None or victim.rid == req.rid:
+                    # nothing left to evict but this request itself:
+                    # evict it (its own pages may unblock older ones)
+                    victim = req
+                self.evict(victim)
+                evicted.append(victim)
+                if victim.rid == req.rid:
+                    break  # this request is gone; stop growing it
+        return evicted
+
+    def _needs_page(self, req: Request) -> bool:
+        geom = self.cache.geom
+        next_col = int(self.cache.lengths[req.slot])
+        return next_col // geom.page_size >= len(req.pages)
+
+    def _youngest_running(self, exclude_rid=None) -> Optional[Request]:
+        cands = [r for r in self.running.values()
+                 if r.rid != exclude_rid]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: r.rid)
+
+    def evict(self, req: Request) -> None:
+        """Preempt: free slot + pages, keep generated tokens, requeue at
+        the FRONT (it was admitted before everything still waiting)."""
+        self.preemptions += 1
+        req.evictions += 1
+        self._release_resources(req)
+        req.state = RequestState.EVICTED
+        self.queue.appendleft(req)
+        req.state = RequestState.WAITING
+
+    def finish(self, req: Request, reason: str) -> None:
+        req.finish_reason = reason
+        self._release_resources(req)
+        req.state = RequestState.FINISHED
+
+    def _release_resources(self, req: Request) -> None:
+        if req.slot is not None:
+            self.running.pop(req.slot, None)
+            self.cache.close_slot(req.slot)
+            self.free_slots.append(req.slot)
+            req.slot = None
+        if req.pages:
+            self.cache.allocator.free(req.pages)
+            req.pages = []
+
+    # ------------------------------------------------------------- status
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self.running)
+
+    def assert_consistent(self) -> None:
+        """Slot/page accounting invariants (tests call this every step):
+        no slot leaks, no page leaks, no slot double-booked."""
+        geom = self.cache.geom
+        assert len(self.free_slots) + len(self.running) == geom.num_slots, (
+            f"slot leak: {len(self.free_slots)} free + "
+            f"{len(self.running)} running != {geom.num_slots}")
+        assert len(set(self.free_slots)) == len(self.free_slots)
+        assert not (set(self.free_slots) & set(self.running))
+        held = sum(len(r.pages) for r in self.running.values())
+        assert held == self.cache.allocator.used_count, (
+            f"page leak: running hold {held}, allocator says "
+            f"{self.cache.allocator.used_count}")

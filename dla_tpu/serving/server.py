@@ -1,0 +1,338 @@
+"""Continuous-batching serving engine: the host loop that drives jitted
+prefill/decode steps over the block-paged KV pool.
+
+Execution model — the three invariants everything else hangs off:
+
+1. **Static decode shapes.** The decode step always runs the full
+   ``num_slots``-row batch over the full per-slot page window. Requests
+   entering and leaving only change the *data* (block tables, validity,
+   the active mask) — never a shape — so XLA compiles the decode step
+   exactly once per engine lifetime (asserted by test).
+2. **Bucketed prefill.** Prompts pad to power-of-two page-count buckets,
+   so prefill compiles once per bucket width ever used, not per prompt
+   length.
+3. **Host-mirrored metadata.** Slot metadata (block tables, valid, pos,
+   lengths, last tokens) is authoritative on the host as numpy; the
+   jitted steps receive it as inputs and the host re-applies the
+   deterministic updates itself instead of fetching arrays back. Only
+   sampled tokens and prefill logits cross device->host per step.
+
+Backpressure: admission needs every prompt page plus a decode reserve up
+front; mid-decode page exhaustion preempts the youngest request (freed
+pages go to older ones; the victim recomputes its prefix on
+re-admission). The same engine is the intended async rollout backend for
+PPO (docs/SERVING.md): rollouts are just requests whose consumer is the
+trainer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dla_tpu.generation.engine import GenerationConfig
+from dla_tpu.models.transformer import Transformer
+from dla_tpu.ops.sampling import sample_token
+from dla_tpu.serving.kv_blocks import PagedKVCache, PageGeometry
+from dla_tpu.serving.metrics import ServingMetrics
+from dla_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+    SchedulerConfig,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Geometry + policy of one serving engine instance."""
+    page_size: int = 16
+    num_pages: int = 64          # pool size (page 0 reserved for trash)
+    num_slots: int = 4           # static decode batch rows
+    max_model_len: int = 128     # per-slot logical window (prompt + new)
+    max_prefill_batch: int = 2
+    lookahead: int = 16
+    decode_reserve_pages: int = 1
+    seed: int = 0
+
+    @property
+    def pages_per_slot(self) -> int:
+        return -(-self.max_model_len // self.page_size)
+
+
+class ServingEngine:
+    """Continuous-batching engine over one model + params.
+
+    >>> eng = ServingEngine(model, params, GenerationConfig(...), cfg)
+    >>> rid = eng.submit([1, 2, 3], max_new_tokens=16)
+    >>> while eng.has_work():
+    ...     for rid, tok in eng.step():
+    ...         ...                      # stream tokens out per request
+    >>> eng.result(rid).generated
+    """
+
+    def __init__(self, model: Transformer, params, gen: GenerationConfig,
+                 cfg: ServingConfig,
+                 now: Callable[[], float] = time.perf_counter):
+        if cfg.page_size < 1 or cfg.max_model_len % cfg.page_size:
+            raise ValueError(
+                f"max_model_len ({cfg.max_model_len}) must be a positive "
+                f"multiple of page_size ({cfg.page_size})")
+        self.model = model
+        self.params = params
+        self.gen = gen
+        self.cfg = cfg
+        self.now = now
+        geom = PageGeometry(
+            page_size=cfg.page_size, num_pages=cfg.num_pages,
+            num_slots=cfg.num_slots, pages_per_slot=cfg.pages_per_slot)
+        self.cache = PagedKVCache(model, geom)
+        self.scheduler = Scheduler(
+            self.cache,
+            SchedulerConfig(max_prefill_batch=cfg.max_prefill_batch,
+                            lookahead=cfg.lookahead,
+                            decode_reserve_pages=cfg.decode_reserve_pages),
+            bucket_widths=self._bucket_widths(geom))
+        self.metrics = ServingMetrics()
+        self._results: Dict[int, Request] = {}
+        self._rng = jax.random.key(cfg.seed)
+        # trace-time counters: the function bodies run once per XLA
+        # compile, so these ARE the compile counts the no-recompilation
+        # test asserts on
+        self.decode_compiles = 0
+        self.prefill_compiles = 0
+        self._decode = jax.jit(self._decode_fn)
+        self._prefill = jax.jit(self._prefill_fn)
+
+    @staticmethod
+    def _bucket_widths(geom: PageGeometry) -> List[int]:
+        """Power-of-two page counts up to the slot window: one compiled
+        prefill per bucket ever used."""
+        widths, n = [], 1
+        while n < geom.pages_per_slot:
+            widths.append(n * geom.page_size)
+            n *= 2
+        widths.append(geom.slot_window)
+        return widths
+
+    # -------------------------------------------------------- jitted steps
+
+    def _prefill_fn(self, params, k_pages, v_pages, ids, mask, page_rows):
+        """Prefill a padded bucket batch and scatter its KV into the
+        pool. ids/mask [PB, W]; page_rows [PB, W/page_size] physical page
+        ids (dummy rows -> trash page 0). Returns (k_pages, v_pages,
+        last-real-token logits [PB, V])."""
+        self.prefill_compiles += 1       # trace-time only
+        ps = self.cfg.page_size
+        logits, ks, vs = self.model.prefill_external(params, ids, mask)
+        l, pb, w, kh, dh = ks.shape
+        ks = ks.reshape(l, pb, w // ps, ps, kh, dh)
+        vs = vs.reshape(l, pb, w // ps, ps, kh, dh)
+        k_pages = k_pages.at[:, page_rows].set(ks)
+        v_pages = v_pages.at[:, page_rows].set(vs)
+        return k_pages, v_pages, logits
+
+    def _decode_fn(self, params, k_pages, v_pages, block_tables, valid,
+                   pos, lengths, tokens, active, rng):
+        """One static-shape decode step over every slot: gather each
+        slot's pages into its [S] window, run the layout-agnostic decode
+        step, sample, scatter the fresh KV column back. Free slots
+        compute garbage routed to the trash page."""
+        self.decode_compiles += 1        # trace-time only
+        geom = self.cache.geom
+        ps = geom.page_size
+        l = self.model.cfg.num_layers
+        b = geom.num_slots
+        # in-graph block-table gather: [L, B, pages/slot, ps, KH, D]
+        k_view = k_pages[:, block_tables].reshape(
+            l, b, geom.slot_window, *k_pages.shape[3:])
+        v_view = v_pages[:, block_tables].reshape(
+            l, b, geom.slot_window, *v_pages.shape[3:])
+        view = {"k": k_view, "v": v_view, "valid": valid, "pos": pos,
+                "lengths": lengths}
+        logits, k_cols, v_cols = self.model.decode_step_paged(
+            params, view, tokens)
+        new_tok = sample_token(
+            rng, logits, temperature=self.gen.temperature,
+            top_p=self.gen.top_p, top_k=self.gen.top_k,
+            do_sample=self.gen.do_sample)
+        new_tok = jnp.where(active, new_tok, 0)
+        # scatter this step's KV column: physical (page, offset) of each
+        # slot's write column; inactive slots write the trash page
+        col = lengths
+        page_ids = jnp.take_along_axis(
+            block_tables, (col // ps)[:, None], axis=1)[:, 0]
+        offs = col % ps
+        page_ids = jnp.where(active, page_ids, 0)
+        offs = jnp.where(active, offs, 0)
+        k_pages = k_pages.at[:, page_ids, offs].set(k_cols[:, :, 0])
+        v_pages = v_pages.at[:, page_ids, offs].set(v_cols[:, :, 0])
+        return k_pages, v_pages, new_tok
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, prompt_tokens: List[int], max_new_tokens: int,
+               arrival_time: Optional[float] = None) -> int:
+        """Queue a request; returns its id. Guards that the request can
+        EVER fit: its worst-case page demand (re-admission prefix padded
+        to a bucket, plus the decode reserve) within pool capacity."""
+        geom = self.cache.geom
+        req = Request(prompt_tokens=list(prompt_tokens),
+                      max_new_tokens=int(max_new_tokens),
+                      arrival_time=(self.now() if arrival_time is None
+                                    else arrival_time))
+        worst = len(req.prompt_tokens) + req.max_new_tokens
+        worst_pages = min(
+            geom.pages_for(self.scheduler.bucket_width(min(
+                worst, geom.slot_window)))
+            + self.cfg.decode_reserve_pages,
+            geom.pages_per_slot)
+        if worst_pages > self.cache.allocator.capacity:
+            raise ValueError(
+                f"request {req.rid} can never be served: needs up to "
+                f"{worst_pages} pages, pool capacity is "
+                f"{self.cache.allocator.capacity}")
+        self.scheduler.submit(req)
+        self._results[req.rid] = req
+        self.metrics.requests_submitted.inc()
+        return req.rid
+
+    def result(self, rid: int) -> Request:
+        return self._results[rid]
+
+    def has_work(self) -> bool:
+        return bool(self.scheduler.queue or self.scheduler.running)
+
+    # --------------------------------------------------------- engine step
+
+    def step(self) -> List[Tuple[int, int]]:
+        """One engine iteration: ensure pages for running requests (may
+        preempt) -> admit into leftovers -> decode. Page growth runs
+        first so in-flight requests outrank new admissions for the pool;
+        a fresh admission always carries its decode reserve, so it never
+        needs a page in the same step. Returns the (rid, token) pairs
+        emitted this step, in slot order — the streaming surface."""
+        emitted: List[Tuple[int, int]] = []
+        for req in self.scheduler.ensure_decode_pages():
+            self.metrics.preemptions.inc()
+        self._admit(emitted)
+        if self.scheduler.running:
+            emitted.extend(self._decode_step())
+        m = self.metrics
+        m.queue_depth.set(self.scheduler.queue_depth)
+        m.active_requests.set(self.scheduler.active_count)
+        m.page_occupancy.set(self.cache.allocator.occupancy)
+        return emitted
+
+    def run_until_drained(self, max_steps: int = 100000
+                          ) -> Dict[int, Request]:
+        for _ in range(max_steps):
+            if not self.has_work():
+                return dict(self._results)
+            self.step()
+        raise RuntimeError(f"serving loop did not drain in {max_steps} steps")
+
+    # ------------------------------------------------------------ internals
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _admit(self, emitted: List[Tuple[int, int]]) -> None:
+        """Drain as many bucketed prefill batches as slots/pages allow."""
+        while True:
+            batch = self.scheduler.next_prefill_batch()
+            if not batch:
+                return
+            self._run_prefill(batch, emitted)
+
+    def _run_prefill(self, batch: List[Request],
+                     emitted: List[Tuple[int, int]]) -> None:
+        geom = self.cache.geom
+        ps, pb = self.cfg.page_size, self.cfg.max_prefill_batch
+        width = self.scheduler.bucket_width(len(batch[0].prefix_tokens))
+        n_prompt_pages = geom.pages_for(width)
+        ids = np.zeros((pb, width), np.int32)
+        mask = np.zeros((pb, width), np.int32)
+        page_rows = np.zeros((pb, n_prompt_pages), np.int32)
+        for i, req in enumerate(batch):
+            toks = req.prefix_tokens
+            ids[i, :len(toks)] = toks
+            mask[i, :len(toks)] = 1
+            page_rows[i] = req.pages[:n_prompt_pages]
+        for i in range(len(batch), pb):
+            mask[i, 0] = 1   # dummy rows: one valid token, trash pages
+        self.cache.k_pages, self.cache.v_pages, logits = self._prefill(
+            self.params, self.cache.k_pages, self.cache.v_pages,
+            jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(page_rows))
+        logits_np = np.asarray(logits)
+        t_done = self.now()
+        self.metrics.prefill_batches.inc()
+        first = self._sample_host(logits_np[:len(batch)])
+        for i, req in enumerate(batch):
+            tok = int(first[i])
+            self.cache.open_slot(req.slot, req.pages,
+                                 len(req.prefix_tokens), width, tok)
+            self.scheduler.activate(req)
+            self._emit(req, tok, t_done, emitted, first_of_prefill=True)
+
+    def _sample_host(self, logits: np.ndarray) -> np.ndarray:
+        """Sample next tokens from prefill logits — same sampling rule as
+        the decode step (ops.sampling), eager jax (once per prefill
+        batch, off the hot loop)."""
+        if not self.gen.do_sample or self.gen.temperature == 0.0:
+            return np.argmax(logits, axis=-1).astype(np.int32)
+        toks = sample_token(
+            self._next_rng(), jnp.asarray(logits),
+            temperature=self.gen.temperature, top_p=self.gen.top_p,
+            top_k=self.gen.top_k, do_sample=self.gen.do_sample)
+        return np.asarray(toks)
+
+    def _decode_step(self) -> List[Tuple[int, int]]:
+        c = self.cache
+        active_slots = sorted(self.scheduler.running)
+        active = np.zeros((c.geom.num_slots,), bool)
+        active[active_slots] = True
+        self.cache.k_pages, self.cache.v_pages, toks = self._decode(
+            self.params, c.k_pages, c.v_pages,
+            jnp.asarray(c.block_tables), jnp.asarray(c.valid),
+            jnp.asarray(c.pos), jnp.asarray(c.lengths),
+            jnp.asarray(c.tokens), jnp.asarray(active), self._next_rng())
+        toks_np = np.asarray(toks)
+        t_done = self.now()
+        self.metrics.decode_steps.inc()
+        emitted: List[Tuple[int, int]] = []
+        for slot in active_slots:
+            req = self.scheduler.running[slot]
+            tok = int(toks_np[slot])
+            c.advance_slot(slot, tok)
+            self._emit(req, tok, t_done, emitted)
+        return emitted
+
+    def _emit(self, req: Request, tok: int, t: float,
+              emitted: List[Tuple[int, int]],
+              first_of_prefill: bool = False) -> None:
+        """Record one generated token: stream it, time it, finish the
+        request on EOS or length."""
+        req.generated.append(tok)
+        emitted.append((req.rid, tok))
+        self.metrics.tokens_generated.inc()
+        if req.first_token_time is None:
+            req.first_token_time = t
+            self.metrics.ttft_ms.record((t - req.arrival_time) * 1000.0)
+        elif not first_of_prefill and req.last_token_time is not None:
+            # inter-token latency only between consecutive decode steps
+            # (a re-prefill after eviction restarts the clock)
+            self.metrics.itl_ms.record((t - req.last_token_time) * 1000.0)
+        req.last_token_time = t
+        eos = self.gen.eos_token_id
+        if eos is not None and eos >= 0 and tok == eos:
+            self.scheduler.finish(req, "eos")
+            self.metrics.requests_finished.inc()
+        elif len(req.generated) >= req.max_new_tokens:
+            self.scheduler.finish(req, "length")
+            self.metrics.requests_finished.inc()

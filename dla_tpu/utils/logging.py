@@ -74,6 +74,37 @@ def _scalar(v: Any) -> Any:
         return v
 
 
+def percentile(values, q: float) -> float:
+    """Linear-interpolated percentile (q in [0, 100]) of a sequence —
+    the one implementation behind every latency percentile the framework
+    reports (serving metrics histograms, eval_latency TTFT/ITL rows), so
+    a dashboard comparing the two compares the same statistic. Returns
+    0.0 on an empty sequence (a metrics report must never throw)."""
+    xs = sorted(float(v) for v in values)
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return xs[0]
+    rank = (q / 100.0) * (len(xs) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = rank - lo
+    return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+
+def latency_summary(values, prefix: str = "") -> Dict[str, float]:
+    """{p50, p95, mean, count} of a latency sample list, keys optionally
+    prefixed ("ttft_ms_" -> ttft_ms_p50, ...)."""
+    xs = [float(v) for v in values]
+    mean = sum(xs) / len(xs) if xs else 0.0
+    return {
+        f"{prefix}p50": percentile(xs, 50.0),
+        f"{prefix}p95": percentile(xs, 95.0),
+        f"{prefix}mean": mean,
+        f"{prefix}count": float(len(xs)),
+    }
+
+
 def log_rank_zero(*args: Any) -> None:
     if jax.process_index() == 0:
         print(*args, flush=True)

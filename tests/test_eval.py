@@ -102,6 +102,42 @@ def test_eval_latency_end_to_end(tmp_path):
     assert dec["decode_tokens_per_second"] > 0
 
 
+def test_eval_latency_serving_mode(tmp_path):
+    """--serving runs the continuous-batching engine on a Poisson
+    arrival trace and reports per-request TTFT/ITL percentiles."""
+    from dla_tpu.eval.eval_latency import main
+    cfg = {
+        "seed": 0,
+        "models": {"tiny": "tiny"},
+        "model": {"tokenizer": "byte"},
+        "latency": {
+            "hardware": "cpu-test",
+            "batch_sizes": [1],
+            "seq_lengths": [16],
+            "warmup_steps": 0,
+            "measure_steps": 1,
+            "decode": {"enabled": False},
+            "serving": {"num_requests": 3, "arrival_rate": 200.0,
+                        "new_tokens": 4, "prompt_len_min": 4,
+                        "prompt_len_max": 8, "page_size": 4,
+                        "num_pages": 32, "num_slots": 2,
+                        "max_model_len": 32},
+        },
+        "logging": {"output_path": str(tmp_path / "out" / "results.json")},
+    }
+    p = tmp_path / "eval.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    main(["--config", str(p), "--serving"])
+    lat = json.loads((tmp_path / "out" / "latency.json").read_text())
+    srv = lat["tiny"]["serving"]
+    assert srv["num_requests"] == 3
+    assert srv["requests_per_second"] > 0
+    for k in ("ttft_ms_p50", "ttft_ms_p95", "itl_ms_p50", "itl_ms_p95"):
+        assert srv[k] >= 0.0
+    assert srv["ttft_ms_p95"] >= srv["ttft_ms_p50"]
+    assert srv["serve_tokens_per_second"] > 0
+
+
 def test_eval_perplexity_benchmark(tmp_path):
     """benchmark type: perplexity — token-mean NLL over {prompt,response}
     pairs through the fused CE path, folded into results.json/summary.md."""

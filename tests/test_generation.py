@@ -87,6 +87,39 @@ def test_generate_stops_at_eos(model_and_params):
         np.asarray(out["sequences"])[0, :4], [5, 6, 7, first])
 
 
+def test_public_single_steps_match_fused_loop(model_and_params):
+    """The public step-at-a-time surface (build_prefill_step +
+    build_decode_step, the API the serving scheduler and latency
+    harness drive) reproduces the fused generate loop exactly."""
+    from dla_tpu.generation.engine import (
+        build_decode_step,
+        build_prefill_step,
+    )
+    model, params = model_and_params
+    ids = jnp.asarray([[5, 9, 14, 0], [21, 8, 3, 17]], jnp.int32)
+    mask = jnp.asarray([[1, 1, 1, 0], [1, 1, 1, 1]], jnp.int32)
+    n = 5
+    gen = GenerationConfig(max_new_tokens=n, do_sample=False,
+                           eos_token_id=2, pad_token_id=0)
+    fused = jax.jit(build_generate_fn(model, gen))
+    out = fused(params, ids, mask, jax.random.key(0))
+
+    prefill = jax.jit(build_prefill_step(model, n))
+    decode = jax.jit(build_decode_step(model, gen))
+    logits, cache = prefill(params, ids, mask)
+    done = jnp.zeros((2,), bool)
+    toks, emits = [], []
+    for s in range(n):
+        tok, emit, logits, cache, done = decode(
+            jax.random.key(s), params, logits, cache, done)
+        toks.append(np.asarray(tok))
+        emits.append(np.asarray(emit))
+    np.testing.assert_array_equal(np.stack(toks, 1),
+                                  np.asarray(out["response_tokens"]))
+    np.testing.assert_array_equal(np.stack(emits, 1),
+                                  np.asarray(out["response_mask"]))
+
+
 def test_sampling_deterministic_per_key(model_and_params):
     model, params = model_and_params
     gen = GenerationConfig(max_new_tokens=6, do_sample=True,

@@ -1,0 +1,342 @@
+"""Serving subsystem tests: page allocator invariants, scheduler state
+machine (admission, bucketing, eviction-recompute, no leaks), and the
+load-bearing e2e guarantees — paged decode is TOKEN-IDENTICAL to the
+contiguous GenerationEngine path, and mid-decode arrivals never
+recompile the decode step."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
+from dla_tpu.models.config import get_model_config
+from dla_tpu.models.transformer import Transformer
+from dla_tpu.serving import (
+    PageAllocator,
+    PagedKVCache,
+    PageGeometry,
+    Request,
+    RequestState,
+    Scheduler,
+    SchedulerConfig,
+    ServingConfig,
+    ServingEngine,
+)
+
+
+# ---------------------------------------------------------------------------
+# page allocator (pure host, no model)
+# ---------------------------------------------------------------------------
+
+def test_allocator_basic_alloc_free():
+    a = PageAllocator(8)
+    assert a.capacity == 7          # page 0 reserved
+    pages = a.alloc(3)
+    assert len(pages) == 3 and 0 not in pages
+    assert a.used_count == 3 and a.free_count == 4
+    a.free(pages)
+    assert a.used_count == 0 and a.free_count == 7
+
+
+def test_allocator_all_or_nothing_exhaustion():
+    a = PageAllocator(5)            # capacity 4
+    first = a.alloc(3)
+    assert first is not None
+    assert a.alloc(2) is None       # only 1 free: nothing handed out
+    assert a.free_count == 1        # failed alloc left the pool untouched
+    assert a.alloc(1) is not None
+    assert a.alloc(1) is None
+    assert not a.can_alloc(1)
+
+
+def test_allocator_no_fragmentation_across_interleaving():
+    """Fixed-size pages: any alloc/free interleaving keeps every free
+    page usable (no external fragmentation)."""
+    a = PageAllocator(9)            # capacity 8
+    held = [a.alloc(2) for _ in range(4)]
+    a.free(held[1])
+    a.free(held[3])
+    big = a.alloc(4)                # freed pages coalesce trivially
+    assert big is not None and len(big) == 4
+    assert a.free_count == 0
+
+
+def test_allocator_double_free_and_trash_page():
+    a = PageAllocator(4)
+    pages = a.alloc(2)
+    a.free(pages)
+    with pytest.raises(ValueError):
+        a.free(pages)               # double free
+    with pytest.raises(ValueError):
+        a.free([0])                 # trash page is never allocatable
+    seen = set()
+    while a.can_alloc(1):
+        seen.update(a.alloc(1))
+    assert 0 not in seen
+
+
+# ---------------------------------------------------------------------------
+# scheduler state machine (host-only: a model-free cache stand-in)
+# ---------------------------------------------------------------------------
+
+class _Cfg:
+    num_layers = 1
+    num_kv_heads = 1
+    head_dim_ = 2
+
+
+class _ModelStub:
+    cfg = _Cfg()
+    adtype = jnp.float32
+
+
+def _sched(page_size=4, num_pages=16, num_slots=2, pages_per_slot=4,
+           **cfg_kw):
+    geom = PageGeometry(page_size=page_size, num_pages=num_pages,
+                        num_slots=num_slots, pages_per_slot=pages_per_slot)
+    cache = PagedKVCache(_ModelStub(), geom)
+    widths = [page_size, 2 * page_size, geom.slot_window]
+    return Scheduler(cache, SchedulerConfig(**cfg_kw), widths), cache
+
+
+def test_scheduler_admission_binds_slot_and_pages():
+    sched, cache = _sched()
+    req = Request(prompt_tokens=[1, 2, 3], max_new_tokens=4)
+    sched.submit(req)
+    batch = sched.next_prefill_batch()
+    assert batch == [req]
+    assert req.state is RequestState.PREFILL
+    assert req.slot is not None
+    # 3 tokens -> 4-wide bucket -> 1 prompt page + 1 decode reserve
+    assert len(req.pages) == 2
+    sched.activate(req)
+    assert req.state is RequestState.DECODE
+    sched.assert_consistent()
+    sched.finish(req, "length")
+    assert req.state is RequestState.FINISHED
+    assert cache.allocator.used_count == 0
+    assert len(sched.free_slots) == cache.geom.num_slots
+    sched.assert_consistent()
+
+
+def test_scheduler_bucketing_head_fixes_bucket():
+    """The head's bucket decides the batch; a same-bucket request behind
+    a different-bucket one rides along, the different one waits."""
+    sched, _ = _sched(num_slots=4, max_prefill_batch=4)
+    short1 = Request(prompt_tokens=[1, 2], max_new_tokens=2)        # w=4
+    longer = Request(prompt_tokens=list(range(1, 7)), max_new_tokens=2)  # w=8
+    short2 = Request(prompt_tokens=[3], max_new_tokens=2)           # w=4
+    for r in (short1, longer, short2):
+        sched.submit(r)
+    batch = sched.next_prefill_batch()
+    assert [r.rid for r in batch] == [short1.rid, short2.rid]
+    assert list(sched.queue) == [longer]
+    batch2 = sched.next_prefill_batch()
+    assert batch2 == [longer]
+
+
+def test_scheduler_rejects_oversized_and_empty():
+    sched, _ = _sched()   # slot window = 16
+    with pytest.raises(ValueError):
+        sched.submit(Request(prompt_tokens=list(range(10)),
+                             max_new_tokens=10))
+    with pytest.raises(ValueError):
+        sched.submit(Request(prompt_tokens=[], max_new_tokens=4))
+
+
+def test_scheduler_eviction_on_oom_requeues_and_frees():
+    """Page exhaustion mid-decode evicts the YOUNGEST running request:
+    its pages return to the pool, it re-enters the queue head with its
+    generated tokens intact (the recompute contract)."""
+    # capacity 5: two requests at 2 pages each fit, growth doesn't
+    sched, cache = _sched(num_pages=6, num_slots=2)
+    old = Request(prompt_tokens=[1, 2, 3], max_new_tokens=8)
+    young = Request(prompt_tokens=[4, 5, 6], max_new_tokens=8)
+    sched.submit(old)
+    sched.submit(young)
+    for req in sched.next_prefill_batch():
+        cache.open_slot(req.slot, req.pages, 3, 4, 7)
+        sched.activate(req)
+    sched.assert_consistent()
+    old_slot = old.slot
+    # drive the old request's length to column 12: page index 3, two
+    # pages past its allocation — the pool has only 1 spare, so the
+    # second growth must evict `young`
+    for _ in range(9):
+        cache.advance_slot(old_slot, 9)
+    evicted = sched.ensure_decode_pages()
+    assert evicted == [young]
+    assert young.state is RequestState.WAITING
+    assert young.evictions == 1
+    assert young.slot is None and young.pages == []
+    assert sched.queue[0] is young           # requeued at the FRONT
+    assert young.prefix_tokens == [4, 5, 6]  # prompt kept for recompute
+    assert old.state is RequestState.DECODE  # survivor kept running
+    sched.assert_consistent()
+    sched.finish(old, "length")
+    assert cache.allocator.used_count == 0   # no page leaked through OOM
+    sched.assert_consistent()
+
+
+# ---------------------------------------------------------------------------
+# e2e on the tiny model
+# ---------------------------------------------------------------------------
+
+MAX_NEW = 5
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = get_model_config("tiny")
+    model = Transformer(cfg)
+    return model, model.init(jax.random.key(7))
+
+
+@pytest.fixture(scope="module")
+def reference_tokens(model_and_params):
+    """Greedy reference per prompt from the contiguous fixed-batch
+    engine — the serving path must reproduce these exactly."""
+    model, params = model_and_params
+    rs = np.random.RandomState(3)
+    prompts = [list(rs.randint(3, 500, (n,))) for n in (6, 4, 9, 5)]
+    width = max(len(p) for p in prompts)
+    ids = np.zeros((len(prompts), width), np.int32)
+    mask = np.zeros_like(ids)
+    for i, p in enumerate(prompts):
+        ids[i, :len(p)] = p
+        mask[i, :len(p)] = 1
+    gen = GenerationConfig(max_new_tokens=MAX_NEW, do_sample=False,
+                           eos_token_id=2, pad_token_id=0)
+    fn = jax.jit(build_generate_fn(model, gen))
+    out = fn(params, jnp.asarray(ids), jnp.asarray(mask), jax.random.key(0))
+    resp = np.asarray(out["response_tokens"])
+    rmask = np.asarray(out["response_mask"])
+    ref = [[int(t) for t, m in zip(resp[i], rmask[i]) if m]
+           for i in range(len(prompts))]
+    return prompts, ref, gen
+
+
+def _drain(eng):
+    results = eng.run_until_drained(max_steps=500)
+    eng.scheduler.assert_consistent()
+    return results
+
+
+def test_serving_matches_contiguous_engine(model_and_params,
+                                           reference_tokens):
+    """THE parity pin: block-paged decode through gather/scatter and the
+    static-shape slot batch produces byte-for-byte the tokens of the
+    contiguous GenerationEngine decode on the same model."""
+    model, params = model_and_params
+    prompts, ref, gen = reference_tokens
+    eng = ServingEngine(model, params, gen,
+                        ServingConfig(page_size=4, num_pages=32,
+                                      num_slots=3, max_model_len=32,
+                                      max_prefill_batch=2))
+    rids = [eng.submit(p, MAX_NEW) for p in prompts]
+    results = _drain(eng)
+    for i, rid in enumerate(rids):
+        assert results[rid].generated == ref[i], f"prompt {i} diverged"
+        assert results[rid].state is RequestState.FINISHED
+    assert eng.cache.allocator.used_count == 0, "pages leaked after drain"
+
+
+def test_serving_no_recompile_and_no_leaks_across_arrivals(
+        model_and_params, reference_tokens):
+    """Mid-decode arrivals land in freed slots without retracing the
+    decode step (static shapes), and the page pool drains to empty."""
+    model, params = model_and_params
+    prompts, ref, gen = reference_tokens
+    eng = ServingEngine(model, params, gen,
+                        ServingConfig(page_size=4, num_pages=32,
+                                      num_slots=2, max_model_len=32,
+                                      max_prefill_batch=2))
+    # wave 1: two requests saturate both slots
+    rids = {eng.submit(p, MAX_NEW): i for i, p in enumerate(prompts[:2])}
+    for _ in range(2):
+        eng.step()
+    assert eng.scheduler.active_count == 2
+    # wave 2 arrives mid-decode; admitted only as slots free up
+    for i, p in enumerate(prompts[2:], start=2):
+        rids[eng.submit(p, MAX_NEW)] = i
+        eng.step()
+        eng.scheduler.assert_consistent()
+    results = _drain(eng)
+    for rid, i in rids.items():
+        assert results[rid].generated == ref[i], f"prompt {i} diverged"
+    assert eng.decode_compiles == 1, (
+        f"decode step retraced {eng.decode_compiles}x — static-shape "
+        "guarantee broken")
+    assert eng.cache.allocator.used_count == 0
+    assert len(eng.scheduler.free_slots) == 2
+    # prefill compiles once per bucket width used, never per prompt
+    widths = {eng.scheduler.bucket_width(len(p)) for p in prompts}
+    assert eng.prefill_compiles == len(widths)
+
+
+def test_serving_eviction_recomputes_identically(model_and_params):
+    """A pool sized to force mid-decode preemption: the evicted request
+    re-prefills prompt+generated and still lands on the reference
+    tokens (greedy recompute is deterministic)."""
+    model, params = model_and_params
+    rs = np.random.RandomState(11)
+    use = [list(rs.randint(3, 500, (4,))) for _ in range(2)]
+    gen = GenerationConfig(max_new_tokens=MAX_NEW, do_sample=False,
+                           eos_token_id=2, pad_token_id=0)
+    fn = jax.jit(build_generate_fn(model, gen))
+    ids = np.asarray(use, np.int32)
+    out = fn(params, jnp.asarray(ids), jnp.ones_like(jnp.asarray(ids)),
+             jax.random.key(0))
+    resp = np.asarray(out["response_tokens"])
+    rmask = np.asarray(out["response_mask"])
+    want = [[int(t) for t, m in zip(resp[i], rmask[i]) if m]
+            for i in range(len(use))]
+    # capacity 7 pages: both 4-token prompts admit at 3 pages (2 prompt
+    # + reserve) but cannot BOTH grow to 9 tokens (5 pages each) ->
+    # someone gets preempted mid-decode
+    eng = ServingEngine(model, params, gen,
+                        ServingConfig(page_size=2, num_pages=8,
+                                      num_slots=2, max_model_len=12,
+                                      max_prefill_batch=2))
+    rids = [eng.submit(p, MAX_NEW) for p in use]
+    results = _drain(eng)
+    assert eng.metrics.preemptions.value >= 1, (
+        "config was meant to force at least one preemption")
+    for rid, expect in zip(rids, want):
+        req = results[rid]
+        assert req.generated == expect, (
+            f"eviction recompute diverged (evictions={req.evictions})")
+    assert eng.cache.allocator.used_count == 0
+    eng.scheduler.assert_consistent()
+
+
+def test_serving_metrics_surface(model_and_params, reference_tokens):
+    model, params = model_and_params
+    prompts, _, gen = reference_tokens
+    eng = ServingEngine(model, params, gen,
+                        ServingConfig(page_size=4, num_pages=32,
+                                      num_slots=2, max_model_len=32))
+    for p in prompts[:2]:
+        eng.submit(p, MAX_NEW)
+    _drain(eng)
+    snap = eng.metrics.snapshot()
+    assert snap["serving/requests_submitted"] == 2.0
+    assert snap["serving/requests_finished"] == 2.0
+    assert snap["serving/tokens_generated"] == 2.0 * MAX_NEW
+    assert snap["serving/ttft_ms_count"] == 2.0
+    assert snap["serving/itl_ms_count"] > 0
+    assert snap["serving/ttft_ms_p50"] >= 0.0
+    assert snap["serving/page_occupancy_peak"] > 0.0
+    assert snap["serving/page_occupancy"] == 0.0   # drained
+
+
+def test_serving_rejects_request_that_can_never_fit(model_and_params):
+    model, params = model_and_params
+    gen = GenerationConfig(max_new_tokens=4, do_sample=False,
+                           eos_token_id=2, pad_token_id=0)
+    # pool capacity (3 pages) below one slot's worst-case demand
+    eng = ServingEngine(model, params, gen,
+                        ServingConfig(page_size=4, num_pages=4,
+                                      num_slots=1, max_model_len=32))
+    with pytest.raises(ValueError):
+        eng.submit(list(range(1, 20)), 8)
