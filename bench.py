@@ -530,6 +530,124 @@ def run_serving_bench() -> dict:
     }
 
 
+def run_resilience_bench() -> dict:
+    """Recovery-overhead microbench for the fault-tolerance stack
+    (dla_tpu/resilience): one tiny SFT run with an injected checkpoint
+    io_error AND an injected NaN step, async checkpointing on. Reports
+    what resilience costs when faults actually happen:
+
+      - checkpoint stall ms — how long save() blocked the step loop
+        (async: host-snapshot only), vs the same save through the
+        synchronous Checkpointer
+      - steps lost — extra step executions the NaN guard spent
+        (retries); with a one-shot transient fault the retry succeeds,
+        so the run still reaches max_steps with zero skipped data
+      - io retries — backoff retries the background writer needed
+
+    Deterministic, CPU-sized, in-process (no tunnel involved)."""
+    import shutil as _shutil
+    import tempfile
+
+    import jax
+    from dla_tpu.checkpoint.checkpointer import Checkpointer
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.ops.fused_ce import model_fused_ce
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.training.trainer import Trainer
+
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=64, remat="none", dtype="float32",
+        param_dtype="float32")
+    micro, seq, max_steps, save_every = 2, 64, 8, 2
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
+    model = Transformer(cfg)
+
+    def loss_fn(p, frozen, batch, rng):
+        del frozen, rng
+        loss, _ = model_fused_ce(model, p, batch)
+        return loss, {}
+
+    rs = np.random.RandomState(0)
+
+    def batches():
+        local_bs = micro * mesh.devices.size
+        while True:
+            yield {
+                "input_ids": rs.randint(1, cfg.vocab_size, (local_bs, seq)
+                                        ).astype(np.int32),
+                "attention_mask": np.ones((local_bs, seq), np.int32),
+                "labels": rs.randint(1, cfg.vocab_size, (local_bs, seq)
+                                     ).astype(np.int32),
+            }
+
+    out_dir = tempfile.mkdtemp(prefix="dla_bench_resil_")
+    try:
+        config = {
+            "experiment_name": "bench_resilience",
+            "optimization": {
+                "total_batch_size": micro * mesh.devices.size,
+                "micro_batch_size": micro, "learning_rate": 1e-4,
+                "max_train_steps": max_steps, "lr_scheduler": "constant",
+                "max_grad_norm": 1.0,
+            },
+            "logging": {"output_dir": out_dir, "log_dir": None,
+                        "save_every_steps": save_every,
+                        "log_every_steps": 10 ** 6},
+            "hardware": {"gradient_accumulation_steps": 1},
+            "resilience": {
+                "async_checkpointing": True,
+                "save_retries": 3, "retry_backoff_s": 0.05,
+                # io_error hits the background writer of the step-2 save;
+                # nan hits the forward of step 5 (one-shot -> the guard's
+                # retry of the same batch recovers bit-exactly)
+                "fault_plan": "step=2:io_error;step=5:nan",
+            },
+        }
+        with jax.sharding.set_mesh(mesh):
+            trainer = Trainer(config=config, mesh=mesh, loss_fn=loss_fn,
+                              params=model.init(jax.random.key(0)),
+                              param_specs=model.partition_specs())
+            trainer.fit(batches(), rng=jax.random.key(1))
+            trainer.checkpoint_wait()
+            ck = trainer.checkpointer
+            async_stall = (ck.total_stall_ms
+                           / max(1, ck.saves_started))
+            retries = ck.retries_total
+            bad_steps = trainer.guard.bad_steps_total
+            final_step = trainer.step
+
+            # the comparison bar: the same state through the blocking
+            # Checkpointer — what every save used to cost the step loop
+            sync = Checkpointer(out_dir + "/sync", keep_last_n=1)
+            t0 = time.perf_counter()
+            sync.save(final_step, trainer._state_tree(), {"step": final_step})
+            sync_stall = (time.perf_counter() - t0) * 1000.0
+    finally:
+        _shutil.rmtree(out_dir, ignore_errors=True)
+
+    return {
+        "metric": "resilience_checkpoint_stall_ms",
+        "value": round(async_stall, 3),
+        "unit": "ms",
+        "vs_baseline": round(async_stall / max(sync_stall, 1e-9), 4),
+        "detail": {
+            # steps lost = retried executions; the run still reaches
+            # max_steps (transient NaN retried on the same batch)
+            "steps_lost_to_faults": int(bad_steps),
+            "final_step": int(final_step),
+            "target_steps": int(max_steps),
+            "io_retries": int(retries),
+            "async_stall_ms_per_save": round(async_stall, 3),
+            "sync_save_ms": round(sync_stall, 3),
+            "saves_completed": int(ck.saves_completed),
+            "fault_plan": "step=2:io_error;step=5:nan",
+        },
+    }
+
+
 def _child_env(mode: str) -> dict:
     from _cpuhost import prepend_pythonpath, scrubbed_cpu_env
     if mode == "cpu":
@@ -636,6 +754,14 @@ def _emit_and_maybe_extra() -> None:
 
 
 def main() -> int:
+    if "resilience" in sys.argv[1:]:
+        # fault-tolerance recovery-overhead target: deterministic and
+        # CPU-sized, so it runs in-process on the forced-CPU platform
+        # (no tunnel, no child ladder)
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_resilience_bench()))
+        return 0
     mode = os.environ.get("DLA_BENCH_PLATFORM")
     if mode == "cpu":
         # CPU child: force the platform before backend init, run, emit.

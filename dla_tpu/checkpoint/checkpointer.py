@@ -180,13 +180,9 @@ class Checkpointer:
             tmp.mkdir(parents=True, exist_ok=True)
         _barrier(f"ckpt_mkdir_{tag}")
 
-        leaves = _flatten_with_paths(tree)
-        index = {"format": 2, "step": int(step), "aux": aux or {},
-                 "leaves": {}}
-        for path, leaf in leaves:
-            if leaf is None:
-                continue
-            index["leaves"][path] = self._save_leaf(tmp, path, leaf)
+        index, writes = self.plan(step, tree, aux)
+        for fname, arr in writes:
+            np.save(tmp / fname, arr)
         _barrier(f"ckpt_written_{tag}")
         if self.is_main:
             with (tmp / "index.json").open("w") as fh:
@@ -199,11 +195,32 @@ class Checkpointer:
         _barrier(f"ckpt_final_{tag}")
         return final
 
-    def _save_leaf(self, tmp: Path, path: str, leaf: Any) -> Dict[str, Any]:
-        """Write one leaf; return its index entry. Sharded jax.Arrays are
-        written one file per distinct index region, this process writing
-        only regions whose replica-0 copy it holds — across all hosts every
-        region is written exactly once, with no gather anywhere."""
+    def plan(self, step: int, tree: Any, aux: Optional[Dict[str, Any]] = None,
+             copy: bool = False
+             ) -> Tuple[Dict[str, Any], List[Tuple[str, np.ndarray]]]:
+        """Separate WHAT to write from the writing: returns
+        ``(index, writes)`` where ``writes`` is this process's
+        ``[(filename, host_array), ...]``. With ``copy=True`` every array
+        is a fresh host copy — the snapshot an async save needs so the
+        background write never reads a donated device buffer the next
+        step has already reused."""
+        index = {"format": 2, "step": int(step), "aux": aux or {},
+                 "leaves": {}}
+        writes: List[Tuple[str, np.ndarray]] = []
+        for path, leaf in _flatten_with_paths(tree):
+            if leaf is None:
+                continue
+            index["leaves"][path] = self._plan_leaf(path, leaf, writes, copy)
+        return index, writes
+
+    def _plan_leaf(self, path: str, leaf: Any,
+                   writes: List[Tuple[str, np.ndarray]],
+                   copy: bool) -> Dict[str, Any]:
+        """Plan one leaf; return its index entry, appending this process's
+        file writes. Sharded jax.Arrays get one file per distinct index
+        region, this process contributing only regions whose replica-0
+        copy it holds — across all hosts every region is written exactly
+        once, with no gather anywhere."""
         if _is_prng_key(leaf):
             leaf = jax.random.key_data(leaf)
         # The shard path handles every case np.asarray cannot: sharded
@@ -224,7 +241,9 @@ class Checkpointer:
                 if shard.replica_id != 0:
                     continue
                 region = _normalize_index(shard.index, shape)
-                np.save(tmp / regions[region], np.asarray(shard.data))
+                data = np.asarray(shard.data)
+                writes.append((regions[region],
+                               np.array(data, copy=True) if copy else data))
             return {"shape": list(shape), "dtype": dtype,
                     "shards": [{"file": fname,
                                 "index": [list(se) for se in region]}
@@ -232,13 +251,40 @@ class Checkpointer:
         # replicated / host / scalar leaf: process 0 writes it whole
         np_arr = np.asarray(leaf)
         if self.is_main:
-            np.save(tmp / _leaf_filename(path), np_arr)
+            writes.append((_leaf_filename(path),
+                           np.array(np_arr, copy=True) if copy else np_arr))
         return {"file": _leaf_filename(path),
                 "shape": list(np_arr.shape), "dtype": str(np_arr.dtype)}
 
     def _write_latest(self, tag: str) -> None:
-        with (self.dir / "latest").open("w") as fh:
+        # atomic: a crash mid-write must never leave a truncated pointer
+        # (readers would then resolve a garbage tag). Write-aside, fsync,
+        # rename — rename is atomic on POSIX.
+        tmp = self.dir / ".latest.tmp"
+        with tmp.open("w") as fh:
             fh.write(tag)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.dir / "latest")
+
+    def sweep_stale_tmp(self) -> List[str]:
+        """Startup hygiene: remove ``.tmp_*`` staging directories (and a
+        stray ``.latest.tmp``) left by a save that died mid-write. They
+        are never valid checkpoints, but they leak disk and a later save
+        of the same tag would have to clear them anyway. Call once at
+        trainer startup (rank 0), NEVER concurrently with a save."""
+        removed: List[str] = []
+        if not self.is_main or not self.dir.is_dir():
+            return removed
+        for stale in self.dir.glob(".tmp_*"):
+            if stale.is_dir():
+                shutil.rmtree(stale, ignore_errors=True)
+                removed.append(stale.name)
+        latest_tmp = self.dir / ".latest.tmp"
+        if latest_tmp.is_file():
+            latest_tmp.unlink(missing_ok=True)
+            removed.append(latest_tmp.name)
+        return removed
 
     def _retain(self) -> None:
         if self.keep_last_n <= 0:
@@ -259,8 +305,12 @@ class Checkpointer:
                 return tag
         return self.newest_step_tag()
 
+    def step_tags(self) -> List[str]:
+        """All ``step_*`` checkpoint tags on disk, ascending."""
+        return sorted(d.name for d in self.dir.glob("step_*") if d.is_dir())
+
     def newest_step_tag(self) -> Optional[str]:
-        steps = sorted(d.name for d in self.dir.glob("step_*") if d.is_dir())
+        steps = self.step_tags()
         return steps[-1] if steps else None
 
     def restore(self, template: Any, tag: Optional[str] = None,

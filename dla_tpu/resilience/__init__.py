@@ -1,0 +1,82 @@
+"""Fault tolerance for multi-day pod training and serving
+(docs/RESILIENCE.md): async checkpointing with retry, SIGTERM-graceful
+preemption, an in-graph NaN/spike guard with rollback, a step-hang
+watchdog, and the deterministic fault-injection plan that tests all of
+it.
+
+``ResilienceConfig.from_config`` parses the ``resilience:`` YAML block
+every train entry point forwards; the Trainer owns the runtime objects.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+from dla_tpu.resilience.async_checkpoint import AsyncCheckpointer
+from dla_tpu.resilience.faults import ENV_VAR, Fault, FaultPlan
+from dla_tpu.resilience.guard import (
+    GuardConfig,
+    GuardState,
+    RETRY,
+    ROLLBACK,
+    SKIP,
+)
+from dla_tpu.resilience.preemption import (
+    PreemptionExit,
+    PreemptionHandler,
+    install_sigterm_flag,
+)
+from dla_tpu.resilience.watchdog import Watchdog, format_all_stacks
+
+__all__ = [
+    "AsyncCheckpointer",
+    "ENV_VAR",
+    "Fault",
+    "FaultPlan",
+    "GuardConfig",
+    "GuardState",
+    "PreemptionExit",
+    "PreemptionHandler",
+    "ResilienceConfig",
+    "RETRY",
+    "ROLLBACK",
+    "SKIP",
+    "Watchdog",
+    "format_all_stacks",
+    "install_sigterm_flag",
+]
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Parsed ``resilience:`` block. Code defaults are conservative
+    (everything that changes process-level behavior — signals, async
+    writes, the watchdog — is opt-in); the shipped configs turn the
+    production set on."""
+    async_checkpointing: bool = False
+    save_retries: int = 3
+    retry_backoff_s: float = 0.5
+    preemption: bool = False           # install SIGTERM/SIGINT handlers
+    preemption_sync_every: int = 1     # cross-host agreement cadence
+    guard: GuardConfig = dataclasses.field(default_factory=GuardConfig)
+    watchdog_enabled: bool = False
+    watchdog_timeout_s: float = 1800.0
+    fault_plan: FaultPlan = dataclasses.field(default_factory=FaultPlan)
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]]) -> "ResilienceConfig":
+        cfg = cfg or {}
+        wd = cfg.get("watchdog") or {}
+        spec = cfg.get("fault_plan") or os.environ.get(ENV_VAR, "")
+        return cls(
+            async_checkpointing=bool(cfg.get("async_checkpointing", False)),
+            save_retries=int(cfg.get("save_retries", 3)),
+            retry_backoff_s=float(cfg.get("retry_backoff_s", 0.5)),
+            preemption=bool(cfg.get("preemption", False)),
+            preemption_sync_every=int(cfg.get("preemption_sync_every", 1)),
+            guard=GuardConfig.from_config(cfg.get("guard")),
+            watchdog_enabled=bool(wd.get("enabled", False)),
+            watchdog_timeout_s=float(wd.get("timeout_s", 1800.0)),
+            fault_plan=FaultPlan.parse(spec),
+        )

@@ -1,0 +1,169 @@
+"""Asynchronous checkpointing: snapshot on the training thread, write in
+the background, retry transient I/O.
+
+``Checkpointer.save()`` blocks the step loop for the whole write — at
+70B scale that is minutes of idle accelerators every save interval. The
+split here moves only the part that MUST be synchronous onto the
+training thread: ``Checkpointer.plan(..., copy=True)`` fetches this
+process's replica-0 shards to host memory as fresh copies (device->host
+DMA, the cheap part). Everything else — staging dir, shard files,
+index, rename, ``latest``, retention — happens on a writer thread while
+the device trains on. The copy is what makes this safe against the
+trainer's donated buffers: by the time step N+1 reuses the params
+memory, the snapshot no longer references it.
+
+Write failures (flaky GCS/NFS, the routine kind) retry with exponential
+backoff + jitter; a fresh attempt restarts from a clean staging dir, so
+a half-written attempt can never be mistaken for a checkpoint (the
+``index.json`` + atomic rename protocol already guarantees that).
+Retries exhausted = a real outage: the error is re-raised on the
+training thread at the next save/wait, failing the run loudly rather
+than training on with silently dead checkpoints.
+
+Concurrency contract: AT MOST ONE save in flight. A second ``save()``
+while one is writing first waits it out (backpressure — saves can
+stall, but never pile up or interleave their multi-host barriers). The
+multi-host barrier protocol is preserved verbatim inside the writer
+thread; every host must therefore run saves in the same order, which
+the step-boundary save cadence already guarantees.
+
+Fault hook: an injected plan (resilience.faults) with ``io_error``
+entries makes the first write attempt raise ``OSError`` — how the tests
+prove the retry path recovers bit-exactly.
+"""
+from __future__ import annotations
+
+import json
+import random
+import shutil
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dla_tpu.checkpoint.checkpointer import Checkpointer
+from dla_tpu.parallel.dist import barrier as _barrier
+from dla_tpu.resilience.faults import FaultPlan
+from dla_tpu.utils.logging import log_rank_zero
+
+
+class AsyncCheckpointer(Checkpointer):
+    """Drop-in for ``Checkpointer`` with background writes.
+
+    ``save()`` returns as soon as the host snapshot is taken;
+    ``wait()`` joins the in-flight write (call before restore/rollback,
+    at fit exit, and before a preemption exit). ``stall_ms`` accounting
+    exposes exactly how long the training thread was blocked — the
+    number the resilience bench reports.
+    """
+
+    def __init__(self, output_dir: str, keep_last_n: int = 3,
+                 max_retries: int = 3, backoff_s: float = 0.5,
+                 backoff_jitter: float = 0.25,
+                 faults: Optional[FaultPlan] = None):
+        super().__init__(output_dir, keep_last_n=keep_last_n)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_jitter = float(backoff_jitter)
+        self.faults = faults or FaultPlan()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._rng = random.Random(0x5EED)
+        # observability (training-thread only, no locks needed)
+        self.saves_started = 0
+        self.saves_completed = 0
+        self.retries_total = 0
+        self.last_stall_ms = 0.0
+        self.total_stall_ms = 0.0
+
+    # ------------------------------------------------------------------ api
+
+    def save(self, step: int, tree: Any, aux: Optional[Dict[str, Any]] = None,
+             tag: Optional[str] = None) -> Path:
+        tag = tag or f"step_{step:08d}"
+        t0 = time.perf_counter()
+        self.wait()                       # backpressure: one save in flight
+        index, writes = self.plan(step, tree, aux, copy=True)
+        stall = (time.perf_counter() - t0) * 1000.0
+        self.last_stall_ms = stall
+        self.total_stall_ms += stall
+        self.saves_started += 1
+        self._thread = threading.Thread(
+            target=self._writer, args=(int(step), tag, index, writes),
+            name=f"dla-ckpt-{tag}", daemon=True)
+        self._thread.start()
+        return self.dir / tag
+
+    def wait(self) -> None:
+        """Join the in-flight write; re-raise its terminal failure (all
+        retries exhausted) on the training thread."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def close(self) -> None:
+        self.wait()
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # --------------------------------------------------------------- writer
+
+    def _writer(self, step: int, tag: str, index: Dict[str, Any],
+                writes: List[Tuple[str, np.ndarray]]) -> None:
+        try:
+            self._with_retries(step, tag,
+                               lambda: self._attempt(tag, index, writes))
+            self.saves_completed += 1
+        except BaseException as exc:  # noqa: BLE001 — surfaced via wait()
+            self._error = exc
+
+    def _attempt(self, tag: str, index: Dict[str, Any],
+                 writes: List[Tuple[str, np.ndarray]]) -> None:
+        """One full write attempt, restartable from scratch: same staging
+        + barrier + atomic-rename protocol as the sync save."""
+        final = self.dir / tag
+        tmp = self.dir / f".tmp_{tag}"
+        if self.is_main:
+            if tmp.exists():
+                shutil.rmtree(tmp)
+            tmp.mkdir(parents=True, exist_ok=True)
+        _barrier(f"ckpt_mkdir_{tag}")
+        for fname, arr in writes:
+            np.save(tmp / fname, arr)
+        _barrier(f"ckpt_written_{tag}")
+        if self.is_main:
+            with (tmp / "index.json").open("w") as fh:
+                json.dump(index, fh)
+            if final.exists():
+                shutil.rmtree(final)
+            tmp.rename(final)
+            self._write_latest(tag)
+            self._retain()
+        _barrier(f"ckpt_final_{tag}")
+
+    def _with_retries(self, step: int, tag: str, attempt) -> None:
+        for n in range(self.max_retries + 1):
+            try:
+                fault = self.faults.take("io_error", step)
+                if fault is not None:
+                    raise OSError(
+                        f"injected io_error (fault plan, step>={fault.step})")
+                attempt()
+                return
+            except OSError as exc:
+                if n >= self.max_retries:
+                    raise
+                self.retries_total += 1
+                delay = (self.backoff_s * (2 ** n)
+                         * (1.0 + self.backoff_jitter * self._rng.random()))
+                log_rank_zero(
+                    f"[dla_tpu][ckpt] save {tag} attempt {n + 1} failed "
+                    f"({exc}); retrying in {delay:.2f}s")
+                time.sleep(delay)

@@ -1,0 +1,117 @@
+"""Deterministic fault injection: the test harness for every recovery
+path in dla_tpu/resilience.
+
+A fault plan is a semicolon list of one-shot entries::
+
+    DLA_FAULT_PLAN="step=12:io_error;step=30:nan;step=50:preempt"
+
+Each entry names a *kind* and the training step at which it arms. The
+subsystem that owns the matching hook polls ``take(kind, step)`` at its
+natural site — checkpoint I/O (``io_error``), the train step
+(``nan``), the host loop (``preempt``, ``hang``) — and an armed entry
+fires EXACTLY ONCE, at the first poll whose step has reached the
+entry's step. That one-shot + ``>=`` rule is what makes plans
+deterministic at every site: the train loop polls every step (so the
+fault lands on the named step precisely), while checkpoint I/O polls
+only when a save happens (so ``io_error`` lands on the first save at or
+after the named step, whatever the save cadence is).
+
+Kinds and their hook sites:
+
+==========  =======================================================
+io_error    AsyncCheckpointer raises ``OSError`` on the write attempt
+            (exercises retry + backoff; one-shot, so the retry wins)
+nan         Trainer passes a NaN scalar into the jitted step, tripping
+            the in-graph finite-loss guard (guard.py) with zero
+            recompiles
+preempt     Trainer flips the preemption flag as if SIGTERM arrived
+            (preemption.py): emergency checkpoint + resumable exit
+hang        Trainer sleeps ``arg`` seconds (default 1.0) inside the
+            step loop, tripping the watchdog
+==========  =======================================================
+
+An optional third field is the kind's argument: ``step=5:hang:0.25``.
+Entries are thread-safe (checkpoint I/O polls from the writer thread).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import List, Optional
+
+ENV_VAR = "DLA_FAULT_PLAN"
+
+KNOWN_KINDS = ("io_error", "nan", "preempt", "hang")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One one-shot plan entry."""
+    step: int
+    kind: str
+    arg: Optional[float] = None
+    fired: bool = False
+
+
+class FaultPlan:
+    """Parsed, thread-safe fault schedule. ``FaultPlan.parse("")`` is the
+    empty plan every hook site can poll unconditionally."""
+
+    def __init__(self, entries: Optional[List[Fault]] = None):
+        self.entries = list(entries or [])
+        self._lock = threading.Lock()
+
+    def __bool__(self) -> bool:
+        return bool(self.entries)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({self.spec()!r})"
+
+    def spec(self) -> str:
+        return ";".join(
+            f"step={f.step}:{f.kind}" + ("" if f.arg is None else f":{f.arg:g}")
+            for f in self.entries)
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "FaultPlan":
+        entries: List[Fault] = []
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) not in (2, 3) or not fields[0].startswith("step="):
+                raise ValueError(
+                    f"bad fault entry {part!r}; expected "
+                    f"'step=<N>:<kind>[:<arg>]'")
+            kind = fields[1].strip()
+            if kind not in KNOWN_KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {part!r}; "
+                    f"known: {KNOWN_KINDS}")
+            arg = float(fields[2]) if len(fields) == 3 else None
+            entries.append(Fault(step=int(fields[0][len("step="):]),
+                                 kind=kind, arg=arg))
+        entries.sort(key=lambda f: f.step)
+        return cls(entries)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls.parse(os.environ.get(ENV_VAR, ""))
+
+    def take(self, kind: str, step: int) -> Optional[Fault]:
+        """Fire-and-consume the earliest unfired ``kind`` entry whose step
+        has been reached; None when nothing is due. One-shot: a taken
+        entry never fires again."""
+        with self._lock:
+            for f in self.entries:
+                if f.kind == kind and not f.fired and step >= f.step:
+                    f.fired = True
+                    return f
+        return None
+
+    def pending(self, kind: Optional[str] = None) -> List[Fault]:
+        with self._lock:
+            return [f for f in self.entries if not f.fired
+                    and (kind is None or f.kind == kind)]

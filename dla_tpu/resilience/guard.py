@@ -1,0 +1,85 @@
+"""NaN/loss-spike guard policy: configuration and host-side bookkeeping
+for the in-graph finite-step flag the Trainer compiles into its step.
+
+Split of responsibilities — the graph side lives in
+``training/trainer.py`` (it must be traced into the one jitted train
+step so the guard adds ZERO extra compiles and zero extra host syncs):
+
+- in-graph: ``ok = isfinite(loss) & isfinite(grad_norm)`` (optionally
+  ``& loss <= spike_factor * ema``), then a per-leaf
+  ``where(ok, new, old)`` select over params and optimizer state. A bad
+  step is skipped bit-exactly: the old values pass through the select
+  untouched.
+- host (this module): the consecutive-bad counter, the loss EMA the
+  spike check reads (fed back into the graph as a scalar input — data,
+  not a constant, so it never recompiles), and the verdict after each
+  bad step: RETRY the same batch (a transient SDC/numerics glitch
+  recomputes cleanly, bit-identical to a fault-free run since the rng
+  folds on the unchanged step counter) or, after ``max_consecutive_bad``
+  failures (the same batch deterministically NaN-ing is data poison, not
+  a glitch), ROLLBACK to the last good checkpoint and drop the batch.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class GuardConfig:
+    """``resilience.guard:`` block. Defaults keep finite-loss training
+    bit-identical to an unguarded run."""
+    enabled: bool = True
+    max_consecutive_bad: int = 3      # K: retries before rollback
+    rollback: bool = True             # restore last good ckpt after K
+    ema_beta: float = 0.99            # loss EMA decay (host side)
+    spike_factor: float = 0.0         # >0: skip steps with loss > f*ema
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]]) -> "GuardConfig":
+        cfg = cfg or {}
+        return cls(
+            enabled=bool(cfg.get("enabled", True)),
+            max_consecutive_bad=int(cfg.get("max_consecutive_bad", 3)),
+            rollback=bool(cfg.get("rollback", True)),
+            ema_beta=float(cfg.get("ema_beta", 0.99)),
+            spike_factor=float(cfg.get("spike_factor", 0.0)),
+        )
+
+
+RETRY = "retry"        # re-run the same batch with the same rng
+ROLLBACK = "rollback"  # restore last good checkpoint, drop the batch
+SKIP = "skip"          # no checkpoint to roll back to: drop the batch
+
+
+class GuardState:
+    """Host-side counters for one trainer. ``on_step(ok, loss)`` after
+    every executed step returns None (step was good) or one of
+    RETRY / ROLLBACK / SKIP."""
+
+    def __init__(self, cfg: GuardConfig):
+        self.cfg = cfg
+        self.ema = 0.0                # 0 = cold; fed to the graph as-is
+        self.consecutive_bad = 0
+        self.bad_steps_total = 0
+        self.rollbacks = 0
+
+    def on_step(self, ok: bool, loss: float) -> Optional[str]:
+        if ok:
+            self.consecutive_bad = 0
+            b = self.cfg.ema_beta
+            self.ema = loss if self.ema == 0.0 else b * self.ema + (1 - b) * loss
+            return None
+        self.consecutive_bad += 1
+        self.bad_steps_total += 1
+        if self.consecutive_bad < self.cfg.max_consecutive_bad:
+            return RETRY
+        self.consecutive_bad = 0
+        if self.cfg.rollback:
+            self.rollbacks += 1
+            return ROLLBACK
+        return SKIP
+
+    def reset_ema(self) -> None:
+        """After a rollback the restored params invalidate the EMA."""
+        self.ema = 0.0

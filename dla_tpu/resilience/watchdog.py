@@ -1,0 +1,101 @@
+"""Step-hang watchdog: a heartbeat thread that dumps every thread's
+stack and aborts the process when the training loop stops making
+progress.
+
+On a pod, a single host wedged in a collective (flaky ICI link, a
+deadlocked barrier, a filesystem stall inside a checkpoint write) hangs
+EVERY host silently — the job burns its reservation doing nothing until
+a human notices. The watchdog turns that into a loud, attributable
+death: the stack dump says exactly where each thread was stuck, and the
+abort lets the cluster scheduler restart the job, which then resumes
+from the last checkpoint.
+
+The trainer calls ``beat()`` once per step; the monitor thread checks
+the time since the last beat every ``poll_s`` and trips after
+``timeout_s``. Tests (and embedders that want a softer landing) pass
+``on_hang`` and ``abort=False``.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+
+def format_all_stacks() -> str:
+    """Every live thread's current stack, watchdog excluded last."""
+    lines = ["=== dla_tpu watchdog: all-thread stack dump ==="]
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    return "\n".join(lines)
+
+
+class Watchdog:
+    """``with Watchdog(timeout_s=1800): ... beat() ...`` — or start()/stop().
+
+    ``on_hang(dump: str)`` runs first (metrics, log shipping); then, when
+    ``abort`` is true, the dump goes to stderr and the process dies with
+    SIGABRT so the launcher sees an abnormal exit and restarts."""
+
+    def __init__(self, timeout_s: float, poll_s: Optional[float] = None,
+                 on_hang: Optional[Callable[[str], None]] = None,
+                 abort: bool = True):
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s) if poll_s else min(1.0, self.timeout_s / 4)
+        self.on_hang = on_hang
+        self.abort = abort
+        self.fired = False
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._last_beat = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="dla-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            if time.monotonic() - self._last_beat <= self.timeout_s:
+                continue
+            self.fired = True
+            dump = format_all_stacks()
+            try:
+                if self.on_hang is not None:
+                    self.on_hang(dump)
+            finally:
+                if self.abort:
+                    print(dump, file=sys.stderr, flush=True)
+                    print(f"[dla_tpu][watchdog] no step heartbeat for "
+                          f"{self.timeout_s:.0f}s — aborting", file=sys.stderr,
+                          flush=True)
+                    os.kill(os.getpid(), signal.SIGABRT)
+            return  # fired once; monitor done
+
+    def __enter__(self) -> "Watchdog":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

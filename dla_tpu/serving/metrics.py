@@ -70,6 +70,8 @@ class ServingMetrics:
         self.itl_ms = Histogram()
         self.requests_submitted = Counter()
         self.requests_finished = Counter()
+        self.requests_timed_out = Counter()
+        self.requests_cancelled = Counter()
         self.preemptions = Counter()
         self.decode_steps = Counter()
         self.prefill_batches = Counter()
@@ -85,6 +87,10 @@ class ServingMetrics:
             "serving/requests_submitted": float(
                 self.requests_submitted.value),
             "serving/requests_finished": float(self.requests_finished.value),
+            "serving/requests_timed_out": float(
+                self.requests_timed_out.value),
+            "serving/requests_cancelled": float(
+                self.requests_cancelled.value),
             "serving/preemptions": float(self.preemptions.value),
             "serving/decode_steps": float(self.decode_steps.value),
             "serving/prefill_batches": float(self.prefill_batches.value),

@@ -40,6 +40,7 @@ class RequestState(enum.Enum):
     DECODE = "decode"
     FINISHED = "finished"
     EVICTED = "evicted"
+    TIMEOUT = "timeout"      # deadline passed before completion
 
 
 _rid_counter = itertools.count()
@@ -57,7 +58,9 @@ class Request:
     slot: Optional[int] = None
     pages: List[int] = dataclasses.field(default_factory=list)
     evictions: int = 0
-    finish_reason: Optional[str] = None   # "eos" | "length"
+    finish_reason: Optional[str] = None   # "eos" | "length" | "timeout"
+                                          # | "cancelled"
+    deadline: Optional[float] = None      # absolute engine-clock cutoff
     # wall-clock marks for TTFT / inter-token latency metrics
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None
@@ -238,6 +241,25 @@ class Scheduler:
         req.finish_reason = reason
         self._release_resources(req)
         req.state = RequestState.FINISHED
+
+    def cancel(self, req: Request, reason: str,
+               state: RequestState = RequestState.FINISHED) -> None:
+        """Terminal removal from wherever the request currently lives —
+        the queue (waiting/evicted) or a decode slot. Generated-so-far
+        tokens stay on the request; resources go back to the pool. Used
+        for deadline expiry (state=TIMEOUT) and drain cancellation."""
+        self.queue = deque(r for r in self.queue if r.rid != req.rid)
+        self._release_resources(req)
+        req.finish_reason = reason
+        req.state = state
+
+    def expired(self, now: float) -> List[Request]:
+        """Every queued or running request whose deadline has passed."""
+        out = [r for r in self.queue
+               if r.deadline is not None and now >= r.deadline]
+        out += [r for r in self.running.values()
+                if r.deadline is not None and now >= r.deadline]
+        return out
 
     def _release_resources(self, req: Request) -> None:
         if req.slot is not None:

@@ -100,6 +100,8 @@ class ServingEngine:
         self.metrics = ServingMetrics()
         self._results: Dict[int, Request] = {}
         self._rng = jax.random.key(cfg.seed)
+        self._draining = False
+        self._old_handlers: Optional[dict] = None
         # trace-time counters: the function bodies run once per XLA
         # compile, so these ARE the compile counts the no-recompilation
         # test asserts on
@@ -176,15 +178,26 @@ class ServingEngine:
     # ------------------------------------------------------------- intake
 
     def submit(self, prompt_tokens: List[int], max_new_tokens: int,
-               arrival_time: Optional[float] = None) -> int:
+               arrival_time: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue a request; returns its id. Guards that the request can
         EVER fit: its worst-case page demand (re-admission prefix padded
-        to a bucket, plus the decode reserve) within pool capacity."""
+        to a bucket, plus the decode reserve) within pool capacity.
+
+        ``deadline_s`` is a per-request latency budget relative to
+        arrival: past it the scheduler finishes the request with TIMEOUT
+        status at the next engine step, whether it is still queued or
+        mid-decode (generated-so-far tokens are kept)."""
+        if self._draining:
+            raise RuntimeError(
+                "engine is draining (SIGTERM received): admission closed")
         geom = self.cache.geom
         req = Request(prompt_tokens=list(prompt_tokens),
                       max_new_tokens=int(max_new_tokens),
                       arrival_time=(self.now() if arrival_time is None
                                     else arrival_time))
+        if deadline_s is not None:
+            req.deadline = req.arrival_time + float(deadline_s)
         worst = len(req.prompt_tokens) + req.max_new_tokens
         worst_pages = min(
             geom.pages_for(self.scheduler.bucket_width(min(
@@ -217,6 +230,7 @@ class ServingEngine:
         needs a page in the same step. Returns the (rid, token) pairs
         emitted this step, in slot order — the streaming surface."""
         emitted: List[Tuple[int, int]] = []
+        self._expire(self.now())
         for req in self.scheduler.ensure_decode_pages():
             self.metrics.preemptions.inc()
         self._admit(emitted)
@@ -235,6 +249,51 @@ class ServingEngine:
                 return dict(self._results)
             self.step()
         raise RuntimeError(f"serving loop did not drain in {max_steps} steps")
+
+    # ------------------------------------------------------ graceful drain
+
+    def begin_drain(self) -> None:
+        """Stop admission and shed work that never started: queued
+        requests with no generated tokens are cancelled; evicted
+        in-flight requests (they hold generated tokens and sunk compute)
+        stay queued for re-admission, and running decodes run to
+        completion. Safe to call from a signal handler's flag path —
+        it only mutates host state."""
+        if self._draining:
+            return
+        self._draining = True
+        for req in [r for r in self.scheduler.queue if not r.generated]:
+            self.scheduler.cancel(req, "cancelled")
+            self.metrics.requests_cancelled.inc()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def install_drain_handler(self) -> None:
+        """SIGTERM -> begin_drain(): the serving analog of the trainer's
+        preemption handling. The engine loop keeps stepping until
+        ``has_work()`` is false, then the caller flushes metrics and
+        exits — in-flight decodes finish, nothing is dropped mid-token."""
+        from dla_tpu.resilience.preemption import install_sigterm_flag
+        self._old_handlers = install_sigterm_flag(self.begin_drain)
+
+    def drain(self, logger=None, max_steps: int = 100000
+              ) -> Dict[int, Request]:
+        """Begin (or continue) a drain, run it to empty, flush metrics."""
+        self.begin_drain()
+        results = self.run_until_drained(max_steps)
+        self.metrics.report(logger, self.metrics.decode_steps.value)
+        return results
+
+    def _expire(self, now: float) -> None:
+        """Finish every queued or running request past its deadline with
+        TIMEOUT status. Queued requests simply leave the queue; a running
+        one gives its slot and pages back, so the timeout of a stuck-long
+        request is itself a backpressure release valve."""
+        for req in self.scheduler.expired(now):
+            self.scheduler.cancel(req, "timeout", RequestState.TIMEOUT)
+            self.metrics.requests_timed_out.inc()
 
     # ------------------------------------------------------------ internals
 

@@ -451,8 +451,18 @@ def main(argv=None) -> None:
                 log_rank_zero(
                     f"[dla_tpu] resuming at rollout {rollout_idx}/{n_steps}")
 
+        if trainer.resilience.preemption:
+            trainer.preemption.install()
+        if trainer.watchdog is not None:
+            trainer.watchdog.start()
         try:
             while rollout_idx < n_steps:
+                # the rollout boundary is this loop's only resumable
+                # point (trainer.step // updates_per_rollout recovers
+                # rollout_idx): an agreed preemption checkpoints here
+                # and exits cleanly for --resume
+                trainer.poll_preemption(extra_aux=model_aux(
+                    policy, model_cfg.get("tokenizer")))
                 # 1. sample + encode prompts (host, this rank's share only)
                 batch_prompts = [
                     PROMPT_TEMPLATE.format(prompt=p)
@@ -577,6 +587,10 @@ def main(argv=None) -> None:
             # fit()), so it owns closing an in-flight
             # logging.profile trace window on exit or error
             trainer.profile.close()
+            if trainer.watchdog is not None:
+                trainer.watchdog.stop()
+            if trainer.resilience.preemption:
+                trainer.preemption.uninstall()
 
         trainer.save(extra_aux=model_aux(policy, model_cfg.get("tokenizer")),
                      tag="final")
@@ -597,6 +611,7 @@ def main(argv=None) -> None:
                 trainer.step, {"params": policy_tree()}, aux, tag="policy")
             log_rank_zero("[dla_tpu] wrote plain-policy checkpoint "
                           "(`latest` -> policy; training state in `final`)")
+        trainer.checkpoint_wait()
         trainer.logger.finish()
 
 

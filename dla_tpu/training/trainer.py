@@ -19,9 +19,15 @@ once. A phase supplies a pure ``loss_fn(params, frozen, batch, rng) ->
 - global-norm clipping + AdamW + schedule (dla_tpu.training.optim)
 - periodic log / eval / checkpoint with resume (reference lacks resume)
 - tokens/sec/chip on every run
+- fault tolerance (dla_tpu.resilience, ``resilience:`` config block):
+  async checkpointing with retried writes, SIGTERM-graceful preemption
+  (emergency save + resumable exit), an in-graph non-finite-step guard
+  with retry/rollback that adds zero recompiles, and a step-hang
+  watchdog — see docs/RESILIENCE.md for the fault model
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -37,6 +43,16 @@ from dla_tpu.parallel.sharding import (
     make_global_batch,
     prune_spec_for_mesh,
     sharding_tree,
+)
+from dla_tpu.resilience import (
+    RETRY,
+    ROLLBACK,
+    AsyncCheckpointer,
+    GuardState,
+    PreemptionExit,
+    PreemptionHandler,
+    ResilienceConfig,
+    Watchdog,
 )
 from dla_tpu.training.optim import build_optimizer
 from dla_tpu.training.utils import StepTimer, check_batch_identity
@@ -132,9 +148,31 @@ class Trainer:
         self.logger = MetricsLogger(
             log_cfg.get("log_dir"), config.get("experiment_name", "run"),
             use_wandb=bool(log_cfg.get("use_wandb", False)), config=config)
-        self.checkpointer = Checkpointer(
-            log_cfg.get("output_dir", "checkpoints/run"),
-            keep_last_n=int(log_cfg.get("keep_last_n", 3)))
+        # ---- resilience: async checkpointing, preemption, guard, watchdog
+        self.resilience = ResilienceConfig.from_config(
+            config.get("resilience"))
+        ckpt_dir = log_cfg.get("output_dir", "checkpoints/run")
+        keep_n = int(log_cfg.get("keep_last_n", 3))
+        if self.resilience.async_checkpointing:
+            self.checkpointer: Checkpointer = AsyncCheckpointer(
+                ckpt_dir, keep_last_n=keep_n,
+                max_retries=self.resilience.save_retries,
+                backoff_s=self.resilience.retry_backoff_s,
+                faults=self.resilience.fault_plan)
+        else:
+            self.checkpointer = Checkpointer(ckpt_dir, keep_last_n=keep_n)
+        swept = self.checkpointer.sweep_stale_tmp()
+        if swept:
+            log_rank_zero(
+                f"[dla_tpu] swept stale checkpoint staging dirs: {swept}")
+        self.guard = GuardState(self.resilience.guard)
+        self.preemption = PreemptionHandler(
+            sync_every=self.resilience.preemption_sync_every)
+        self.watchdog = (Watchdog(self.resilience.watchdog_timeout_s)
+                         if self.resilience.watchdog_enabled else None)
+        # trace-time counter (the function body runs once per XLA compile)
+        # — how tests pin "the guard adds zero extra train-step compiles"
+        self.train_step_compiles = 0
         self.log_every = int(log_cfg.get("log_every_steps", 10))
         self.eval_every = int(log_cfg.get("eval_every_steps", 0))
         self.save_every = int(log_cfg.get("save_every_steps", 0))
@@ -145,8 +183,15 @@ class Trainer:
 
     # ------------------------------------------------------------ the step
 
-    def _train_step(self, params, opt_state, frozen, batch, rng):
-        """One optimizer step = scan over ``accum`` microbatches."""
+    def _train_step(self, params, opt_state, frozen, batch, rng,
+                    guard_ema, fault_nan):
+        """One optimizer step = scan over ``accum`` microbatches.
+
+        ``guard_ema``/``fault_nan`` are traced scalars (data, not
+        constants — their values never trigger a recompile): the host's
+        loss EMA for the spike check, and the fault plan's NaN injector
+        (0.0 outside tests)."""
+        self.train_step_compiles += 1        # trace-time only
 
         def micro_loss(p, mb, r):
             loss, metrics = self.loss_fn(p, frozen, mb, r)
@@ -193,6 +238,25 @@ class Trainer:
         gnorm = optax.global_norm(grads)
         metrics = dict(metrics)
         metrics["grad_norm"] = gnorm
+        if self.guard.cfg.enabled:
+            # NaN/spike guard, entirely in-graph: compute the step as
+            # usual, then SELECT old vs new state on a finite-step flag.
+            # No host sync (the flag rides out with the metrics the loop
+            # already fetches), no extra compile (same jitted graph), and
+            # a skipped step is bit-exact — where(False, new, old)
+            # passes the old buffers' values through untouched.
+            loss = jnp.where(jnp.isnan(fault_nan), fault_nan, loss)
+            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            if self.guard.cfg.spike_factor > 0.0:
+                warm = guard_ema > 0.0
+                ok = ok & (~warm
+                           | (loss <= self.guard.cfg.spike_factor * guard_ema))
+            new_params = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old), new_params, params)
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                new_opt_state, opt_state)
+            metrics["guard_ok"] = ok.astype(jnp.float32)
         return new_params, new_opt_state, loss, metrics
 
     def compile_train_step(self):
@@ -209,7 +273,7 @@ class Trainer:
             donate_argnums=(0, 1),
             in_shardings=(
                 self.param_shardings, self.opt_state_shardings,
-                frozen_shardings, None, None),
+                frozen_shardings, None, None, None, None),
             out_shardings=(self.param_shardings, self.opt_state_shardings,
                            NamedSharding(self.mesh, P()),
                            None),
@@ -287,13 +351,43 @@ class Trainer:
 
     def _run_step(self, batch: Dict[str, Any], rng: jax.Array
                   ) -> Tuple[float, Dict[str, float]]:
+        while True:
+            loss, metrics, ok = self._execute_step(batch, rng)
+            if ok:
+                self.guard.on_step(True, loss)
+                self.step += 1
+                return loss, {k: float(v) for k, v in metrics.items()}
+            verdict = self.guard.on_step(False, loss)
+            if verdict == RETRY:
+                log_rank_zero(
+                    f"[dla_tpu][guard] non-finite step @ {self.step}; "
+                    f"retrying batch "
+                    f"({self.guard.consecutive_bad} consecutive)")
+                continue          # same batch, same rng: bit-exact recompute
+            if verdict == ROLLBACK:
+                self._rollback()
+            # rolled back (or nothing to roll back to): abandon the batch
+            # and report the bad step so the driver sees it in its stats
+            return loss, {k: float(v) for k, v in metrics.items()}
+
+    def _execute_step(self, batch: Dict[str, Any], rng: jax.Array
+                      ) -> Tuple[float, Dict[str, Any], bool]:
+        """Run the jitted step once; (host loss, device metrics, guard
+        verdict). The guard flag costs no extra sync — the step result is
+        materialized by the ``float(loss)`` the loop already does."""
         step_fn = self.compile_train_step()
+        inject = (np.float32("nan")
+                  if self.resilience.fault_plan.take("nan", self.step)
+                  else np.float32(0.0))
         self.profile.on_step(self.step)
         with step_annotation(self.step):
             self.params, self.opt_state, loss, metrics = step_fn(
-                self.params, self.opt_state, self.frozen, batch, rng)
-        self.step += 1
-        return float(loss), {k: float(v) for k, v in metrics.items()}
+                self.params, self.opt_state, self.frozen, batch, rng,
+                np.float32(self.guard.ema), inject)
+        loss_f = float(loss)
+        ok = (not self.guard.cfg.enabled
+              or bool(float(metrics["guard_ok"])))
+        return loss_f, metrics, ok
 
     # ------------------------------------------------------------- the loop
 
@@ -309,7 +403,7 @@ class Trainer:
         resume: bool = False,
         extra_aux: Optional[Dict[str, Any]] = None,
     ) -> Pytree:
-        step_fn = self.compile_train_step()
+        self.compile_train_step()
         running = RunningMean(100)
         timer = StepTimer()
 
@@ -333,30 +427,50 @@ class Trainer:
                     train_iter, "load_state_dict"):
                 train_iter.load_state_dict(aux["data_state"])
 
+        if self.resilience.preemption:
+            self.preemption.install()
+        if self.watchdog is not None:
+            self.watchdog.start()
         gen = iter(train_iter)
+        held = None      # (placed batch, n_tokens) kept across guard retries
         try:
             while self.step < self.max_steps:
-                np_batch = next(gen)
-                n_tokens = _count_tokens(np_batch, tokens_per_batch_key) \
-                    * jax.process_count()
-                batch = self.place_batch(np_batch)
+                self._poll_host_faults()
+                if self.watchdog is not None:
+                    self.watchdog.beat()
+                if held is None:
+                    # clean step boundary: every consumed batch is
+                    # trained, so data_state is exact — the only point a
+                    # preemption exit is resumable from
+                    if self.preemption.should_checkpoint(self.step):
+                        self._emergency_save(data_state, extra_aux)
+                    np_batch = next(gen)
+                    n_tokens = _count_tokens(np_batch, tokens_per_batch_key) \
+                        * jax.process_count()
+                    held = (self.place_batch(np_batch), n_tokens)
+                batch, n_tokens = held
                 step_rng = jax.random.fold_in(rng, self.step)
-                self.profile.on_step(self.step)
-                with step_annotation(self.step):
-                    self.params, self.opt_state, loss, metrics = step_fn(
-                        self.params, self.opt_state, self.frozen, batch,
-                        step_rng)
+                loss, metrics, ok = self._execute_step(batch, step_rng)
+                if not ok:
+                    verdict = self.guard.on_step(False, loss)
+                    held = self._handle_bad_step(verdict, held)
+                    continue
+                self.guard.on_step(True, loss)
+                held = None
                 self.step += 1
                 timer.tick(n_tokens)
-                running.update(float(loss))
+                running.update(loss)
 
                 if self.step % self.log_every == 0:
                     payload = {"train/loss": running.average,
-                               "train/loss_instant": float(loss),
+                               "train/loss_instant": loss,
                                "train/lr": float(self.schedule(self.step)),
                                **{f"train/{k}": float(v)
                                   for k, v in metrics.items()},
                                **timer.rates()}
+                    if self.guard.bad_steps_total:
+                        payload["train/guard_bad_steps"] = float(
+                            self.guard.bad_steps_total)
                     self.logger.log(payload, self.step)
                     log_rank_zero(
                         f"step {self.step}: loss {running.average:.4f} "
@@ -370,12 +484,97 @@ class Trainer:
         finally:
             # a failed step must not lose an already-open trace window
             self.profile.close()
+            if self.watchdog is not None:
+                self.watchdog.stop()
+            if self.resilience.preemption:
+                self.preemption.uninstall()
             if wrapper is not None:
                 wrapper.close()
 
         self.save(data_state() if data_state else None, extra_aux, tag="final")
+        self.checkpoint_wait()
         self.logger.finish()
         return self.params
+
+    def _poll_host_faults(self) -> None:
+        """Host-loop fault-plan hooks: an armed ``preempt`` entry flips the
+        preemption flag exactly as SIGTERM would; ``hang`` freezes the
+        loop to trip the watchdog."""
+        plan = self.resilience.fault_plan
+        if plan.take("preempt", self.step):
+            self.preemption.request()
+        hang = plan.take("hang", self.step)
+        if hang is not None:
+            time.sleep(hang.arg if hang.arg is not None else 1.0)
+
+    def poll_preemption(self, data_state: Optional[Callable[[], Dict]] = None,
+                        extra_aux: Optional[Dict[str, Any]] = None) -> None:
+        """For externally-driven loops (the RLHF rollout loop): call at a
+        resumable boundary. Fires host fault-plan entries, feeds the
+        watchdog, and, on an agreed preemption, writes the emergency
+        checkpoint and raises PreemptionExit."""
+        self._poll_host_faults()
+        if self.watchdog is not None:
+            self.watchdog.beat()
+        if self.preemption.should_checkpoint(self.step):
+            self._emergency_save(data_state, extra_aux)
+
+    def _emergency_save(self, data_state: Optional[Callable[[], Dict]],
+                        extra_aux: Optional[Dict[str, Any]]) -> None:
+        log_rank_zero(
+            f"[dla_tpu] preemption requested: writing emergency checkpoint "
+            f"@ step {self.step}")
+        self.checkpoint_wait()
+        self.save(data_state() if data_state else None, extra_aux)
+        self.checkpoint_wait()   # the exit must not outrun an async write
+        raise PreemptionExit(self.step)
+
+    def _handle_bad_step(self, verdict: Optional[str], held):
+        """Apply the guard's verdict; returns the batch to hold for the
+        next loop iteration (None = fetch a fresh one)."""
+        if verdict == RETRY:
+            # same batch, same rng (the step counter didn't move): a
+            # transient glitch recomputes bit-identically to a fault-free
+            # run; a deterministic NaN trips the counter toward rollback
+            log_rank_zero(
+                f"[dla_tpu][guard] non-finite step @ {self.step}; retrying "
+                f"batch ({self.guard.consecutive_bad} consecutive)")
+            return held
+        if verdict == ROLLBACK and self._rollback():
+            return None          # poison batch dropped; training continues
+        log_rank_zero(
+            f"[dla_tpu][guard] dropping poison batch @ step {self.step} "
+            f"(no rollback target)")
+        return None
+
+    def _rollback(self) -> bool:
+        """Restore params/opt_state/step from the newest restorable
+        checkpoint after K consecutive non-finite steps. The data stream
+        is NOT rewound — the poison batch is dropped and the run re-walks
+        the schedule from the restored step on fresh batches."""
+        self.checkpoint_wait()
+        tag = self.checkpointer.latest_tag()
+        if tag is None:
+            return False
+        shardings = {"params": self.param_shardings,
+                     "opt_state": self.opt_state_shardings}
+        try:
+            tree, aux = self.checkpointer.restore(
+                self._state_tree(), tag=tag, shardings=shardings)
+        except (KeyError, ValueError, OSError) as exc:
+            log_rank_zero(
+                f"[dla_tpu][guard] rollback restore of `{tag}` failed "
+                f"({type(exc).__name__}: {exc})")
+            return False
+        self.params = tree["params"]
+        self.opt_state = tree["opt_state"]
+        self.step = int(aux.get("step", self.step))
+        self.guard.reset_ema()
+        log_rank_zero(
+            f"[dla_tpu][guard] rolled back to `{tag}` @ step {self.step} "
+            f"after {self.guard.cfg.max_consecutive_bad} consecutive "
+            f"non-finite steps")
+        return True
 
     def run_eval(self, eval_iter_fn, eval_batches: int, rng: jax.Array) -> Dict[str, float]:
         eval_step = self.compile_eval_step()
@@ -403,6 +602,13 @@ class Trainer:
     def _state_tree(self) -> Dict[str, Any]:
         return {"params": self.params, "opt_state": self.opt_state}
 
+    def checkpoint_wait(self) -> None:
+        """Join any in-flight async checkpoint write (no-op for the sync
+        checkpointer); surfaces a terminal write failure here."""
+        waiter = getattr(self.checkpointer, "wait", None)
+        if waiter is not None:
+            waiter()
+
     def save(self, data_state: Optional[Dict] = None,
              extra_aux: Optional[Dict[str, Any]] = None,
              tag: Optional[str] = None) -> None:
@@ -412,6 +618,7 @@ class Trainer:
         log_rank_zero(f"[dla_tpu] saved checkpoint @ step {self.step}")
 
     def try_resume(self) -> Optional[Dict[str, Any]]:
+        self.checkpoint_wait()
         tag = self.checkpointer.latest_tag()
         if tag is None:
             return None
@@ -420,21 +627,24 @@ class Trainer:
         try:
             tree, aux = self.checkpointer.restore(
                 self._state_tree(), tag=tag, shardings=shardings)
-        except KeyError as exc:
+        except (KeyError, ValueError, OSError) as exc:
             # `latest` may name an export artifact (e.g. the LoRA-merged
             # model written for phase chaining) whose tree doesn't match
-            # the training state; fall back to the newest full training
-            # checkpoint (`final`, then step_*). Loud, so a genuinely
-            # corrupt checkpoint isn't mistaken for a normal resume.
-            fallbacks = [t for t in ("final",
-                                     self.checkpointer.newest_step_tag())
-                         if t and t != tag
-                         and (self.checkpointer.dir / t).is_dir()]
+            # the training state (KeyError), or a corrupt checkpoint — a
+            # truncated index.json (ValueError) or missing shard file
+            # (OSError) from a write that died mid-flight. Fall back to
+            # the newest restorable full training state: `final`, then
+            # every step_* tag newest-first. Loud, so corruption isn't
+            # mistaken for a normal resume.
+            fallbacks = [t for t in (["final"]
+                                     + list(reversed(
+                                         self.checkpointer.step_tags())))
+                         if t != tag and (self.checkpointer.dir / t).is_dir()]
             if not fallbacks:
                 raise
             log_rank_zero(
-                f"[dla_tpu] `{tag}` is not a resumable training state "
-                f"({exc}); trying {fallbacks}")
+                f"[dla_tpu] `{tag}` is not restorable "
+                f"({type(exc).__name__}: {exc}); trying {fallbacks}")
             tree = aux = None
             for fb in fallbacks:
                 try:
@@ -442,7 +652,7 @@ class Trainer:
                         self._state_tree(), tag=fb, shardings=shardings)
                     tag = fb
                     break
-                except KeyError:
+                except (KeyError, ValueError, OSError):
                     continue
             if tree is None:
                 raise
