@@ -53,18 +53,11 @@ import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
-PEAK_BF16_FLOPS = {
-    # per-chip peak bf16 FLOP/s by device kind (substring match)
-    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-    "v4": 275e12, "v6": 918e12, "trillium": 918e12,
-    "cpu": 5e11,
-}
-PEAK_HBM_BW = {
-    # per-chip HBM bandwidth, bytes/s (same substring match)
-    "v5 lite": 819e9, "v5e": 819e9, "v5p": 2765e9,
-    "v4": 1228e9, "v6": 1640e9, "trillium": 1640e9,
-    "cpu": 50e9,
-}
+# Per-chip peak FLOPs / HBM-bandwidth tables live in
+# dla_tpu.telemetry.mfu (ONE set of peak numbers for bench, the
+# trainer's MFU gauge, and the sweep tools). Imported lazily inside the
+# lookup helpers: importing the dla_tpu package pulls in the jax module,
+# and this parent process must stay jax-free (backend init can hang).
 BASELINE_MFU = 0.8 * 0.40  # 0.8x of a 40%-MFU H100-class DeepSpeed baseline
 # PPO baseline efficiency factors (BASELINE.md "PPO vs_baseline"): an
 # H100-class trl/DeepSpeed rollout+update loop modeled at 40% MFU on the
@@ -97,13 +90,9 @@ def hbm_bw_assumed(device) -> bool:
 
 
 def _hbm_bw_lookup(device):
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, val in PEAK_HBM_BW.items():
-        if key in kind:
-            return val, False
-    if device.platform == "cpu":
-        return PEAK_HBM_BW["cpu"], False
-    return 819e9, True
+    from dla_tpu.telemetry.mfu import hbm_bw_for
+    return hbm_bw_for(getattr(device, "device_kind", "cpu"),
+                      device.platform)
 
 
 def ppo_baseline_samples_per_sec(n_params: int, batch: int, prompt: int,
@@ -129,11 +118,9 @@ def ppo_baseline_samples_per_sec(n_params: int, batch: int, prompt: int,
 
 
 def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, val in PEAK_BF16_FLOPS.items():
-        if key in kind:
-            return val
-    return 197e12 if device.platform != "cpu" else PEAK_BF16_FLOPS["cpu"]
+    from dla_tpu.telemetry.mfu import peak_flops_for
+    return peak_flops_for(getattr(device, "device_kind", "cpu"),
+                          device.platform)
 
 
 def count_params(params) -> int:
@@ -284,6 +271,7 @@ def run_bench() -> dict:
         # must be distinguishable from the tuned TPU micro=8 config
         "detail": {"micro": micro, "seq": seq,
                    "params_m": round(n_params / 1e6),
+                   "mfu": round(mfu, 4),
                    "platform": jax.devices()[0].device_kind},
     }
 
@@ -648,6 +636,106 @@ def run_resilience_bench() -> dict:
     }
 
 
+def run_telemetry_bench() -> dict:
+    """Telemetry-overhead microbench (dla_tpu/telemetry): the same tiny
+    SFT run twice — telemetry on (step clock + in-graph collector +
+    flight recorder + registry mirror) vs ``logging.telemetry.enabled:
+    false`` — reporting ms/step overhead and the ratio. The collector
+    rides the one jitted step (train_step_compiles stays 1, asserted),
+    so the expected overhead is host-side accounting only: a few
+    perf_counter calls per step.
+
+    Deterministic, CPU-sized, in-process (no tunnel involved)."""
+    import shutil as _shutil
+    import tempfile
+
+    import jax
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.ops.fused_ce import model_fused_ce
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.training.trainer import Trainer
+
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=64, remat="none", dtype="float32",
+        param_dtype="float32")
+    micro, seq, max_steps = 2, 64, 24
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
+    model = Transformer(cfg)
+
+    def loss_fn(p, frozen, batch, rng):
+        del frozen, rng
+        loss, _ = model_fused_ce(model, p, batch)
+        return loss, {}
+
+    def batches(seed):
+        rs = np.random.RandomState(seed)
+        local_bs = micro * mesh.devices.size
+        while True:
+            yield {
+                "input_ids": rs.randint(1, cfg.vocab_size, (local_bs, seq)
+                                        ).astype(np.int32),
+                "attention_mask": np.ones((local_bs, seq), np.int32),
+                "labels": rs.randint(1, cfg.vocab_size, (local_bs, seq)
+                                     ).astype(np.int32),
+            }
+
+    def one_run(enabled: bool) -> tuple:
+        out_dir = tempfile.mkdtemp(prefix="dla_bench_tel_")
+        try:
+            config = {
+                "experiment_name": "bench_telemetry",
+                "optimization": {
+                    "total_batch_size": micro * mesh.devices.size,
+                    "micro_batch_size": micro, "learning_rate": 1e-4,
+                    "max_train_steps": max_steps,
+                    "lr_scheduler": "constant", "max_grad_norm": 1.0,
+                },
+                "logging": {"output_dir": out_dir, "log_dir": None,
+                            "save_every_steps": 0,
+                            "log_every_steps": 8,
+                            "telemetry": {"enabled": enabled}},
+                "hardware": {"gradient_accumulation_steps": 1},
+                "resilience": {"watchdog": {"enabled": False}},
+            }
+            with jax.sharding.set_mesh(mesh):
+                trainer = Trainer(config=config, mesh=mesh,
+                                  loss_fn=loss_fn,
+                                  params=model.init(jax.random.key(0)),
+                                  param_specs=model.partition_specs())
+                t0 = time.perf_counter()
+                trainer.fit(batches(0), rng=jax.random.key(1))
+                wall = time.perf_counter() - t0
+                return (wall * 1000.0 / max_steps,
+                        trainer.train_step_compiles,
+                        trainer.clock.goodput())
+        finally:
+            _shutil.rmtree(out_dir, ignore_errors=True)
+
+    base_ms, base_compiles, _ = one_run(enabled=False)
+    tel_ms, tel_compiles, goodput = one_run(enabled=True)
+    overhead_ms = tel_ms - base_ms
+
+    return {
+        "metric": "telemetry_overhead_ms_per_step",
+        "value": round(overhead_ms, 3),
+        "unit": "ms",
+        # ratio of instrumented to bare step time: ~1.0 = free telemetry
+        "vs_baseline": round(tel_ms / max(base_ms, 1e-9), 4),
+        "detail": {
+            "base_ms_per_step": round(base_ms, 3),
+            "telemetry_ms_per_step": round(tel_ms, 3),
+            "goodput": round(goodput, 4),
+            # both must be 1: the collector adds ZERO extra compiles
+            "train_step_compiles_base": int(base_compiles),
+            "train_step_compiles_telemetry": int(tel_compiles),
+            "steps": int(max_steps),
+        },
+    }
+
+
 def _child_env(mode: str) -> dict:
     from _cpuhost import prepend_pythonpath, scrubbed_cpu_env
     if mode == "cpu":
@@ -761,6 +849,12 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_resilience_bench()))
+        return 0
+    if "telemetry" in sys.argv[1:]:
+        # telemetry-overhead target: same in-process forced-CPU pattern
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_telemetry_bench()))
         return 0
     mode = os.environ.get("DLA_BENCH_PLATFORM")
     if mode == "cpu":

@@ -151,7 +151,11 @@ def measure_serving(model, params, srv: Dict) -> Dict[str, float]:
         num_pages=int(srv.get("num_pages", 256)),
         num_slots=int(srv.get("num_slots", 8)),
         max_model_len=int(srv.get("max_model_len", 256)),
-        max_prefill_batch=int(srv.get("max_prefill_batch", 4)))
+        max_prefill_batch=int(srv.get("max_prefill_batch", 4)),
+        # pass through the trainer-style profiling window ({trace_dir,
+        # start_step, num_steps}) — an xplane trace of the measured
+        # serving run is one config key away
+        profile=srv.get("profile"))
     eng = ServingEngine(model, params, gen, scfg)
     rs = np.random.RandomState(int(srv.get("seed", 0)))
     prompts = [list(rs.randint(3, model.cfg.vocab_size - 1,
@@ -196,6 +200,8 @@ def measure_serving(model, params, srv: Dict) -> Dict[str, float]:
         "ttft_ms_p95": snap["serving/ttft_ms_p95"],
         "itl_ms_p50": snap["serving/itl_ms_p50"],
         "itl_ms_p95": snap["serving/itl_ms_p95"],
+        "queue_wait_ms_p50": snap["serving/queue_wait_ms_p50"],
+        "queue_wait_ms_p95": snap["serving/queue_wait_ms_p95"],
         "preemptions": snap["serving/preemptions"],
         "page_occupancy_peak": snap["serving/page_occupancy_peak"],
     }

@@ -62,12 +62,13 @@ class AsyncCheckpointer(Checkpointer):
     def __init__(self, output_dir: str, keep_last_n: int = 3,
                  max_retries: int = 3, backoff_s: float = 0.5,
                  backoff_jitter: float = 0.25,
-                 faults: Optional[FaultPlan] = None):
+                 faults: Optional[FaultPlan] = None, recorder=None):
         super().__init__(output_dir, keep_last_n=keep_last_n)
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
         self.backoff_jitter = float(backoff_jitter)
         self.faults = faults or FaultPlan()
+        self.recorder = recorder      # telemetry.FlightRecorder (optional)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._rng = random.Random(0x5EED)
@@ -90,6 +91,9 @@ class AsyncCheckpointer(Checkpointer):
         self.last_stall_ms = stall
         self.total_stall_ms += stall
         self.saves_started += 1
+        if self.recorder is not None:
+            self.recorder.record("ckpt_save_start", step=step,
+                                 stall_ms=stall)
         self._thread = threading.Thread(
             target=self._writer, args=(int(step), tag, index, writes),
             name=f"dla-ckpt-{tag}", daemon=True)
@@ -121,6 +125,8 @@ class AsyncCheckpointer(Checkpointer):
             self._with_retries(step, tag,
                                lambda: self._attempt(tag, index, writes))
             self.saves_completed += 1
+            if self.recorder is not None:
+                self.recorder.record("ckpt_save_done", step=step)
         except BaseException as exc:  # noqa: BLE001 — surfaced via wait()
             self._error = exc
 
@@ -161,6 +167,9 @@ class AsyncCheckpointer(Checkpointer):
                 if n >= self.max_retries:
                     raise
                 self.retries_total += 1
+                if self.recorder is not None:
+                    self.recorder.record("ckpt_retry", step=step,
+                                         attempt=n + 1, error=str(exc))
                 delay = (self.backoff_s * (2 ** n)
                          * (1.0 + self.backoff_jitter * self._rng.random()))
                 log_rank_zero(

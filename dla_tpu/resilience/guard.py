@@ -57,12 +57,13 @@ class GuardState:
     every executed step returns None (step was good) or one of
     RETRY / ROLLBACK / SKIP."""
 
-    def __init__(self, cfg: GuardConfig):
+    def __init__(self, cfg: GuardConfig, recorder=None):
         self.cfg = cfg
         self.ema = 0.0                # 0 = cold; fed to the graph as-is
         self.consecutive_bad = 0
         self.bad_steps_total = 0
         self.rollbacks = 0
+        self.recorder = recorder      # telemetry.FlightRecorder (optional)
 
     def on_step(self, ok: bool, loss: float) -> Optional[str]:
         if ok:
@@ -72,6 +73,9 @@ class GuardState:
             return None
         self.consecutive_bad += 1
         self.bad_steps_total += 1
+        if self.recorder is not None:
+            self.recorder.record("guard_bad_step", loss=float(loss),
+                                 consecutive=self.consecutive_bad)
         if self.consecutive_bad < self.cfg.max_consecutive_bad:
             return RETRY
         self.consecutive_bad = 0

@@ -56,12 +56,14 @@ class PreemptionHandler:
 
     def __init__(self, signals: Iterable[int] = (signal.SIGTERM,
                                                  signal.SIGINT),
-                 sync_every: int = 1):
+                 sync_every: int = 1, recorder=None):
         self.signals = tuple(signals)
         self.sync_every = max(1, int(sync_every))
         self._flag = threading.Event()
         self._old = {}
         self._installed = False
+        self.recorder = recorder      # telemetry.FlightRecorder (optional)
+        self.requests_total = 0
 
     # ---------------------------------------------------------- signal side
 
@@ -89,10 +91,19 @@ class PreemptionHandler:
 
     def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
         self._flag.set()
+        self.requests_total += 1
+        if self.recorder is not None:
+            # deque.append is async-signal-safe enough (atomic under the
+            # GIL, no locks taken); the postmortem itself is written
+            # later from the step loop, never from the handler
+            self.recorder.record("preempt_requested", signum=int(signum))
 
     def request(self) -> None:
         """Programmatic preemption (fault injection, cluster agent RPC)."""
         self._flag.set()
+        self.requests_total += 1
+        if self.recorder is not None:
+            self.recorder.record("preempt_requested")
 
     def requested_local(self) -> bool:
         return self._flag.is_set()

@@ -45,13 +45,16 @@ class Watchdog:
 
     def __init__(self, timeout_s: float, poll_s: Optional[float] = None,
                  on_hang: Optional[Callable[[str], None]] = None,
-                 abort: bool = True):
+                 abort: bool = True, recorder=None):
         if timeout_s <= 0:
             raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
         self.timeout_s = float(timeout_s)
         self.poll_s = float(poll_s) if poll_s else min(1.0, self.timeout_s / 4)
         self.on_hang = on_hang
         self.abort = abort
+        # telemetry.FlightRecorder: a hang writes a postmortem naming the
+        # last completed step BEFORE on_hang/abort can kill the process
+        self.recorder = recorder
         self.fired = False
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
@@ -81,6 +84,11 @@ class Watchdog:
                 continue
             self.fired = True
             dump = format_all_stacks()
+            if self.recorder is not None:
+                self.recorder.record("watchdog_hang",
+                                     timeout_s=self.timeout_s)
+                self.recorder.dump("watchdog_hang",
+                                   extra={"stacks": dump})
             try:
                 if self.on_hang is not None:
                     self.on_hang(dump)

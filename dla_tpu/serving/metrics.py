@@ -1,81 +1,52 @@
 """Serving metrics surface: counters, gauges, and bounded histograms for
 the quantities that tell you whether a serving deployment is healthy —
-queue depth, time-to-first-token, inter-token latency, page-pool
-occupancy, preemption count.
+queue depth, time-to-first-token, inter-token latency, queue wait,
+page-pool occupancy, preemption count.
 
-Everything exports through dla_tpu/utils/logging.py: ``snapshot()``
-returns a flat dict a ``MetricsLogger`` writes as one JSONL row (and to
-wandb when enabled); percentiles come from ``utils.logging.percentile``
-so serving and eval_latency report the same statistic.
+The instrument classes live in ``dla_tpu.telemetry.registry`` (re-
+exported here for back-compat) and every instrument registers into a
+shared :class:`~dla_tpu.telemetry.MetricRegistry`, so the same numbers
+export two ways: ``snapshot()`` returns the flat dict a
+``MetricsLogger`` writes as one JSONL row, and the registry's
+``prometheus_text()`` backs the engine's HTTP ``/metrics`` endpoint.
+Percentiles come from ``utils.logging.percentile`` so serving and
+eval_latency report the same statistic.
 """
 from __future__ import annotations
 
-from collections import deque
 from typing import Dict, Optional
 
-from dla_tpu.utils.logging import MetricsLogger, latency_summary
-
-
-class Counter:
-    """Monotonic event count."""
-
-    def __init__(self):
-        self.value = 0
-
-    def inc(self, n: int = 1) -> None:
-        self.value += n
-
-
-class Gauge:
-    """Last-set value plus the observed peak (peak matters for capacity
-    questions like "did the page pool ever fill?")."""
-
-    def __init__(self):
-        self.value = 0.0
-        self.peak = 0.0
-
-    def set(self, v: float) -> None:
-        self.value = float(v)
-        self.peak = max(self.peak, self.value)
-
-
-class Histogram:
-    """Windowed latency sample store (last ``window`` observations) with
-    p50/p95/mean via the shared percentile helper. A serving process
-    runs indefinitely; the bound keeps the store O(1) while the window
-    is wide enough that percentiles track current behavior."""
-
-    def __init__(self, window: int = 4096):
-        self.samples: deque = deque(maxlen=window)
-        self.total_count = 0
-
-    def record(self, v: float) -> None:
-        self.samples.append(float(v))
-        self.total_count += 1
-
-    def summary(self, prefix: str = "") -> Dict[str, float]:
-        return latency_summary(self.samples, prefix)
+from dla_tpu.telemetry.registry import (  # noqa: F401 — re-exported
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+)
+from dla_tpu.utils.logging import MetricsLogger
 
 
 class ServingMetrics:
     """The serving engine's instrument panel. The engine records; anyone
-    (CLI harness, bench, tests) reads ``snapshot()`` or streams rows
-    through ``report()``."""
+    (CLI harness, bench, tests, a Prometheus scraper) reads
+    ``snapshot()``, streams rows through ``report()``, or scrapes the
+    registry."""
 
-    def __init__(self):
-        self.queue_depth = Gauge()
-        self.active_requests = Gauge()
-        self.page_occupancy = Gauge()
-        self.ttft_ms = Histogram()
-        self.itl_ms = Histogram()
-        self.requests_submitted = Counter()
-        self.requests_finished = Counter()
-        self.requests_timed_out = Counter()
-        self.requests_cancelled = Counter()
-        self.preemptions = Counter()
-        self.decode_steps = Counter()
-        self.prefill_batches = Counter()
-        self.tokens_generated = Counter()
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        r = self.registry = registry or MetricRegistry()
+        self.queue_depth = r.gauge("serving/queue_depth")
+        self.active_requests = r.gauge("serving/active_requests")
+        self.page_occupancy = r.gauge("serving/page_occupancy")
+        self.ttft_ms = r.histogram("serving/ttft_ms")
+        self.itl_ms = r.histogram("serving/itl_ms")
+        self.queue_wait_ms = r.histogram("serving/queue_wait_ms")
+        self.requests_submitted = r.counter("serving/requests_submitted")
+        self.requests_finished = r.counter("serving/requests_finished")
+        self.requests_timed_out = r.counter("serving/requests_timed_out")
+        self.requests_cancelled = r.counter("serving/requests_cancelled")
+        self.preemptions = r.counter("serving/preemptions")
+        self.decode_steps = r.counter("serving/decode_steps")
+        self.prefill_batches = r.counter("serving/prefill_batches")
+        self.tokens_generated = r.counter("serving/tokens_generated")
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {
@@ -98,6 +69,7 @@ class ServingMetrics:
         }
         out.update(self.ttft_ms.summary("serving/ttft_ms_"))
         out.update(self.itl_ms.summary("serving/itl_ms_"))
+        out.update(self.queue_wait_ms.summary("serving/queue_wait_ms_"))
         return out
 
     def report(self, logger: Optional[MetricsLogger], step: int) -> None:

@@ -61,7 +61,8 @@ class Request:
     finish_reason: Optional[str] = None   # "eos" | "length" | "timeout"
                                           # | "cancelled"
     deadline: Optional[float] = None      # absolute engine-clock cutoff
-    # wall-clock marks for TTFT / inter-token latency metrics
+    # wall-clock marks for TTFT / queue-wait / inter-token latency metrics
+    admitted_time: Optional[float] = None  # first prefill admission
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None
 

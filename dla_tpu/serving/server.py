@@ -45,6 +45,8 @@ from dla_tpu.serving.scheduler import (
     Scheduler,
     SchedulerConfig,
 )
+from dla_tpu.telemetry.exporter import MetricsHTTPServer
+from dla_tpu.utils.profiling import ProfileWindow, annotate, step_annotation
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,6 +60,12 @@ class ServingConfig:
     lookahead: int = 16
     decode_reserve_pages: int = 1
     seed: int = 0
+    # same {trace_dir, start_step, num_steps} dict the trainer's
+    # logging.profile takes: an xplane trace of a serving run is one
+    # config flag away (windows count ENGINE steps, not tokens)
+    profile: Optional[Dict] = None
+    # Prometheus scrape endpoint (telemetry.exporter); 0 = ephemeral
+    metrics_port: Optional[int] = None
 
     @property
     def pages_per_slot(self) -> int:
@@ -102,6 +110,13 @@ class ServingEngine:
         self._rng = jax.random.key(cfg.seed)
         self._draining = False
         self._old_handlers: Optional[dict] = None
+        # engine-step counter drives the profiling window (the serving
+        # analog of the trainer's step number)
+        self.engine_steps = 0
+        self.profile = ProfileWindow(cfg.profile)
+        self.metrics_server: Optional[MetricsHTTPServer] = None
+        if cfg.metrics_port is not None:
+            self.start_metrics_server(cfg.metrics_port)
         # trace-time counters: the function bodies run once per XLA
         # compile, so these ARE the compile counts the no-recompilation
         # test asserts on
@@ -229,13 +244,16 @@ class ServingEngine:
         a fresh admission always carries its decode reserve, so it never
         needs a page in the same step. Returns the (rid, token) pairs
         emitted this step, in slot order — the streaming surface."""
+        self.profile.on_step(self.engine_steps)
         emitted: List[Tuple[int, int]] = []
-        self._expire(self.now())
-        for req in self.scheduler.ensure_decode_pages():
-            self.metrics.preemptions.inc()
-        self._admit(emitted)
-        if self.scheduler.running:
-            emitted.extend(self._decode_step())
+        with step_annotation(self.engine_steps, name="serve"):
+            self._expire(self.now())
+            for req in self.scheduler.ensure_decode_pages():
+                self.metrics.preemptions.inc()
+            self._admit(emitted)
+            if self.scheduler.running:
+                emitted.extend(self._decode_step())
+        self.engine_steps += 1
         m = self.metrics
         m.queue_depth.set(self.scheduler.queue_depth)
         m.active_requests.set(self.scheduler.active_count)
@@ -244,11 +262,34 @@ class ServingEngine:
 
     def run_until_drained(self, max_steps: int = 100000
                           ) -> Dict[int, Request]:
-        for _ in range(max_steps):
-            if not self.has_work():
-                return dict(self._results)
-            self.step()
+        try:
+            for _ in range(max_steps):
+                if not self.has_work():
+                    return dict(self._results)
+                self.step()
+        finally:
+            # an open trace window must flush even on an early exit
+            self.profile.close()
         raise RuntimeError(f"serving loop did not drain in {max_steps} steps")
+
+    # -------------------------------------------------------- observability
+
+    def start_metrics_server(self, port: int = 0) -> MetricsHTTPServer:
+        """Expose this engine's registry at ``GET /metrics`` (Prometheus
+        text format) on a background thread; idempotent. ``port=0``
+        binds an ephemeral port — read it back from ``.port``."""
+        if self.metrics_server is None:
+            self.metrics_server = MetricsHTTPServer(
+                self.metrics.registry, port=port)
+        return self.metrics_server
+
+    def close(self) -> None:
+        """Release host-side resources (trace window, metrics endpoint).
+        Device state is dropped with the object as usual."""
+        self.profile.close()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     # ------------------------------------------------------ graceful drain
 
@@ -325,15 +366,22 @@ class ServingEngine:
             page_rows[i] = req.pages[:n_prompt_pages]
         for i in range(len(batch), pb):
             mask[i, 0] = 1   # dummy rows: one valid token, trash pages
-        self.cache.k_pages, self.cache.v_pages, logits = self._prefill(
-            self.params, self.cache.k_pages, self.cache.v_pages,
-            jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(page_rows))
-        logits_np = np.asarray(logits)
+        with annotate("serve_prefill"):
+            self.cache.k_pages, self.cache.v_pages, logits = self._prefill(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(page_rows))
+            logits_np = np.asarray(logits)
         t_done = self.now()
         self.metrics.prefill_batches.inc()
         first = self._sample_host(logits_np[:len(batch)])
         for i, req in enumerate(batch):
             tok = int(first[i])
+            if req.admitted_time is None:
+                # queue wait = arrival -> first admission (re-prefills
+                # after eviction are decode-path stalls, not queue time)
+                req.admitted_time = t_done
+                self.metrics.queue_wait_ms.record(
+                    (t_done - req.arrival_time) * 1000.0)
             self.cache.open_slot(req.slot, req.pages,
                                  len(req.prefix_tokens), width, tok)
             self.scheduler.activate(req)
@@ -356,12 +404,13 @@ class ServingEngine:
         active_slots = sorted(self.scheduler.running)
         active = np.zeros((c.geom.num_slots,), bool)
         active[active_slots] = True
-        self.cache.k_pages, self.cache.v_pages, toks = self._decode(
-            self.params, c.k_pages, c.v_pages,
-            jnp.asarray(c.block_tables), jnp.asarray(c.valid),
-            jnp.asarray(c.pos), jnp.asarray(c.lengths),
-            jnp.asarray(c.tokens), jnp.asarray(active), self._next_rng())
-        toks_np = np.asarray(toks)
+        with annotate("serve_decode"):
+            self.cache.k_pages, self.cache.v_pages, toks = self._decode(
+                self.params, c.k_pages, c.v_pages,
+                jnp.asarray(c.block_tables), jnp.asarray(c.valid),
+                jnp.asarray(c.pos), jnp.asarray(c.lengths),
+                jnp.asarray(c.tokens), jnp.asarray(active), self._next_rng())
+            toks_np = np.asarray(toks)
         t_done = self.now()
         self.metrics.decode_steps.inc()
         emitted: List[Tuple[int, int]] = []

@@ -1,0 +1,52 @@
+"""Unified telemetry: the observability spine every subsystem reports
+through (docs/OBSERVABILITY.md).
+
+- registry        — metric instruments, the name CATALOG, snapshot +
+                    Prometheus renderings
+- stepclock       — step-time decomposition and goodput accounting
+- collector       — in-graph scalar collection (zero extra compiles)
+- mfu             — MFU math + per-chip peak FLOPs / HBM tables
+- flight_recorder — crash postmortems from a bounded event ring
+- exporter        — stdlib HTTP ``/metrics`` endpoint
+"""
+from dla_tpu.telemetry.registry import (
+    CATALOG,
+    Counter,
+    FuncGauge,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    MetricSpec,
+    catalog_names,
+    is_catalog_name,
+    parse_prometheus_text,
+    prometheus_name,
+)
+from dla_tpu.telemetry.stepclock import StepClock
+from dla_tpu.telemetry.collector import (
+    CollectorConfig,
+    capture,
+    collect_train_scalars,
+    stash_rms,
+    stash_scalar,
+)
+from dla_tpu.telemetry.mfu import (
+    MFUCalculator,
+    PEAK_BF16_FLOPS,
+    PEAK_HBM_BW,
+    flops_per_token,
+    hbm_bw_for,
+    peak_flops_for,
+)
+from dla_tpu.telemetry.flight_recorder import FlightRecorder
+from dla_tpu.telemetry.exporter import MetricsHTTPServer
+
+__all__ = [
+    "CATALOG", "CollectorConfig", "Counter", "FlightRecorder",
+    "FuncGauge", "Gauge", "Histogram", "MFUCalculator",
+    "MetricRegistry", "MetricSpec", "MetricsHTTPServer",
+    "PEAK_BF16_FLOPS", "PEAK_HBM_BW", "StepClock", "capture",
+    "catalog_names", "collect_train_scalars", "flops_per_token",
+    "hbm_bw_for", "is_catalog_name", "parse_prometheus_text",
+    "peak_flops_for", "prometheus_name", "stash_rms", "stash_scalar",
+]

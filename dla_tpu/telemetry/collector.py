@@ -1,0 +1,123 @@
+"""In-graph scalar collector: auxiliary metrics as one extra output
+pytree of the existing jitted train step.
+
+The wrong way to log grad-norm / param-norm / per-layer activation RMS
+is a second jitted function or a host callback — either adds a compile
+or a device->host sync per step. The right way is the one the NaN guard
+already uses: compute everything as scalars INSIDE the step function and
+return them in the metrics pytree the step already outputs. One dispatch,
+one transfer, zero extra compiles — ``Trainer.train_step_compiles`` stays
+pinned at 1 and tests assert it.
+
+Two halves:
+
+- :class:`CollectorConfig` + :func:`collect_train_scalars` — what the
+  trainer itself computes in-graph (param/update global norms; grad-norm
+  is already there). Parsed from ``logging.telemetry.collector``.
+- the **scalar stash** — a trace-time side channel for code the trainer
+  does not own. Model/loss code calls :func:`stash_scalar` /
+  :func:`stash_rms` anywhere under the step; the trainer drains the
+  stash into the metrics pytree right after calling ``loss_fn``. The
+  stash holds *tracers* during trace and is drained within the same
+  trace, so it adds no sync; outside a capture it is a no-op, so library
+  code can call it unconditionally.
+
+Example (per-layer activation RMS from a model block)::
+
+    from dla_tpu.telemetry import stash_rms
+    h = block(h)
+    stash_rms(f"layer{i}/act", h)   # -> train/rms/layer{i}/act
+"""
+from __future__ import annotations
+
+import dataclasses
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+# Module-global active stash. jit tracing is single-threaded per trace
+# and the trainer drains immediately after loss_fn returns, so a plain
+# dict is safe; nested captures stack.
+_ACTIVE: list = []
+
+
+def stash_scalar(name: str, value) -> None:
+    """Record a scalar metric from inside a traced function. No-op when
+    no capture is active (e.g. eval paths, library code run standalone).
+    Surfaces as ``train/aux/<name>`` — the prefix namespaces stashed
+    keys away from the loss_fn's own metric dict."""
+    if _ACTIVE:
+        _ACTIVE[-1][f"aux/{name}"] = jnp.asarray(value, jnp.float32)
+
+
+def stash_rms(name: str, x) -> None:
+    """Record root-mean-square of an array (the standard per-layer
+    activation-health scalar) from inside a traced function. Surfaces
+    as ``train/rms/<name>``."""
+    if _ACTIVE:
+        x = jnp.asarray(x)
+        _ACTIVE[-1][f"rms/{name}"] = jnp.sqrt(
+            jnp.mean(jnp.square(x.astype(jnp.float32))))
+
+
+@contextmanager
+def capture():
+    """Open a stash capture; yields the dict that receives every
+    ``stash_*`` call made while tracing under it."""
+    stash: Dict[str, Any] = {}
+    _ACTIVE.append(stash)
+    try:
+        yield stash
+    finally:
+        _ACTIVE.pop()
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectorConfig:
+    """What the in-graph collector computes. All on by default — each is
+    a handful of reduce ops, invisible next to a fwd+bwd pass."""
+    enabled: bool = True
+    param_norm: bool = True
+    update_norm: bool = True
+    per_layer: bool = False   # per-leaf grad RMS; large trees -> many keys
+
+    @classmethod
+    def from_config(cls, tel_cfg: Optional[Dict]) -> "CollectorConfig":
+        tel_cfg = tel_cfg or {}
+        c = tel_cfg.get("collector", {}) or {}
+        return cls(
+            enabled=bool(c.get("enabled", tel_cfg.get("enabled", True))),
+            param_norm=bool(c.get("param_norm", True)),
+            update_norm=bool(c.get("update_norm", True)),
+            per_layer=bool(c.get("per_layer", False)),
+        )
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+def collect_train_scalars(cfg: CollectorConfig, *, params=None,
+                          updates=None, grads=None) -> Dict[str, Any]:
+    """Build the collector's metric dict inside the train step trace.
+    Every value is a scalar tracer; keys are catalog names."""
+    if not cfg.enabled:
+        return {}
+    import jax
+    import optax
+    out: Dict[str, Any] = {}
+    if cfg.param_norm and params is not None:
+        out["param_norm"] = optax.global_norm(params)
+    if cfg.update_norm and updates is not None:
+        out["update_norm"] = optax.global_norm(updates)
+    if cfg.per_layer and grads is not None:
+        leaves = jax.tree_util.tree_flatten_with_path(grads)[0]
+        for path, leaf in leaves:
+            g = jnp.asarray(leaf)
+            out[f"rms/{_path_str(path)}"] = jnp.sqrt(
+                jnp.mean(jnp.square(g.astype(jnp.float32))))
+    return out

@@ -1,0 +1,78 @@
+"""Stdlib-only Prometheus ``/metrics`` HTTP endpoint.
+
+One daemon-threaded ``ThreadingHTTPServer`` serving two routes:
+
+- ``GET /metrics``  -> ``registry.prometheus_text()`` (text/plain 0.0.4)
+- ``GET /healthz``  -> ``ok`` (liveness for the serving launcher)
+
+No dependencies beyond ``http.server`` — the container bakes nothing
+extra in and the endpoint must work in the leanest serving image.
+``port=0`` binds an ephemeral port (tests); ``.port`` reports the real
+one. Scrape cost is a registry snapshot render — microseconds — and runs
+off the serving/train loop thread, so scraping never perturbs step time.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class MetricsHTTPServer:
+    """Lifecycle wrapper: construct -> serving immediately; stop() to
+    tear down. Failures to render metrics return 500 rather than
+    killing the handler thread."""
+
+    def __init__(self, registry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.registry = registry
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (http.server API)
+                if self.path.split("?")[0] == "/metrics":
+                    try:
+                        body = outer.registry.prometheus_text().encode()
+                    except Exception as exc:  # noqa: BLE001
+                        self.send_error(500, str(exc))
+                        return
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.split("?")[0] == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *args):  # scrapes are not log events
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dla-metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}/metrics"
+
+    def stop(self, timeout: Optional[float] = 2.0) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=timeout)

@@ -1,0 +1,101 @@
+"""Flight recorder: a bounded ring of recent step events, dumped as
+postmortem JSON when something dies.
+
+A hang, divergence, or preemption usually kills the process before any
+log line explains what the last few steps looked like. The recorder
+keeps the last ``capacity`` events in memory at near-zero cost (a deque
+append per event) and writes them all out — with the last completed
+step named up front — when a crash path asks for it:
+
+- ``Watchdog`` dumps ``watchdog_hang`` from its monitor thread before
+  raising SIGABRT,
+- the NaN-guard rollback path dumps ``guard_rollback`` before restoring,
+- the preemption handler dumps ``preemption`` before the emergency save.
+
+Events are flat dicts ``{"t": <unix time>, "kind": ..., "step": ...,
+**fields}``. ``record()`` is safe from signal handlers and background
+threads (single deque.append — atomic under the GIL); ``dump()`` is
+re-entrant per reason (each reason gets its own file, overwritten on
+repeat so the LAST occurrence survives).
+"""
+from __future__ import annotations
+
+import json
+import math
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+
+def _sanitize(v: Any) -> Any:
+    """Postmortems must be strict JSON — a NaN loss is exactly what a
+    divergence postmortem contains, so non-finite floats become None."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
+
+
+class FlightRecorder:
+    """Ring buffer of step events + postmortem writer.
+
+    ``out_dir=None`` keeps the ring in memory only (dump() then needs an
+    explicit path) — the trainer passes its log/checkpoint dir.
+    """
+
+    def __init__(self, capacity: int = 256,
+                 out_dir: Optional[str] = None):
+        self.events: deque = deque(maxlen=capacity)
+        self.out_dir = Path(out_dir) if out_dir else None
+        self.dumps_written = 0
+
+    def record(self, kind: str, step: Optional[int] = None,
+               **fields: Any) -> None:
+        evt = {"t": time.time(), "kind": kind}
+        if step is not None:
+            evt["step"] = int(step)
+        for k, v in fields.items():
+            evt[k] = _sanitize(v)
+        self.events.append(evt)
+
+    def last_completed_step(self) -> Optional[int]:
+        """Highest step with a recorded ``step_end`` — the number a
+        restart should expect to resume after."""
+        best = None
+        for evt in self.events:
+            if evt.get("kind") == "step_end" and "step" in evt:
+                best = evt["step"] if best is None else max(best,
+                                                            evt["step"])
+        return best
+
+    def dump(self, reason: str, path: Optional[str] = None,
+             extra: Optional[Dict[str, Any]] = None) -> Optional[Path]:
+        """Write the postmortem JSON; returns the path, or None if there
+        is nowhere to write. Never raises — this runs on crash paths."""
+        events: List[Dict] = list(self.events)
+        doc = {
+            "reason": reason,
+            "written_at": time.time(),
+            "last_completed_step": self.last_completed_step(),
+            "num_events": len(events),
+            **({k: _sanitize(v) for k, v in extra.items()} if extra
+               else {}),
+            "events": events,
+        }
+        if path is not None:
+            target = Path(path)
+        elif self.out_dir is not None:
+            safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                           for c in reason)
+            target = self.out_dir / f"postmortem_{safe}.json"
+        else:
+            return None
+        try:
+            target.parent.mkdir(parents=True, exist_ok=True)
+            tmp = target.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(doc, allow_nan=False))
+            tmp.replace(target)   # atomic: a crash mid-dump never leaves
+            self.dumps_written += 1              # a truncated postmortem
+            return target
+        except OSError:
+            return None

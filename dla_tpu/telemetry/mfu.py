@@ -1,0 +1,92 @@
+"""MFU / throughput math and the per-chip peak tables.
+
+Model FLOPs Utilization is the hardware-efficiency north star: the
+fraction of a chip's peak bf16 FLOP/s the training loop actually
+achieves, using the standard dense-transformer cost model
+
+    train FLOPs/token ~= 6 * N        (fwd 2N + bwd 4N, N = params)
+    MFU = tokens/sec/chip * 6N / peak_flops(chip)
+
+This module is deliberately dependency-free (no jax import) so
+``bench.py``'s parent orchestrator — which must never initialize the jax
+backend — and offline report tooling can both use the tables. The tables
+lived in bench.py before telemetry existed; they moved here so the
+trainer, bench, and the sweep tools all read ONE set of peak numbers.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+#: Per-chip peak bf16 FLOP/s by device kind (substring match against
+#: jax's ``device_kind``). "cpu" is a nominal figure so CPU-hosted smoke
+#: runs report a non-degenerate MFU.
+PEAK_BF16_FLOPS = {
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v4": 275e12, "v6": 918e12, "trillium": 918e12,
+    "cpu": 5e11,
+}
+
+#: Per-chip HBM bandwidth, bytes/s (same substring match).
+PEAK_HBM_BW = {
+    "v5 lite": 819e9, "v5e": 819e9, "v5p": 2765e9,
+    "v4": 1228e9, "v6": 1640e9, "trillium": 1640e9,
+    "cpu": 50e9,
+}
+
+
+def peak_flops_for(device_kind: str, platform: str = "") -> float:
+    """Peak bf16 FLOP/s for a device kind string. Unrecognized
+    accelerators fall back to the v5e figure; unrecognized CPU-platform
+    kinds to the nominal CPU figure."""
+    kind = (device_kind or "cpu").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_BF16_FLOPS["cpu"] if platform == "cpu" else 197e12
+
+
+def hbm_bw_for(device_kind: str, platform: str = "") -> Tuple[float, bool]:
+    """(per-chip HBM bytes/s, assumed?) — ``assumed`` is True when the
+    figure is the v5e fallback, not a known-chip number; callers must
+    surface that in their emitted detail rather than silently skewing
+    rooflines."""
+    kind = (device_kind or "cpu").lower()
+    for key, val in PEAK_HBM_BW.items():
+        if key in kind:
+            return val, False
+    if platform == "cpu":
+        return PEAK_HBM_BW["cpu"], False
+    return 819e9, True
+
+
+def flops_per_token(n_params: int, training: bool = True) -> float:
+    """Dense-transformer FLOPs per token: 6N training (fwd+bwd), 2N
+    inference. The 6N approximation ignores attention's quadratic term,
+    standard for MFU reporting (PaLM appendix B convention)."""
+    return (6.0 if training else 2.0) * float(n_params)
+
+
+class MFUCalculator:
+    """Binds a model size to a chip so the hot loop computes MFU from
+    the one number it already has (tokens/sec/chip).
+
+    ``n_params`` should be the parameter count doing fwd+bwd work. For
+    LoRA/adapter training the frozen base still does forward+activation
+    -gradient work, so trainable-only counts UNDERSTATE true FLOPs; we
+    use total touched params when the caller passes them, and document
+    the caveat in docs/OBSERVABILITY.md.
+    """
+
+    def __init__(self, n_params: int, device_kind: str = "cpu",
+                 platform: str = "cpu", training: bool = True):
+        self.n_params = int(n_params)
+        self.device_kind = device_kind
+        self.peak = peak_flops_for(device_kind, platform)
+        self.flops_per_token = flops_per_token(self.n_params, training)
+
+    def mfu(self, tokens_per_sec_per_chip: Optional[float]) -> float:
+        """MFU in [0, ~1] from per-chip token throughput; 0.0 when the
+        rate is unknown (no steps yet) — a metrics report never throws."""
+        if not tokens_per_sec_per_chip or self.peak <= 0:
+            return 0.0
+        return tokens_per_sec_per_chip * self.flops_per_token / self.peak

@@ -1,0 +1,385 @@
+"""Shared metric registry: the one place metric NAMES are declared and
+the one rendering path every exporter goes through.
+
+Every subsystem (trainer, serving engine, resilience counters) creates
+plain instruments — :class:`Counter`, :class:`Gauge`, :class:`Histogram`,
+or a :class:`FuncGauge` bridging an existing attribute — and registers
+them under a canonical ``area/name`` string. The registry then serves:
+
+- ``snapshot()``  — the flat float dict a ``MetricsLogger`` writes as one
+  JSONL row (same keys as before this layer existed; dashboards keep
+  working),
+- ``prometheus_text()`` — Prometheus text exposition (0.0.4) for the
+  stdlib HTTP ``/metrics`` endpoint (telemetry/exporter.py).
+
+Renames are a production hazard (a dashboard silently flatlines), so
+registration validates names against :data:`CATALOG` — the metric
+catalog documented in docs/OBSERVABILITY.md — and
+``tools/check_metric_names.py`` greps emission sites for literals that
+drifted from it. Instruments stay plain mutable objects on purpose: the
+hot paths (serving decode loop, trainer step loop) mutate fields
+directly with zero indirection; the registry only matters at
+snapshot/scrape time.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from dla_tpu.utils.logging import latency_summary
+
+# --------------------------------------------------------------- instruments
+
+
+class Counter:
+    """Monotonic event count."""
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-set value plus the observed peak (peak matters for capacity
+    questions like "did the page pool ever fill?"). The peak seeds from
+    the FIRST observed value — a gauge that only ever holds negative
+    values reports that value as its peak, not a phantom 0.0."""
+
+    def __init__(self):
+        self.value = 0.0
+        self._peak: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+        self._peak = (self.value if self._peak is None
+                      else max(self._peak, self.value))
+
+    @property
+    def peak(self) -> float:
+        return self.value if self._peak is None else self._peak
+
+
+class FuncGauge:
+    """Read-through gauge over an existing counter/attribute — how
+    subsystems that already track a number (``AsyncCheckpointer.
+    retries_total``, ``GuardState.bad_steps_total``) join the registry
+    without double bookkeeping. ``fn`` is called at snapshot/scrape."""
+
+    def __init__(self, fn: Callable[[], float]):
+        self.fn = fn
+
+    @property
+    def value(self) -> float:
+        return float(self.fn())
+
+
+class Histogram:
+    """Windowed latency sample store (last ``window`` observations) with
+    p50/p95/mean via the shared percentile helper. A serving process
+    runs indefinitely; the bound keeps the store O(1) while the window
+    is wide enough that percentiles track current behavior.
+    ``total_count``/``total_sum`` are unbounded (Prometheus summary
+    semantics: _count/_sum are monotonic even though quantiles are
+    windowed)."""
+
+    def __init__(self, window: int = 4096):
+        self.samples: deque = deque(maxlen=window)
+        self.total_count = 0
+        self.total_sum = 0.0
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        self.samples.append(v)
+        self.total_count += 1
+        self.total_sum += v
+
+    def summary(self, prefix: str = "") -> Dict[str, float]:
+        return latency_summary(self.samples, prefix)
+
+
+# ------------------------------------------------------------------ catalog
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One catalog row: canonical name, instrument kind, unit, cadence."""
+    name: str
+    kind: str          # "counter" | "gauge" | "histogram"
+    unit: str = ""
+    help: str = ""
+    cadence: str = ""  # when it updates: "step" | "log_every" | "scrape"
+
+
+def _s(name, kind, unit="", help="", cadence="log_every"):
+    return MetricSpec(name, kind, unit, help, cadence)
+
+
+#: The metric catalog — docs/OBSERVABILITY.md renders this table and
+#: tools/check_metric_names.py fails the build on emission-site literals
+#: not declared here. Dynamic families (``train/<loss_fn metric>``,
+#: ``eval/<metric>``, per-layer collector keys ``train/rms/<path>``)
+#: are declared as their documented members plus the PREFIXES entry.
+CATALOG: Tuple[MetricSpec, ...] = (
+    # -- training JSONL (trainer.fit log interval)
+    _s("train/loss", "gauge", "nll", "windowed mean training loss"),
+    _s("train/loss_instant", "gauge", "nll", "last step's loss"),
+    _s("train/lr", "gauge", "1", "learning-rate schedule value"),
+    _s("train/grad_norm", "gauge", "1", "global gradient norm (in-graph)"),
+    _s("train/param_norm", "gauge", "1",
+       "global parameter norm (in-graph collector)"),
+    _s("train/update_norm", "gauge", "1",
+       "global optimizer-update norm (in-graph collector)"),
+    _s("train/guard_ok", "gauge", "bool", "finite-step guard verdict"),
+    _s("train/guard_bad_steps", "counter", "steps",
+       "non-finite steps seen by the guard"),
+    _s("train/kl", "gauge", "nats", "policy/ref KL (RLHF)"),
+    _s("train/kl_coef", "gauge", "1", "adaptive KL coefficient (RLHF)"),
+    _s("train/reward_mean", "gauge", "1", "mean rollout reward (RLHF)"),
+    _s("train/rm_score_mean", "gauge", "1", "mean raw RM score (RLHF)"),
+    _s("train/response_len", "gauge", "tokens", "mean rollout length"),
+    _s("train/zero_len_responses", "gauge", "1",
+       "fraction of empty rollouts"),
+    _s("train/preference_rate", "gauge", "1",
+       "chosen>rejected rate (reward/DPO)"),
+    _s("tokens_per_sec", "gauge", "tok/s", "global training throughput"),
+    _s("tokens_per_sec_per_chip", "gauge", "tok/s/chip",
+       "per-chip training throughput (the north-star rate)"),
+    _s("ms_per_step", "gauge", "ms", "mean optimizer-step wall time"),
+    _s("eval/loss", "gauge", "nll", "eval loss", "eval_every"),
+    _s("eval/acc", "gauge", "1", "eval accuracy", "eval_every"),
+    # -- step-time / goodput accounting (telemetry.stepclock)
+    _s("telemetry/step_ms", "gauge", "ms", "mean wall time per step"),
+    _s("telemetry/data_wait_ms", "gauge", "ms",
+       "host wait on the data iterator"),
+    _s("telemetry/h2d_ms", "gauge", "ms",
+       "batch reshape + host-to-device placement"),
+    _s("telemetry/compute_ms", "gauge", "ms",
+       "jitted step dispatch-to-sync (device compute)"),
+    _s("telemetry/checkpoint_stall_ms", "gauge", "ms",
+       "step loop blocked on checkpointing"),
+    _s("telemetry/logging_ms", "gauge", "ms", "metric emission"),
+    _s("telemetry/eval_ms", "gauge", "ms", "in-loop eval"),
+    _s("telemetry/other_ms", "gauge", "ms",
+       "unattributed step wall time"),
+    _s("telemetry/goodput", "gauge", "fraction",
+       "useful device compute / total wall clock (cumulative)"),
+    _s("telemetry/badput_compile", "gauge", "fraction",
+       "wall fraction lost to XLA compiles"),
+    _s("telemetry/badput_fault", "gauge", "fraction",
+       "wall fraction lost to failed/retried steps"),
+    _s("telemetry/badput_checkpoint", "gauge", "fraction",
+       "wall fraction lost to checkpoint stalls"),
+    _s("telemetry/mfu", "gauge", "fraction",
+       "model FLOPs utilization vs chip peak"),
+    # -- serving instrument panel (serving.metrics)
+    _s("serving/queue_depth", "gauge", "requests",
+       "waiting requests", "step"),
+    _s("serving/active_requests", "gauge", "requests",
+       "requests holding decode slots", "step"),
+    _s("serving/page_occupancy", "gauge", "fraction",
+       "KV page pool occupancy", "step"),
+    _s("serving/requests_submitted", "counter", "requests", "", "step"),
+    _s("serving/requests_finished", "counter", "requests", "", "step"),
+    _s("serving/requests_timed_out", "counter", "requests", "", "step"),
+    _s("serving/requests_cancelled", "counter", "requests", "", "step"),
+    _s("serving/preemptions", "counter", "evictions",
+       "page-pool OOM evictions", "step"),
+    _s("serving/decode_steps", "counter", "steps", "", "step"),
+    _s("serving/prefill_batches", "counter", "batches", "", "step"),
+    _s("serving/tokens_generated", "counter", "tokens", "", "step"),
+    _s("serving/ttft_ms", "histogram", "ms",
+       "time to first token (arrival -> first emit)", "step"),
+    _s("serving/itl_ms", "histogram", "ms",
+       "inter-token latency between consecutive decodes", "step"),
+    _s("serving/queue_wait_ms", "histogram", "ms",
+       "arrival -> first prefill admission", "step"),
+    # -- resilience counters bridged into the registry (FuncGauge)
+    _s("resilience/ckpt_saves_started", "counter", "saves"),
+    _s("resilience/ckpt_saves_completed", "counter", "saves"),
+    _s("resilience/ckpt_io_retries", "counter", "retries",
+       "background-writer retry attempts"),
+    _s("resilience/ckpt_stall_ms_total", "counter", "ms",
+       "cumulative step-loop checkpoint stall"),
+    _s("resilience/guard_bad_steps", "counter", "steps"),
+    _s("resilience/guard_rollbacks", "counter", "rollbacks"),
+    _s("resilience/preemptions_requested", "counter", "signals"),
+)
+
+#: Dynamic-name families a static check cannot enumerate: any name under
+#: these prefixes is catalog-legal (loss_fn auxiliary metrics surface as
+#: ``train/<k>`` / ``eval/<k>``; the per-layer collector emits
+#: ``train/rms/<param path>``).
+DYNAMIC_PREFIXES: Tuple[str, ...] = ("train/rms/", "train/aux/", "eval/")
+
+#: Derived suffixes ``latency_summary`` appends to histogram base names.
+HISTOGRAM_SUFFIXES: Tuple[str, ...] = ("p50", "p95", "mean", "count")
+
+_CATALOG_BY_NAME: Dict[str, MetricSpec] = {s.name: s for s in CATALOG}
+
+
+def catalog_names() -> Tuple[str, ...]:
+    return tuple(_CATALOG_BY_NAME)
+
+
+def is_catalog_name(name: str) -> bool:
+    """True when ``name`` is a declared metric: exact catalog hit, a
+    histogram-derived name (``serving/ttft_ms_p95``), a gauge peak
+    (``serving/queue_depth_peak``), or under a dynamic-family prefix."""
+    name = name.rstrip("_")          # "serving/ttft_ms_" prefix literals
+    if name in _CATALOG_BY_NAME:
+        return True
+    if any(name.startswith(p) for p in DYNAMIC_PREFIXES):
+        return True
+    base, _, suffix = name.rpartition("_")
+    if base in _CATALOG_BY_NAME:
+        spec = _CATALOG_BY_NAME[base]
+        if spec.kind == "histogram" and suffix in HISTOGRAM_SUFFIXES:
+            return True
+        if spec.kind == "gauge" and suffix == "peak":
+            return True
+    return False
+
+
+# ----------------------------------------------------------------- registry
+
+
+def prometheus_name(name: str) -> str:
+    """Canonical ``area/name`` -> Prometheus ``dla_area_name``."""
+    return "dla_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def _finite(v: float) -> float:
+    return v if math.isfinite(v) else 0.0
+
+
+class MetricRegistry:
+    """Name -> instrument map with catalog validation and the two export
+    renderings (flat snapshot dict, Prometheus text)."""
+
+    def __init__(self, strict: bool = True):
+        self.strict = strict
+        self._instruments: Dict[str, Any] = {}
+
+    def register(self, name: str, instrument: Any) -> Any:
+        if self.strict and not is_catalog_name(name):
+            raise ValueError(
+                f"metric {name!r} is not declared in telemetry.registry."
+                f"CATALOG — add a MetricSpec (and docs/OBSERVABILITY.md "
+                f"row) instead of inventing names at the emission site")
+        self._instruments[name] = instrument
+        return instrument
+
+    def counter(self, name: str) -> Counter:
+        return self.register(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self.register(name, Gauge())
+
+    def histogram(self, name: str, window: int = 4096) -> Histogram:
+        return self.register(name, Histogram(window))
+
+    def func_gauge(self, name: str, fn: Callable[[], float]) -> FuncGauge:
+        return self.register(name, FuncGauge(fn))
+
+    def get(self, name: str) -> Any:
+        return self._instruments[name]
+
+    def names(self) -> List[str]:
+        return sorted(self._instruments)
+
+    # ------------------------------------------------------------- exports
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat float dict, one key per exported series — the JSONL row."""
+        out: Dict[str, float] = {}
+        for name in sorted(self._instruments):
+            inst = self._instruments[name]
+            if isinstance(inst, Histogram):
+                out.update(inst.summary(f"{name}_"))
+            elif isinstance(inst, Gauge):
+                out[name] = inst.value
+                out[f"{name}_peak"] = inst.peak
+            else:
+                out[name] = float(inst.value)
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4. Counters render with
+        the conventional ``_total`` suffix; histograms render as
+        summaries (windowed quantiles + monotonic _sum/_count); gauges
+        also export their ``_peak``. Non-finite values export as 0 —
+        scrapers must never choke on a NaN."""
+        lines: List[str] = []
+        for name in sorted(self._instruments):
+            inst = self._instruments[name]
+            pname = prometheus_name(name)
+            spec = _CATALOG_BY_NAME.get(name)
+            help_text = (spec.help or spec.unit) if spec else ""
+            if isinstance(inst, Histogram):
+                s = inst.summary()
+                if help_text:
+                    lines.append(f"# HELP {pname} {help_text}")
+                lines.append(f"# TYPE {pname} summary")
+                lines.append(
+                    f'{pname}{{quantile="0.5"}} {_finite(s["p50"])}')
+                lines.append(
+                    f'{pname}{{quantile="0.95"}} {_finite(s["p95"])}')
+                lines.append(f"{pname}_sum {_finite(inst.total_sum)}")
+                lines.append(f"{pname}_count {inst.total_count}")
+            elif isinstance(inst, Gauge):
+                if help_text:
+                    lines.append(f"# HELP {pname} {help_text}")
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {_finite(inst.value)}")
+                lines.append(f"# TYPE {pname}_peak gauge")
+                lines.append(f"{pname}_peak {_finite(inst.peak)}")
+            else:
+                kind = spec.kind if spec else "gauge"
+                if kind == "counter":
+                    if help_text:
+                        lines.append(f"# HELP {pname}_total {help_text}")
+                    lines.append(f"# TYPE {pname}_total counter")
+                    lines.append(f"{pname}_total {_finite(inst.value)}")
+                else:
+                    if help_text:
+                        lines.append(f"# HELP {pname} {help_text}")
+                    lines.append(f"# TYPE {pname} gauge")
+                    lines.append(f"{pname} {_finite(inst.value)}")
+        return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+
+
+def parse_prometheus_text(text: str) -> Dict[Tuple[str, Tuple], float]:
+    """Minimal strict parser for the exposition format this module
+    emits: {(name, sorted (label, value) tuple): float}. Raises
+    ValueError on any line that is neither a comment nor a well-formed
+    sample — the round-trip test runs every exported line through it."""
+    out: Dict[Tuple[str, Tuple], float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: not a prometheus sample: "
+                             f"{line!r}")
+        labels = []
+        if m.group("labels"):
+            for part in m.group("labels").split(","):
+                k, _, v = part.partition("=")
+                if not (v.startswith('"') and v.endswith('"')):
+                    raise ValueError(
+                        f"line {lineno}: unquoted label value in {line!r}")
+                labels.append((k.strip(), v[1:-1]))
+        out[(m.group("name"), tuple(sorted(labels)))] = float(
+            m.group("value"))
+    return out

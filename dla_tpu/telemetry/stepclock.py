@@ -1,0 +1,186 @@
+"""Step-time decomposition and goodput accounting.
+
+A training step's wall clock hides several very different costs: waiting
+on the input pipeline, reshaping/placing the batch on device, the jitted
+device step itself, metric emission, and — the big silent one — blocking
+on checkpoint I/O. ``StepClock`` attributes every wall-clock second of
+the train loop to exactly one of those segments and rolls them up into
+**goodput**: the fraction of total wall time spent doing useful device
+compute (the definition Podracer / the TPUv4 scaling papers use for
+fleet accounting).
+
+Badput is broken out by cause so the fix is obvious from the metric:
+
+- ``compile``    — device-compute time of steps flagged as compiling
+  (first step, or any re-trace). Fix: static shapes, AOT warmup.
+- ``fault``      — full wall time of failed attempts (NaN-guard retries,
+  injected faults, held-batch replays). Fix: see resilience knobs.
+- ``checkpoint`` — step-loop stall waiting on checkpoint writes. Fix:
+  async checkpointing / larger writer backlog.
+
+Usage (the trainer's fit loop)::
+
+    clock = StepClock()
+    with clock.segment("data_wait"):  batch = next(gen)
+    with clock.segment("h2d"):        batch = place(batch)
+    clock.mark_compile()              # first step only
+    with clock.segment("compute"):    loss = step(batch)
+    clock.end_step(ok=True)
+    ...
+    logger.log(clock.interval_metrics(), step)   # every log interval
+
+The clock is host-side only (pure ``time.perf_counter``), costs tens of
+nanoseconds per segment, and never touches jax.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+#: Segment names a step decomposes into. "other" is derived (wall minus
+#: attributed), never passed to segment().
+SEGMENTS = ("data_wait", "h2d", "compute", "checkpoint_stall", "logging",
+            "eval")
+LOSS_KINDS = ("compile", "fault", "checkpoint")
+
+
+class _NullContext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class StepClock:
+    """Per-step wall-clock attribution + cumulative goodput.
+
+    ``enabled=False`` turns every method into a near-free no-op — the
+    bench.py ``telemetry`` target uses this as the zero-overhead
+    baseline, and it is the off-switch for ``logging.telemetry``.
+    """
+
+    def __init__(self, enabled: bool = True, now=time.perf_counter):
+        self.enabled = enabled
+        self.now = now
+        # current-step accumulation
+        self._step_start: Optional[float] = None
+        self._seg_acc: Dict[str, float] = {}
+        self._compile_pending = False
+        # cumulative totals (seconds) since construction
+        self.wall_total = 0.0
+        self.good_compute = 0.0
+        self.lost: Dict[str, float] = {k: 0.0 for k in LOSS_KINDS}
+        self.seg_total: Dict[str, float] = {s: 0.0 for s in SEGMENTS}
+        self.other_total = 0.0
+        self.steps_ok = 0
+        self.steps_failed = 0
+        # interval window (reset by interval_metrics)
+        self._win: List[Dict[str, float]] = []
+
+    # ------------------------------------------------------------- recording
+
+    def _ensure_started(self) -> None:
+        if self._step_start is None:
+            self._step_start = self.now()
+            self._seg_acc = {}
+
+    @contextmanager
+    def _timed(self, name: str):
+        self._ensure_started()
+        t0 = self.now()
+        try:
+            yield
+        finally:
+            self._seg_acc[name] = (self._seg_acc.get(name, 0.0)
+                                   + self.now() - t0)
+
+    def segment(self, name: str):
+        """Context manager attributing the enclosed wall time to one
+        segment of the current step. Re-entering the same name within a
+        step accumulates."""
+        if not self.enabled:
+            return _NullContext()
+        if name not in SEGMENTS:
+            raise ValueError(f"unknown step segment {name!r}; "
+                             f"one of {SEGMENTS}")
+        return self._timed(name)
+
+    def mark_compile(self) -> None:
+        """Flag the current step's device compute as compile time (call
+        before the first dispatch of a fresh jitted fn)."""
+        if self.enabled:
+            self._ensure_started()
+            self._compile_pending = True
+
+    def end_step(self, ok: bool = True) -> None:
+        """Close the current step attempt. ``ok=False`` (guard retry,
+        injected fault) charges the attempt's entire wall time to
+        ``lost["fault"]`` — a failed attempt produced no progress, so
+        none of it is goodput."""
+        if not self.enabled or self._step_start is None:
+            return
+        wall = self.now() - self._step_start
+        seg = dict(self._seg_acc)
+        other = max(0.0, wall - sum(seg.values()))
+        compute = seg.get("compute", 0.0)
+
+        self.wall_total += wall
+        for s in SEGMENTS:
+            self.seg_total[s] += seg.get(s, 0.0)
+        self.other_total += other
+        self.lost["checkpoint"] += seg.get("checkpoint_stall", 0.0)
+        if not ok:
+            self.steps_failed += 1
+            self.lost["fault"] += wall
+        else:
+            self.steps_ok += 1
+            if self._compile_pending:
+                self.lost["compile"] += compute
+            else:
+                self.good_compute += compute
+        self._win.append({"wall": wall, "other": other, **seg})
+
+        self._step_start = None
+        self._seg_acc = {}
+        self._compile_pending = False
+
+    # --------------------------------------------------------------- exports
+
+    def goodput(self) -> float:
+        """Cumulative useful-device-compute fraction of wall clock."""
+        if self.wall_total <= 0.0:
+            return 0.0
+        return self.good_compute / self.wall_total
+
+    def badput(self) -> Dict[str, float]:
+        if self.wall_total <= 0.0:
+            return {k: 0.0 for k in LOSS_KINDS}
+        return {k: v / self.wall_total for k, v in self.lost.items()}
+
+    def interval_metrics(self, reset: bool = True) -> Dict[str, float]:
+        """Catalog-named metric dict for one log interval: mean ms per
+        segment over the window since the previous call, plus cumulative
+        goodput/badput fractions."""
+        if not self.enabled:
+            return {}
+        n = max(1, len(self._win))
+        mean = lambda key: 1000.0 * sum(  # noqa: E731
+            w.get(key, 0.0) for w in self._win) / n
+        out = {
+            "telemetry/step_ms": mean("wall"),
+            "telemetry/data_wait_ms": mean("data_wait"),
+            "telemetry/h2d_ms": mean("h2d"),
+            "telemetry/compute_ms": mean("compute"),
+            "telemetry/checkpoint_stall_ms": mean("checkpoint_stall"),
+            "telemetry/logging_ms": mean("logging"),
+            "telemetry/eval_ms": mean("eval"),
+            "telemetry/other_ms": mean("other"),
+            "telemetry/goodput": self.goodput(),
+        }
+        for kind, frac in self.badput().items():
+            out[f"telemetry/badput_{kind}"] = frac
+        if reset:
+            self._win = []
+        return out

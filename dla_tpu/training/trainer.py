@@ -54,6 +54,16 @@ from dla_tpu.resilience import (
     ResilienceConfig,
     Watchdog,
 )
+from dla_tpu.telemetry import (
+    CollectorConfig,
+    FlightRecorder,
+    Gauge,
+    MFUCalculator,
+    MetricRegistry,
+    StepClock,
+    capture as telemetry_capture,
+    collect_train_scalars,
+)
 from dla_tpu.training.optim import build_optimizer
 from dla_tpu.training.utils import StepTimer, check_batch_identity
 from dla_tpu.utils.logging import MetricsLogger, RunningMean, log_rank_zero
@@ -148,28 +158,57 @@ class Trainer:
         self.logger = MetricsLogger(
             log_cfg.get("log_dir"), config.get("experiment_name", "run"),
             use_wandb=bool(log_cfg.get("use_wandb", False)), config=config)
+        # ---- telemetry: step clock, in-graph collector, flight recorder,
+        # MFU, shared registry (docs/OBSERVABILITY.md). Created BEFORE the
+        # resilience objects so they can record into the flight recorder.
+        tel_cfg = dict(log_cfg.get("telemetry", {}) or {})
+        tel_enabled = bool(tel_cfg.get("enabled", True))
+        self.clock = StepClock(enabled=tel_enabled)
+        ckpt_dir = log_cfg.get("output_dir", "checkpoints/run")
+        self.recorder = FlightRecorder(
+            capacity=int(tel_cfg.get("flight_recorder_capacity", 256)),
+            out_dir=log_cfg.get("log_dir") or ckpt_dir)
+        self.collector_cfg = CollectorConfig.from_config(tel_cfg)
+        dev = jax.devices()[0]
+        self.n_params = int(sum(np.prod(l.shape)
+                                for l in jax.tree.leaves(self.params)))
+        self.mfu_calc = MFUCalculator(
+            self.n_params, getattr(dev, "device_kind", dev.platform),
+            dev.platform)
+        self.registry = MetricRegistry()
         # ---- resilience: async checkpointing, preemption, guard, watchdog
         self.resilience = ResilienceConfig.from_config(
             config.get("resilience"))
-        ckpt_dir = log_cfg.get("output_dir", "checkpoints/run")
         keep_n = int(log_cfg.get("keep_last_n", 3))
         if self.resilience.async_checkpointing:
             self.checkpointer: Checkpointer = AsyncCheckpointer(
                 ckpt_dir, keep_last_n=keep_n,
                 max_retries=self.resilience.save_retries,
                 backoff_s=self.resilience.retry_backoff_s,
-                faults=self.resilience.fault_plan)
+                faults=self.resilience.fault_plan,
+                recorder=self.recorder)
         else:
             self.checkpointer = Checkpointer(ckpt_dir, keep_last_n=keep_n)
         swept = self.checkpointer.sweep_stale_tmp()
         if swept:
             log_rank_zero(
                 f"[dla_tpu] swept stale checkpoint staging dirs: {swept}")
-        self.guard = GuardState(self.resilience.guard)
+        self.guard = GuardState(self.resilience.guard,
+                                recorder=self.recorder)
         self.preemption = PreemptionHandler(
-            sync_every=self.resilience.preemption_sync_every)
-        self.watchdog = (Watchdog(self.resilience.watchdog_timeout_s)
+            sync_every=self.resilience.preemption_sync_every,
+            recorder=self.recorder)
+        self.watchdog = (Watchdog(self.resilience.watchdog_timeout_s,
+                                  recorder=self.recorder)
                          if self.resilience.watchdog_enabled else None)
+        self._register_func_gauges()
+        # optional Prometheus scrape endpoint on the trainer's registry
+        self.metrics_server = None
+        if tel_cfg.get("metrics_port") is not None \
+                and jax.process_index() == 0:
+            from dla_tpu.telemetry import MetricsHTTPServer
+            self.metrics_server = MetricsHTTPServer(
+                self.registry, port=int(tel_cfg["metrics_port"]))
         # trace-time counter (the function body runs once per XLA compile)
         # — how tests pin "the guard adds zero extra train-step compiles"
         self.train_step_compiles = 0
@@ -180,6 +219,47 @@ class Trainer:
         # driving step_on_batch) honor logging.profile too; such drivers
         # must call trainer.profile.close() when their loop ends
         self.profile = ProfileWindow(log_cfg.get("profile"))
+
+    # ----------------------------------------------------------- telemetry
+
+    def _register_func_gauges(self) -> None:
+        """Bridge the resilience counters into the shared registry as
+        read-through gauges — no double bookkeeping, the hot paths keep
+        mutating their plain attributes."""
+        r = self.registry
+        ck = self.checkpointer
+        if isinstance(ck, AsyncCheckpointer):
+            r.func_gauge("resilience/ckpt_saves_started",
+                         lambda: ck.saves_started)
+            r.func_gauge("resilience/ckpt_saves_completed",
+                         lambda: ck.saves_completed)
+            r.func_gauge("resilience/ckpt_io_retries",
+                         lambda: ck.retries_total)
+            r.func_gauge("resilience/ckpt_stall_ms_total",
+                         lambda: ck.total_stall_ms)
+        r.func_gauge("resilience/guard_bad_steps",
+                     lambda: self.guard.bad_steps_total)
+        r.func_gauge("resilience/guard_rollbacks",
+                     lambda: self.guard.rollbacks)
+        r.func_gauge("resilience/preemptions_requested",
+                     lambda: self.preemption.requests_total)
+
+    def _registry_update(self, payload: Dict[str, Any]) -> None:
+        """Mirror a log payload into the registry (gauges, lazily
+        registered) so a /metrics scrape sees the latest interval.
+        Keys outside the catalog (exotic loss_fn extras) are skipped —
+        the JSONL row still carries them."""
+        for k, v in payload.items():
+            if not isinstance(v, (int, float)) or v is None:
+                continue
+            inst = self.registry._instruments.get(k)
+            if inst is None:
+                try:
+                    inst = self.registry.gauge(k)
+                except ValueError:
+                    continue
+            if isinstance(inst, Gauge):
+                inst.set(float(v))
 
     # ------------------------------------------------------------ the step
 
@@ -194,7 +274,14 @@ class Trainer:
         self.train_step_compiles += 1        # trace-time only
 
         def micro_loss(p, mb, r):
-            loss, metrics = self.loss_fn(p, frozen, mb, r)
+            # telemetry stash: model/loss code may stash_scalar/stash_rms
+            # (per-layer activation RMS etc.) while tracing; the stashed
+            # tracers merge into the metrics pytree the step already
+            # returns — zero extra host syncs, zero extra compiles
+            with telemetry_capture() as stash:
+                loss, metrics = self.loss_fn(p, frozen, mb, r)
+            if stash:
+                metrics = {**dict(metrics), **stash}
             return loss, metrics
 
         grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
@@ -214,11 +301,12 @@ class Trainer:
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, self.grad_accum_dtype), params)
         rngs = jax.random.split(rng, self.accum)
-        # metric structure probe (cheap: eval_shape)
+        # metric structure probe (cheap: eval_shape) — through micro_loss,
+        # so stashed telemetry scalars are part of the probed structure
         metric_shapes = jax.eval_shape(
-            lambda: self.loss_fn(params, frozen,
-                                 jax.tree.map(lambda x: x[0], batch),
-                                 rng)[1])
+            lambda: micro_loss(params,
+                               jax.tree.map(lambda x: x[0], batch),
+                               rng)[1])
         zero_metrics = jax.tree.map(
             lambda s: jnp.zeros((), jnp.float32), metric_shapes)
 
@@ -238,6 +326,11 @@ class Trainer:
         gnorm = optax.global_norm(grads)
         metrics = dict(metrics)
         metrics["grad_norm"] = gnorm
+        # in-graph collector: a few more reduce-to-scalar ops riding the
+        # same output pytree (invisible next to fwd+bwd; still 1 compile)
+        metrics.update(collect_train_scalars(
+            self.collector_cfg, params=new_params, updates=updates,
+            grads=grads))
         if self.guard.cfg.enabled:
             # NaN/spike guard, entirely in-graph: compute the step as
             # usual, then SELECT old vs new state on a finite-step flag.
@@ -353,9 +446,12 @@ class Trainer:
                   ) -> Tuple[float, Dict[str, float]]:
         while True:
             loss, metrics, ok = self._execute_step(batch, rng)
+            self.clock.end_step(ok=ok)
             if ok:
                 self.guard.on_step(True, loss)
                 self.step += 1
+                self.recorder.record("step_end", step=self.step,
+                                     loss=float(loss))
                 return loss, {k: float(v) for k, v in metrics.items()}
             verdict = self.guard.on_step(False, loss)
             if verdict == RETRY:
@@ -380,11 +476,16 @@ class Trainer:
                   if self.resilience.fault_plan.take("nan", self.step)
                   else np.float32(0.0))
         self.profile.on_step(self.step)
-        with step_annotation(self.step):
+        compiles_before = self.train_step_compiles
+        with self.clock.segment("compute"), step_annotation(self.step):
             self.params, self.opt_state, loss, metrics = step_fn(
                 self.params, self.opt_state, self.frozen, batch, rng,
                 np.float32(self.guard.ema), inject)
-        loss_f = float(loss)
+            loss_f = float(loss)   # sync point: compute_ms = full step
+        if self.train_step_compiles > compiles_before:
+            # the body traced during that dispatch -> this attempt's
+            # compute is compile time, not goodput
+            self.clock.mark_compile()
         ok = (not self.guard.cfg.enabled
               or bool(float(metrics["guard_ok"])))
         return loss_f, metrics, ok
@@ -444,43 +545,60 @@ class Trainer:
                     # preemption exit is resumable from
                     if self.preemption.should_checkpoint(self.step):
                         self._emergency_save(data_state, extra_aux)
-                    np_batch = next(gen)
+                    with self.clock.segment("data_wait"):
+                        np_batch = next(gen)
                     n_tokens = _count_tokens(np_batch, tokens_per_batch_key) \
                         * jax.process_count()
-                    held = (self.place_batch(np_batch), n_tokens)
+                    with self.clock.segment("h2d"):
+                        held = (self.place_batch(np_batch), n_tokens)
                 batch, n_tokens = held
                 step_rng = jax.random.fold_in(rng, self.step)
                 loss, metrics, ok = self._execute_step(batch, step_rng)
                 if not ok:
                     verdict = self.guard.on_step(False, loss)
                     held = self._handle_bad_step(verdict, held)
+                    self.clock.end_step(ok=False)
                     continue
                 self.guard.on_step(True, loss)
                 held = None
                 self.step += 1
                 timer.tick(n_tokens)
                 running.update(loss)
+                self.recorder.record("step_end", step=self.step,
+                                     loss=float(loss))
 
                 if self.step % self.log_every == 0:
-                    payload = {"train/loss": running.average,
-                               "train/loss_instant": loss,
-                               "train/lr": float(self.schedule(self.step)),
-                               **{f"train/{k}": float(v)
-                                  for k, v in metrics.items()},
-                               **timer.rates()}
-                    if self.guard.bad_steps_total:
-                        payload["train/guard_bad_steps"] = float(
-                            self.guard.bad_steps_total)
-                    self.logger.log(payload, self.step)
-                    log_rank_zero(
-                        f"step {self.step}: loss {running.average:.4f} "
-                        f"({payload.get('tokens_per_sec_per_chip', 0):.0f} tok/s/chip)")
+                    with self.clock.segment("logging"):
+                        payload = {"train/loss": running.average,
+                                   "train/loss_instant": loss,
+                                   "train/lr": float(self.schedule(self.step)),
+                                   **{f"train/{k}": float(v)
+                                      for k, v in metrics.items()},
+                                   **timer.rates()}
+                        if self.guard.bad_steps_total:
+                            payload["train/guard_bad_steps"] = float(
+                                self.guard.bad_steps_total)
+                        payload.update(self.clock.interval_metrics())
+                        payload["telemetry/mfu"] = self.mfu_calc.mfu(
+                            payload.get("tokens_per_sec_per_chip"))
+                        self._registry_update(payload)
+                        self.logger.log(payload, self.step)
+                        log_rank_zero(
+                            f"step {self.step}: loss {running.average:.4f} "
+                            f"({payload.get('tokens_per_sec_per_chip', 0):.0f}"
+                            f" tok/s/chip, goodput "
+                            f"{100 * payload.get('telemetry/goodput', 0):.0f}%,"
+                            f" mfu {100 * payload['telemetry/mfu']:.1f}%)")
 
                 if self.eval_every and eval_iter_fn and self.step % self.eval_every == 0:
-                    self.run_eval(eval_iter_fn, eval_batches, rng)
+                    with self.clock.segment("eval"):
+                        self.run_eval(eval_iter_fn, eval_batches, rng)
 
                 if self.save_every and self.step % self.save_every == 0:
-                    self.save(data_state() if data_state else None, extra_aux)
+                    with self.clock.segment("checkpoint_stall"):
+                        self.save(data_state() if data_state else None,
+                                  extra_aux)
+                self.clock.end_step(ok=True)
         finally:
             # a failed step must not lose an already-open trace window
             self.profile.close()
@@ -524,9 +642,14 @@ class Trainer:
         log_rank_zero(
             f"[dla_tpu] preemption requested: writing emergency checkpoint "
             f"@ step {self.step}")
-        self.checkpoint_wait()
-        self.save(data_state() if data_state else None, extra_aux)
-        self.checkpoint_wait()   # the exit must not outrun an async write
+        with self.clock.segment("checkpoint_stall"):
+            self.checkpoint_wait()
+            self.save(data_state() if data_state else None, extra_aux)
+            self.checkpoint_wait()  # the exit must not outrun an async write
+        # postmortem before the (clean) exit: what the run's last steps
+        # looked like, and which step the emergency checkpoint covers
+        self.recorder.record("preemption_exit", step=self.step)
+        self.recorder.dump("preemption")
         raise PreemptionExit(self.step)
 
     def _handle_bad_step(self, verdict: Optional[str], held):
@@ -552,6 +675,9 @@ class Trainer:
         checkpoint after K consecutive non-finite steps. The data stream
         is NOT rewound — the poison batch is dropped and the run re-walks
         the schedule from the restored step on fresh batches."""
+        # divergence postmortem BEFORE restoring: the ring still holds the
+        # steps that led into the NaN streak
+        self.recorder.dump("guard_rollback")
         self.checkpoint_wait()
         tag = self.checkpointer.latest_tag()
         if tag is None:

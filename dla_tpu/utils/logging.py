@@ -8,6 +8,7 @@ reference lacks: tokens/sec/chip on every trainer.
 from __future__ import annotations
 
 import json
+import math
 import time
 from collections import deque
 from pathlib import Path
@@ -58,7 +59,11 @@ class MetricsLogger:
                    **{k: _scalar(v) for k, v in metrics.items()}}
         if self.jsonl_path:
             with self.jsonl_path.open("a") as fh:
-                fh.write(json.dumps(payload) + "\n")
+                # allow_nan=False would throw mid-training; non-finite
+                # scalars (a diverging loss is when logs matter MOST)
+                # are already nulled by _scalar, keeping every line
+                # strict JSON for downstream parsers.
+                fh.write(json.dumps(payload, allow_nan=False) + "\n")
         if self._wandb is not None:
             self._wandb.log(metrics, step=step)
 
@@ -69,9 +74,13 @@ class MetricsLogger:
 
 def _scalar(v: Any) -> Any:
     try:
-        return float(v)
+        f = float(v)
     except (TypeError, ValueError):
         return v
+    # json.dumps would emit bare NaN/Infinity — NOT valid JSON, and one
+    # such token corrupts metrics.jsonl for every strict parser
+    # downstream. Null is the honest strict-JSON spelling of "no value".
+    return f if math.isfinite(f) else None
 
 
 def percentile(values, q: float) -> float:

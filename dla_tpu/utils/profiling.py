@@ -111,9 +111,11 @@ class ProfileWindow:
         self._done = True
 
 
-def step_annotation(step: int):
-    """Per-step trace annotation; no-op cost when no trace is active."""
-    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+def step_annotation(step: int, name: str = "train"):
+    """Per-step trace annotation; no-op cost when no trace is active.
+    ``name`` distinguishes loops sharing a trace ("train" vs the serving
+    engine's "serve")."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
 
 
 @contextlib.contextmanager

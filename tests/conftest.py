@@ -22,6 +22,24 @@ assert force_cpu_platform(8), (
     "wrong platform or device count already initialized in this process); "
     "run pytest in a fresh interpreter")
 
+# NOTE: do not enable jax's persistent compilation cache here. Executables
+# containing host callbacks (the trainer's guard / fault-injection path)
+# bake callback registry ids into the serialized artifact; a same-process
+# cache hit later in the suite deserializes an executable whose ids point
+# at different callbacks and segfaults (reproduced on test_resilience).
+
+# CPU async dispatch queues eager computations behind an in-flight
+# semaphore shared process-wide; late in the suite (hundreds of jitted
+# programs, host callbacks, and 8-virtual-device collectives behind us)
+# a dispatch of a sharded eager op can block forever on that semaphore /
+# collective rendezvous — reproduced as a futex-wait hang with an idle
+# runtime pool in test_train_rlhf's minibatch jnp.take. Synchronous
+# dispatch sidesteps the queue entirely; throughput here is bounded by
+# the computations themselves, so the cost is noise.
+import jax  # noqa: E402
+
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Static metric-name check: every metric-name string literal at an
+emission site must be declared in the telemetry registry's CATALOG
+(dla_tpu/telemetry/registry.py).
+
+A renamed metric is a silent production failure — the dashboard panel
+flatlines, alerts stop matching, and nobody notices until an incident.
+This check makes a rename a loud build failure instead: it greps
+``dla_tpu/`` and ``bench.py`` for quoted ``area/name`` literals in the
+known metric areas and fails (exit 1, listing file:line) on any name
+the catalog does not declare. Invoked by tests/test_telemetry.py as a
+fast test; run manually with::
+
+    python tools/check_metric_names.py
+"""
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dla_tpu.telemetry.registry import (  # noqa: E402
+    catalog_names,
+    is_catalog_name,
+)
+
+#: Quoted literal starting with a known metric area. Trailing "/" or "_"
+#: marks a prefix literal (f-string stem like "serving/ttft_ms_" or
+#: "train/" + key) — validated as a prefix of catalog names.
+_LITERAL_RE = re.compile(
+    r"""["'](?P<name>(?:train|eval|serving|telemetry|resilience)
+        /[A-Za-z0-9_/]*)""", re.VERBOSE)
+
+#: Files whose job is to *declare* names, not emit them.
+_SKIP = {"dla_tpu/telemetry/registry.py"}
+
+
+def _prefix_ok(literal: str) -> bool:
+    stem = literal.rstrip("_/")
+    return any(n.startswith(stem) for n in catalog_names())
+
+
+def scan_file(path: Path, rel: str):
+    """Yield (line_number, literal) for undeclared names in one file."""
+    text = path.read_text()
+    for m in _LITERAL_RE.finditer(text):
+        name = m.group("name")
+        if name.endswith(("/", "_")):
+            if _prefix_ok(name):
+                continue
+        elif is_catalog_name(name):
+            continue
+        lineno = text.count("\n", 0, m.start()) + 1
+        yield lineno, name
+
+
+def run(repo: Path = REPO) -> int:
+    files = sorted((repo / "dla_tpu").rglob("*.py")) + [repo / "bench.py"]
+    bad = []
+    for f in files:
+        rel = f.relative_to(repo).as_posix()
+        if rel in _SKIP:
+            continue
+        for lineno, name in scan_file(f, rel):
+            bad.append((rel, lineno, name))
+    if bad:
+        print("metric names not declared in telemetry.registry.CATALOG "
+              "(add a MetricSpec + docs/OBSERVABILITY.md row, or fix the "
+              "emission site):", file=sys.stderr)
+        for rel, lineno, name in bad:
+            print(f"  {rel}:{lineno}: {name!r}", file=sys.stderr)
+        return 1
+    print(f"check_metric_names: OK ({len(files)} files scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())
