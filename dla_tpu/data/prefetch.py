@@ -32,10 +32,18 @@ class _WorkerError:
 class PrefetchIterator:
     """Wrap a (resumable) batch iterator with an N-deep prefetch queue."""
 
-    def __init__(self, source: Any, prefetch: int = 2):
+    def __init__(self, source: Any, prefetch: int = 2, tracer=None):
         if prefetch < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {prefetch}")
         self.source = source
+        # host-trace feed: each produced batch is a "prefetch_next" slice
+        # on the worker thread, concurrent with the trainer's step slices
+        # (the overlap this class exists to create, made visible). The
+        # default global tracer is disabled -> zero overhead.
+        if tracer is None:
+            from dla_tpu.telemetry.trace import get_tracer
+            tracer = get_tracer()
+        self.tracer = tracer
         self._q: "queue.Queue" = queue.Queue(maxsize=prefetch)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -76,7 +84,17 @@ class PrefetchIterator:
 
     def _worker(self) -> None:
         try:
-            for batch in iter(self.source):
+            it = iter(self.source)
+            while True:
+                # span covers only the source's own work (tokenize/pack/
+                # collate), not time blocked on a full queue — a full
+                # queue means the host is AHEAD, which is not a cost.
+                try:
+                    with self.tracer.span("prefetch_next", cat="data",
+                                          index=self.produced):
+                        batch = next(it)
+                except StopIteration:
+                    break
                 if not self._put((batch, self._source_state())):
                     return
                 self.produced += 1

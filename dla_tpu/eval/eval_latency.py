@@ -198,10 +198,13 @@ def measure_serving(model, params, srv: Dict) -> Dict[str, float]:
         "serve_tokens_per_second": snap["serving/tokens_generated"] / dt,
         "ttft_ms_p50": snap["serving/ttft_ms_p50"],
         "ttft_ms_p95": snap["serving/ttft_ms_p95"],
+        "ttft_ms_p99": snap["serving/ttft_ms_p99"],
         "itl_ms_p50": snap["serving/itl_ms_p50"],
         "itl_ms_p95": snap["serving/itl_ms_p95"],
+        "itl_ms_p99": snap["serving/itl_ms_p99"],
         "queue_wait_ms_p50": snap["serving/queue_wait_ms_p50"],
         "queue_wait_ms_p95": snap["serving/queue_wait_ms_p95"],
+        "queue_wait_ms_p99": snap["serving/queue_wait_ms_p99"],
         "preemptions": snap["serving/preemptions"],
         "page_occupancy_peak": snap["serving/page_occupancy_peak"],
     }
@@ -254,8 +257,10 @@ def main(argv=None) -> None:
                 log_rank_zero(
                     f"[dla_tpu][latency] serving: "
                     f"{entry['serving']['requests_per_second']:.2f} req/s "
-                    f"ttft p50 {entry['serving']['ttft_ms_p50']:.1f} ms "
-                    f"itl p50 {entry['serving']['itl_ms_p50']:.2f} ms "
+                    f"ttft p50 {entry['serving']['ttft_ms_p50']:.1f} "
+                    f"p99 {entry['serving']['ttft_ms_p99']:.1f} ms "
+                    f"itl p50 {entry['serving']['itl_ms_p50']:.2f} "
+                    f"p99 {entry['serving']['itl_ms_p99']:.2f} ms "
                     f"({entry['serving']['preemptions']:.0f} preemptions)")
         finally:
             # a mid-grid failure must not lose the already-captured trace

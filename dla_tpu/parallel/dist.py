@@ -75,3 +75,19 @@ def barrier(name: str = "barrier") -> None:
         return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(name)
+
+
+def allgather_floats(row) -> "np.ndarray":
+    """Gather one small float row from every host: [k] -> [hosts, k].
+
+    The telemetry aggregation path (telemetry.aggregate) rides this at
+    log cadence; it is a rendezvous, so every host must call it at the
+    same point. Single-process returns the row as [1, k] with no
+    collective at all.
+    """
+    import numpy as np
+    arr = np.asarray(row, dtype=np.float64)
+    if jax.process_count() == 1:
+        return arr[None, :]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr))

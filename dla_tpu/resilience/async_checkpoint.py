@@ -62,13 +62,18 @@ class AsyncCheckpointer(Checkpointer):
     def __init__(self, output_dir: str, keep_last_n: int = 3,
                  max_retries: int = 3, backoff_s: float = 0.5,
                  backoff_jitter: float = 0.25,
-                 faults: Optional[FaultPlan] = None, recorder=None):
+                 faults: Optional[FaultPlan] = None, recorder=None,
+                 tracer=None):
         super().__init__(output_dir, keep_last_n=keep_last_n)
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
         self.backoff_jitter = float(backoff_jitter)
         self.faults = faults or FaultPlan()
         self.recorder = recorder      # telemetry.FlightRecorder (optional)
+        if tracer is None:
+            from dla_tpu.telemetry.trace import get_tracer
+            tracer = get_tracer()     # disabled default: zero overhead
+        self.tracer = tracer
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._rng = random.Random(0x5EED)
@@ -85,8 +90,12 @@ class AsyncCheckpointer(Checkpointer):
              tag: Optional[str] = None) -> Path:
         tag = tag or f"step_{step:08d}"
         t0 = time.perf_counter()
-        self.wait()                       # backpressure: one save in flight
-        index, writes = self.plan(step, tree, aux, copy=True)
+        with self.tracer.span("ckpt_backpressure", cat="checkpoint",
+                              step=int(step)):
+            self.wait()                   # backpressure: one save in flight
+        with self.tracer.span("ckpt_snapshot", cat="checkpoint",
+                              step=int(step)):
+            index, writes = self.plan(step, tree, aux, copy=True)
         stall = (time.perf_counter() - t0) * 1000.0
         self.last_stall_ms = stall
         self.total_stall_ms += stall
@@ -122,8 +131,12 @@ class AsyncCheckpointer(Checkpointer):
     def _writer(self, step: int, tag: str, index: Dict[str, Any],
                 writes: List[Tuple[str, np.ndarray]]) -> None:
         try:
-            self._with_retries(step, tag,
-                               lambda: self._attempt(tag, index, writes))
+            # spans on THIS thread, concurrent with the trainer's step
+            # slices — the trace is how the overlap is verified
+            with self.tracer.span("ckpt_write", cat="checkpoint",
+                                  tag=tag, step=int(step)):
+                self._with_retries(
+                    step, tag, lambda: self._attempt(tag, index, writes))
             self.saves_completed += 1
             if self.recorder is not None:
                 self.recorder.record("ckpt_save_done", step=step)

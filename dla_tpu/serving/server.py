@@ -45,7 +45,9 @@ from dla_tpu.serving.scheduler import (
     Scheduler,
     SchedulerConfig,
 )
-from dla_tpu.telemetry.exporter import MetricsHTTPServer
+from dla_tpu.telemetry.exporter import MetricsHTTPServer, ReadinessProbe
+from dla_tpu.telemetry.slo import SLOWatch
+from dla_tpu.telemetry.trace import Tracer, get_tracer, install_tracer
 from dla_tpu.utils.profiling import ProfileWindow, annotate, step_annotation
 
 
@@ -66,6 +68,17 @@ class ServingConfig:
     profile: Optional[Dict] = None
     # Prometheus scrape endpoint (telemetry.exporter); 0 = ephemeral
     metrics_port: Optional[int] = None
+    # host tracing (telemetry.trace): same {enabled, capacity, path}
+    # block as the trainer's logging.telemetry.trace. When enabled the
+    # engine emits one async span tree per request (enqueue -> admitted
+    # -> first token -> per-decode instants -> finish), timestamped with
+    # the engine's own clock so trace durations equal recorded TTFT/ITL.
+    trace: Optional[Dict] = None
+    # SLO watch (telemetry.slo): {objectives: [...], check_every: N}
+    # evaluated against the metrics snapshot every N engine steps
+    slo: Optional[Dict] = None
+    # /healthz flips to 503 when no engine step completed for this long
+    readiness_timeout_s: float = 600.0
 
     @property
     def pages_per_slot(self) -> int:
@@ -114,6 +127,31 @@ class ServingEngine:
         # analog of the trainer's step number)
         self.engine_steps = 0
         self.profile = ProfileWindow(cfg.profile)
+        # host tracer: an engine-local one from cfg.trace (built on the
+        # engine's OWN clock so request timestamps pass straight in and
+        # trace durations equal recorded TTFT/ITL), installed process-
+        # wide so annotate/step_annotation land on the same timeline;
+        # otherwise whatever tracer is already installed (a co-located
+        # trainer's) — or the disabled default, costing nothing.
+        trace_cfg = dict(cfg.trace or {})
+        self._installed_tracer = False
+        if trace_cfg.get("enabled"):
+            self.tracer = Tracer(
+                enabled=True,
+                capacity=int(trace_cfg.get("capacity", 65536)),
+                now=now, path=trace_cfg.get("path"))
+            install_tracer(self.tracer)
+            self._installed_tracer = True
+        else:
+            self.tracer = get_tracer()
+        # SLO watch over the serving snapshot (TTFT p95 etc.), checked
+        # every `check_every` engine steps; /healthz readiness heartbeat
+        self.slo = SLOWatch.from_config(cfg.slo,
+                                        registry=self.metrics.registry)
+        self._slo_every = max(1, int((cfg.slo or {}).get("check_every",
+                                                         100)))
+        self.readiness = ReadinessProbe(
+            threshold_s=float(cfg.readiness_timeout_s))
         self.metrics_server: Optional[MetricsHTTPServer] = None
         if cfg.metrics_port is not None:
             self.start_metrics_server(cfg.metrics_port)
@@ -227,6 +265,14 @@ class ServingEngine:
         self.scheduler.submit(req)
         self._results[req.rid] = req
         self.metrics.requests_submitted.inc()
+        if self.tracer.enabled:
+            # root of the request's async span tree, keyed by rid and
+            # opened at the recorded arrival time — so the tree's span
+            # durations are exactly the recorded latency metrics
+            self.tracer.async_begin(
+                "request", "request", req.rid, t=req.arrival_time,
+                prompt_tokens=len(req.prompt_tokens),
+                max_new_tokens=req.max_new_tokens)
         return req.rid
 
     def result(self, rid: int) -> Request:
@@ -254,10 +300,14 @@ class ServingEngine:
             if self.scheduler.running:
                 emitted.extend(self._decode_step())
         self.engine_steps += 1
+        self.readiness.beat()
         m = self.metrics
         m.queue_depth.set(self.scheduler.queue_depth)
         m.active_requests.set(self.scheduler.active_count)
         m.page_occupancy.set(self.cache.allocator.occupancy)
+        if self.slo is not None \
+                and self.engine_steps % self._slo_every == 0:
+            self.slo.observe(m.snapshot(), step=self.engine_steps)
         return emitted
 
     def run_until_drained(self, max_steps: int = 100000
@@ -280,13 +330,19 @@ class ServingEngine:
         binds an ephemeral port — read it back from ``.port``."""
         if self.metrics_server is None:
             self.metrics_server = MetricsHTTPServer(
-                self.metrics.registry, port=port)
+                self.metrics.registry, port=port,
+                readiness=self.readiness)
         return self.metrics_server
 
     def close(self) -> None:
-        """Release host-side resources (trace window, metrics endpoint).
-        Device state is dropped with the object as usual."""
+        """Release host-side resources (trace window, host tracer,
+        metrics endpoint). Device state is dropped with the object as
+        usual."""
         self.profile.close()
+        if self._installed_tracer:
+            self.tracer.dump()
+            install_tracer(None)     # don't leak into the next engine
+            self._installed_tracer = False
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
@@ -306,6 +362,9 @@ class ServingEngine:
         for req in [r for r in self.scheduler.queue if not r.generated]:
             self.scheduler.cancel(req, "cancelled")
             self.metrics.requests_cancelled.inc()
+            if self.tracer.enabled:
+                self.tracer.async_end("request", "request", req.rid,
+                                      status="cancelled", tokens=0)
 
     @property
     def draining(self) -> bool:
@@ -335,6 +394,10 @@ class ServingEngine:
         for req in self.scheduler.expired(now):
             self.scheduler.cancel(req, "timeout", RequestState.TIMEOUT)
             self.metrics.requests_timed_out.inc()
+            if self.tracer.enabled:
+                self.tracer.async_end(
+                    "request", "request", req.rid, t=now,
+                    status="timeout", tokens=len(req.generated))
 
     # ------------------------------------------------------------ internals
 
@@ -382,6 +445,11 @@ class ServingEngine:
                 req.admitted_time = t_done
                 self.metrics.queue_wait_ms.record(
                     (t_done - req.arrival_time) * 1000.0)
+                if self.tracer.enabled:
+                    self.tracer.async_instant(
+                        "request", "admitted", req.rid, t=t_done,
+                        queue_wait_ms=(t_done - req.arrival_time)
+                        * 1000.0)
             self.cache.open_slot(req.slot, req.pages,
                                  len(req.prefix_tokens), width, tok)
             self.scheduler.activate(req)
@@ -429,18 +497,35 @@ class ServingEngine:
         req.generated.append(tok)
         emitted.append((req.rid, tok))
         self.metrics.tokens_generated.inc()
+        traced = self.tracer.enabled
         if req.first_token_time is None:
             req.first_token_time = t
             self.metrics.ttft_ms.record((t - req.arrival_time) * 1000.0)
+            if traced:
+                self.tracer.async_instant(
+                    "request", "first_token", req.rid, t=t,
+                    ttft_ms=(t - req.arrival_time) * 1000.0)
         elif not first_of_prefill and req.last_token_time is not None:
             # inter-token latency only between consecutive decode steps
             # (a re-prefill after eviction restarts the clock)
             self.metrics.itl_ms.record((t - req.last_token_time) * 1000.0)
+            if traced:
+                self.tracer.async_instant(
+                    "request", "decode", req.rid, t=t,
+                    n=len(req.generated),
+                    itl_ms=(t - req.last_token_time) * 1000.0)
         req.last_token_time = t
         eos = self.gen.eos_token_id
+        status = None
         if eos is not None and eos >= 0 and tok == eos:
             self.scheduler.finish(req, "eos")
             self.metrics.requests_finished.inc()
+            status = "eos"
         elif len(req.generated) >= req.max_new_tokens:
             self.scheduler.finish(req, "length")
             self.metrics.requests_finished.inc()
+            status = "length"
+        if traced and status is not None:
+            self.tracer.async_end("request", "request", req.rid, t=t,
+                                  status=status,
+                                  tokens=len(req.generated))

@@ -7,7 +7,10 @@ through (docs/OBSERVABILITY.md).
 - collector       — in-graph scalar collection (zero extra compiles)
 - mfu             — MFU math + per-chip peak FLOPs / HBM tables
 - flight_recorder — crash postmortems from a bounded event ring
-- exporter        — stdlib HTTP ``/metrics`` endpoint
+- exporter        — stdlib HTTP ``/metrics`` + readiness ``/healthz``
+- trace           — thread-aware spans exported as Chrome-trace JSON
+- aggregate       — pod-wide per-host step-time/goodput + straggler
+- slo             — rolling-window SLOs with burn-rate alerting
 """
 from dla_tpu.telemetry.registry import (
     CATALOG,
@@ -39,14 +42,19 @@ from dla_tpu.telemetry.mfu import (
     peak_flops_for,
 )
 from dla_tpu.telemetry.flight_recorder import FlightRecorder
-from dla_tpu.telemetry.exporter import MetricsHTTPServer
+from dla_tpu.telemetry.exporter import MetricsHTTPServer, ReadinessProbe
+from dla_tpu.telemetry.trace import Tracer, get_tracer, install_tracer
+from dla_tpu.telemetry.aggregate import PodAggregator, SkewSimulator
+from dla_tpu.telemetry.slo import SLO, SLOWatch
 
 __all__ = [
     "CATALOG", "CollectorConfig", "Counter", "FlightRecorder",
     "FuncGauge", "Gauge", "Histogram", "MFUCalculator",
     "MetricRegistry", "MetricSpec", "MetricsHTTPServer",
-    "PEAK_BF16_FLOPS", "PEAK_HBM_BW", "StepClock", "capture",
-    "catalog_names", "collect_train_scalars", "flops_per_token",
-    "hbm_bw_for", "is_catalog_name", "parse_prometheus_text",
-    "peak_flops_for", "prometheus_name", "stash_rms", "stash_scalar",
+    "PEAK_BF16_FLOPS", "PEAK_HBM_BW", "PodAggregator", "ReadinessProbe",
+    "SLO", "SLOWatch", "SkewSimulator", "StepClock", "Tracer",
+    "capture", "catalog_names", "collect_train_scalars",
+    "flops_per_token", "get_tracer", "hbm_bw_for", "install_tracer",
+    "is_catalog_name", "parse_prometheus_text", "peak_flops_for",
+    "prometheus_name", "stash_rms", "stash_scalar",
 ]

@@ -3,7 +3,13 @@
 One daemon-threaded ``ThreadingHTTPServer`` serving two routes:
 
 - ``GET /metrics``  -> ``registry.prometheus_text()`` (text/plain 0.0.4)
-- ``GET /healthz``  -> ``ok`` (liveness for the serving launcher)
+- ``GET /healthz``  -> readiness, not just liveness. With a
+  :class:`ReadinessProbe` attached the body reports seconds since the
+  loop last completed a step (``ok age_s=1.2``) and flips to HTTP 503
+  (``stale age_s=...``) past the staleness threshold — so an external
+  probe (k8s, a pod launcher) catches a wedged loop *before* the
+  watchdog's SIGABRT, while the process is still scrapeable. Without a
+  probe it stays the plain liveness ``ok``.
 
 No dependencies beyond ``http.server`` — the container bakes nothing
 extra in and the endpoint must work in the leanest serving image.
@@ -14,8 +20,32 @@ off the serving/train loop thread, so scraping never perturbs step time.
 from __future__ import annotations
 
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+
+class ReadinessProbe:
+    """Last-heartbeat tracker behind ``/healthz``. The loop calls
+    ``beat()`` once per completed step (or engine tick); the handler
+    reads ``age_s``/``ready``. Monotonic clock: wall-clock jumps must
+    not fake a stall."""
+
+    def __init__(self, threshold_s: float = 600.0, now=time.monotonic):
+        self.threshold_s = float(threshold_s)
+        self.now = now
+        self._last = now()     # construction counts as the first beat
+
+    def beat(self) -> None:
+        self._last = self.now()
+
+    @property
+    def age_s(self) -> float:
+        return self.now() - self._last
+
+    @property
+    def ready(self) -> bool:
+        return self.age_s < self.threshold_s
 
 
 class MetricsHTTPServer:
@@ -24,8 +54,10 @@ class MetricsHTTPServer:
     killing the handler thread."""
 
     def __init__(self, registry, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 readiness: Optional[ReadinessProbe] = None):
         self.registry = registry
+        self.readiness = readiness
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -44,8 +76,18 @@ class MetricsHTTPServer:
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path.split("?")[0] == "/healthz":
-                    body = b"ok\n"
-                    self.send_response(200)
+                    probe = outer.readiness
+                    if probe is None:
+                        status, body = 200, b"ok\n"
+                    elif probe.ready:
+                        status = 200
+                        body = f"ok age_s={probe.age_s:.1f}\n".encode()
+                    else:
+                        status = 503
+                        body = (f"stale age_s={probe.age_s:.1f} "
+                                f"threshold_s={probe.threshold_s:.1f}\n"
+                                ).encode()
+                    self.send_response(status)
                     self.send_header("Content-Type", "text/plain")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()

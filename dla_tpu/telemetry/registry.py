@@ -176,6 +176,26 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "wall fraction lost to checkpoint stalls"),
     _s("telemetry/mfu", "gauge", "fraction",
        "model FLOPs utilization vs chip peak"),
+    # -- pod-wide aggregation (telemetry.aggregate; host 0 only)
+    _s("telemetry/pod_step_ms_max", "gauge", "ms",
+       "slowest host's interval step time (the pod's pace)"),
+    _s("telemetry/pod_step_ms_mean", "gauge", "ms",
+       "pod-mean interval step time"),
+    _s("telemetry/pod_step_ms_min", "gauge", "ms",
+       "fastest host's interval step time"),
+    _s("telemetry/pod_goodput_min", "gauge", "fraction",
+       "worst host's cumulative goodput"),
+    _s("telemetry/pod_goodput_mean", "gauge", "fraction",
+       "pod-mean cumulative goodput"),
+    _s("telemetry/straggler_host", "gauge", "host",
+       "process index of the slowest host this interval"),
+    _s("telemetry/step_skew", "gauge", "ratio",
+       "slowest / pod-mean step time (1.0 = balanced pod)"),
+    # -- host tracing (telemetry.trace)
+    _s("telemetry/trace_events", "counter", "events",
+       "trace events emitted since start"),
+    _s("telemetry/trace_dropped", "counter", "events",
+       "trace events evicted from the ring buffer"),
     # -- serving instrument panel (serving.metrics)
     _s("serving/queue_depth", "gauge", "requests",
        "waiting requests", "step"),
@@ -214,10 +234,12 @@ CATALOG: Tuple[MetricSpec, ...] = (
 #: these prefixes is catalog-legal (loss_fn auxiliary metrics surface as
 #: ``train/<k>`` / ``eval/<k>``; the per-layer collector emits
 #: ``train/rms/<param path>``).
-DYNAMIC_PREFIXES: Tuple[str, ...] = ("train/rms/", "train/aux/", "eval/")
+DYNAMIC_PREFIXES: Tuple[str, ...] = ("train/rms/", "train/aux/", "eval/",
+                                     "slo/")
 
 #: Derived suffixes ``latency_summary`` appends to histogram base names.
-HISTOGRAM_SUFFIXES: Tuple[str, ...] = ("p50", "p95", "mean", "count")
+HISTOGRAM_SUFFIXES: Tuple[str, ...] = ("p50", "p95", "p99", "mean",
+                                       "count")
 
 _CATALOG_BY_NAME: Dict[str, MetricSpec] = {s.name: s for s in CATALOG}
 
@@ -329,6 +351,8 @@ class MetricRegistry:
                     f'{pname}{{quantile="0.5"}} {_finite(s["p50"])}')
                 lines.append(
                     f'{pname}{{quantile="0.95"}} {_finite(s["p95"])}')
+                lines.append(
+                    f'{pname}{{quantile="0.99"}} {_finite(s["p99"])}')
                 lines.append(f"{pname}_sum {_finite(inst.total_sum)}")
                 lines.append(f"{pname}_count {inst.total_count}")
             elif isinstance(inst, Gauge):

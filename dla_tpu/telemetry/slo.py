@@ -1,0 +1,181 @@
+"""Rolling-window SLO evaluation with burn-rate alerting.
+
+An SLO here is "metric X stays on the right side of objective O for at
+least (1 - budget) of a rolling window" — e.g. serving TTFT p95 under
+500 ms with a 1% budget over 10 minutes, train goodput above 0.55,
+step time under a ceiling. ``SLOWatch.observe()`` is fed the same
+metric snapshots the log loop already produces; each observation is a
+(timestamp, ok) sample in the SLO's window deque.
+
+**Burn rate** is the SRE meaning: the fraction of the window currently
+in violation divided by the error budget. Burn < 1 means the budget is
+being consumed slower than allotted; burn ≥ 1 means at this rate the
+budget is exhausted within the window — that edge fires an alert. An
+alert is edge-triggered (once per excursion, re-armed when burn drops
+back under 1) and lands in two places: a ``slo_burn`` event in the
+``FlightRecorder`` (plus a ``postmortem_slo_burn.json`` dump, so the
+on-call gets the surrounding event ring) and the ``slo/*`` gauges
+(``slo/<name>_ok``, ``slo/<name>_burn_rate``, ``slo/<name>_alerts``)
+on ``/metrics``.
+
+Declared in config as a top-level ``slo:`` block::
+
+    slo:
+      objectives:
+        - name: step_time
+          metric: telemetry/step_ms
+          objective: 2000.0        # violating when metric > objective
+          kind: max
+          window_s: 600
+          budget: 0.01
+        - name: goodput
+          metric: telemetry/goodput
+          objective: 0.55          # violating when metric < objective
+          kind: min
+
+Stdlib-only; evaluation is O(window samples) per observation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+__all__ = ["SLO", "SLOWatch"]
+
+_SLUG_RE = re.compile(r"[^A-Za-z0-9_]+")
+
+
+def _slug(name: str) -> str:
+    return _SLUG_RE.sub("_", name.strip()).strip("_").lower() or "slo"
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One declared objective over a rolling window."""
+    name: str                 # slug; becomes slo/<name>_* gauge names
+    metric: str               # catalog metric name to watch
+    objective: float          # threshold
+    kind: str = "max"         # "max": violate when value > objective;
+                              # "min": violate when value < objective
+    window_s: float = 600.0   # rolling-window length (seconds)
+    budget: float = 0.01      # allowed violating fraction of the window
+
+    def __post_init__(self):
+        if self.kind not in ("max", "min"):
+            raise ValueError(f"SLO kind must be 'max' or 'min', "
+                             f"got {self.kind!r}")
+        if not (0.0 < self.budget <= 1.0):
+            raise ValueError(f"SLO budget must be in (0, 1], "
+                             f"got {self.budget}")
+
+    def violated(self, value: float) -> bool:
+        return value > self.objective if self.kind == "max" \
+            else value < self.objective
+
+
+class _State:
+    __slots__ = ("samples", "alerts", "alerting")
+
+    def __init__(self):
+        # (timestamp, violated) samples inside the window
+        self.samples: Deque[Tuple[float, bool]] = deque()
+        self.alerts = 0
+        self.alerting = False     # currently over budget (edge-trigger arm)
+
+
+class SLOWatch:
+    """Evaluates declared SLOs against metric snapshots.
+
+    ``observe(values)`` returns the ``slo/*`` gauge dict (and mirrors it
+    into ``registry`` when one is attached — the ``slo/`` dynamic prefix
+    makes the names catalog-legal). Metrics absent from a snapshot are
+    simply not sampled that round, so one watch can hold both train and
+    serving objectives and each process feeds what it has.
+    """
+
+    def __init__(self, slos: List[SLO], registry=None, recorder=None,
+                 now=time.monotonic):
+        self.slos = list(slos)
+        self.registry = registry
+        self.recorder = recorder
+        self.now = now
+        self._state = {s.name: _State() for s in self.slos}
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]], registry=None,
+                    recorder=None) -> Optional["SLOWatch"]:
+        """Build from a config ``slo:`` block; None without objectives."""
+        cfg = dict(cfg or {})
+        rows = cfg.get("objectives") or []
+        slos = []
+        for row in rows:
+            row = dict(row)
+            slos.append(SLO(
+                name=_slug(str(row.get("name") or row["metric"])),
+                metric=str(row["metric"]),
+                objective=float(row["objective"]),
+                kind=str(row.get("kind", "max")),
+                window_s=float(row.get("window_s",
+                                       cfg.get("window_s", 600.0))),
+                budget=float(row.get("budget", cfg.get("budget", 0.01))),
+            ))
+        if not slos:
+            return None
+        return cls(slos, registry=registry, recorder=recorder)
+
+    def burn_rate(self, slo: SLO) -> float:
+        """Violating fraction of the current window over the budget."""
+        st = self._state[slo.name]
+        if not st.samples:
+            return 0.0
+        bad = sum(1 for _, v in st.samples if v)
+        return (bad / len(st.samples)) / slo.budget
+
+    def observe(self, values: Dict[str, float],
+                step: Optional[int] = None) -> Dict[str, float]:
+        """Feed one metric snapshot; returns the ``slo/*`` gauge dict."""
+        t = self.now()
+        out: Dict[str, float] = {}
+        for slo in self.slos:
+            st = self._state[slo.name]
+            if slo.metric in values:
+                value = float(values[slo.metric])
+                st.samples.append((t, slo.violated(value)))
+            else:
+                value = None
+            cutoff = t - slo.window_s
+            while st.samples and st.samples[0][0] < cutoff:
+                st.samples.popleft()
+            burn = self.burn_rate(slo)
+            if burn >= 1.0:
+                if not st.alerting:      # edge: budget just exhausted
+                    st.alerting = True
+                    st.alerts += 1
+                    self._alert(slo, burn, value, step)
+            else:
+                st.alerting = False      # re-arm below the line
+            out[f"slo/{slo.name}_ok"] = 0.0 if st.alerting else 1.0
+            out[f"slo/{slo.name}_burn_rate"] = burn
+            out[f"slo/{slo.name}_alerts"] = float(st.alerts)
+        if self.registry is not None:
+            for name, v in out.items():
+                inst = self.registry._instruments.get(name)
+                if inst is None:     # lazily registered, then reused —
+                    inst = self.registry.gauge(name)   # peaks persist
+                inst.set(v)
+        return out
+
+    def _alert(self, slo: SLO, burn: float, value: Optional[float],
+               step: Optional[int]) -> None:
+        if self.recorder is None:
+            return
+        self.recorder.record(
+            "slo_burn", step=step, slo=slo.name, metric=slo.metric,
+            objective=slo.objective, slo_kind=slo.kind,
+            value=value, burn_rate=burn, budget=slo.budget,
+            window_s=slo.window_s)
+        # the surrounding event ring is the postmortem the on-call wants
+        self.recorder.dump("slo_burn")

@@ -38,6 +38,8 @@ import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from dla_tpu.telemetry.trace import Tracer, get_tracer
+
 #: Segment names a step decomposes into. "other" is derived (wall minus
 #: attributed), never passed to segment().
 SEGMENTS = ("data_wait", "h2d", "compute", "checkpoint_stall", "logging",
@@ -61,9 +63,16 @@ class StepClock:
     baseline, and it is the off-switch for ``logging.telemetry``.
     """
 
-    def __init__(self, enabled: bool = True, now=time.perf_counter):
+    def __init__(self, enabled: bool = True, now=time.perf_counter,
+                 tracer: Optional[Tracer] = None):
         self.enabled = enabled
         self.now = now
+        # trace feed: each segment becomes a slice on the trainer thread,
+        # each step a parent slice + goodput counter sample. The tracer
+        # must share this clock's time base (both default perf_counter);
+        # the default global tracer is disabled, so this is free unless
+        # a trace was configured.
+        self.tracer = tracer if tracer is not None else get_tracer()
         # current-step accumulation
         self._step_start: Optional[float] = None
         self._seg_acc: Dict[str, float] = {}
@@ -93,8 +102,10 @@ class StepClock:
         try:
             yield
         finally:
+            t1 = self.now()
             self._seg_acc[name] = (self._seg_acc.get(name, 0.0)
-                                   + self.now() - t0)
+                                   + t1 - t0)
+            self.tracer.complete(name, t0, t1, cat="step")
 
     def segment(self, name: str):
         """Context manager attributing the enclosed wall time to one
@@ -114,14 +125,16 @@ class StepClock:
             self._ensure_started()
             self._compile_pending = True
 
-    def end_step(self, ok: bool = True) -> None:
+    def end_step(self, ok: bool = True, step: Optional[int] = None) -> None:
         """Close the current step attempt. ``ok=False`` (guard retry,
         injected fault) charges the attempt's entire wall time to
         ``lost["fault"]`` — a failed attempt produced no progress, so
-        none of it is goodput."""
+        none of it is goodput. ``step`` (when the caller knows it) tags
+        the trace slice."""
         if not self.enabled or self._step_start is None:
             return
-        wall = self.now() - self._step_start
+        t_end = self.now()
+        wall = t_end - self._step_start
         seg = dict(self._seg_acc)
         other = max(0.0, wall - sum(seg.values()))
         compute = seg.get("compute", 0.0)
@@ -141,6 +154,16 @@ class StepClock:
             else:
                 self.good_compute += compute
         self._win.append({"wall": wall, "other": other, **seg})
+
+        if self.tracer.enabled:
+            args: Dict[str, object] = {"ok": ok}
+            if step is not None:
+                args["step"] = int(step)
+            if self._compile_pending:
+                args["compile"] = True
+            self.tracer.complete("step", self._step_start, t_end,
+                                 cat="step", args=args)
+            self.tracer.counter("goodput", self.goodput(), t=t_end)
 
         self._step_start = None
         self._seg_acc = {}

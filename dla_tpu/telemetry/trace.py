@@ -1,0 +1,281 @@
+"""Structured host tracing: thread-aware spans exported as Chrome trace
+JSON (load the dump in Perfetto / chrome://tracing).
+
+Scalar metrics answer "how much"; a pod-scale failure usually needs
+"when, on which thread, overlapping what" — one straggler host dragging
+a step, an async-checkpoint write invisibly overlapping compute, a
+single request wedging the continuous-batching engine. ``Tracer`` is
+the timeline those questions read from:
+
+- **Duration spans** (``span()`` context manager, or ``complete()`` for
+  callers that already hold both timestamps, like ``StepClock``): one
+  Chrome ``"X"`` event on the emitting thread. Nesting is positional —
+  a child span's ``[ts, ts+dur]`` sits inside its parent's — so the
+  trainer's ``data_wait``/``h2d``/``compute`` segments render as slices
+  under each ``step``.
+- **Async span trees** (``async_begin``/``async_instant``/``async_end``,
+  Chrome ``"b"``/``"n"``/``"e"`` keyed by ``id``): spans whose begin and
+  end happen on different engine iterations — the serving engine emits
+  one tree per request id (enqueue -> admitted -> first token ->
+  per-decode instants -> finish), so TTFT/ITL are *explained* by the
+  timeline, not just summarized by a histogram.
+- **Counter tracks** (``counter()``, Chrome ``"C"``): goodput and
+  queue-depth style series rendered as area tracks between the slices.
+- **Instants** (``instant()``): point events (faults, alerts).
+
+The buffer is a bounded ring (``deque(maxlen=capacity)``) — a
+week-long serving process keeps the last N events at O(1) append cost
+and ``dropped`` says how much history was evicted. ``record`` paths are
+safe from any thread (one deque append under the GIL). Timestamps come
+from one ``now()`` clock (default ``time.perf_counter``) shared with
+the producers, so engine-recorded request times (``arrival_time``,
+token emit times) can be passed straight in via ``t=`` and the trace
+durations agree exactly with the recorded TTFT/ITL metrics.
+
+Every emit path checks ``enabled`` first and returns before doing ANY
+work — a disabled tracer costs one attribute read per call site, which
+is the off-switch contract ``tests/test_trace.py`` pins by making the
+internal ``_push`` raise.
+
+A process-wide tracer (``install_tracer`` / ``get_tracer``) lets
+producers that are not handed an instance (``utils.profiling.annotate``,
+``step_annotation``) mirror into the active timeline; the default
+global tracer is disabled, so library code calls it unconditionally.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Tracer", "get_tracer", "install_tracer",
+]
+
+
+def _sanitize(v: Any) -> Any:
+    """Trace dumps are strict JSON (Perfetto's parser is): non-finite
+    floats become None rather than bare NaN/Infinity tokens."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
+
+
+class _NullSpan:
+    """Shared no-op context manager for disabled tracers."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Live duration span: times itself and emits one "X" event on exit."""
+    __slots__ = ("tracer", "name", "cat", "args", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: Optional[str],
+                 args: Optional[Dict[str, Any]]):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self.t0 = self.tracer.now()
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer.complete(self.name, self.t0, self.tracer.now(),
+                             cat=self.cat, args=self.args)
+        return False
+
+
+class Tracer:
+    """Bounded ring of Chrome-trace events + the export/dump path.
+
+    ``now`` must be the same clock the producers time with (default
+    ``time.perf_counter``) — timestamps passed via ``t=`` are raw clock
+    readings, converted against the tracer's construction-time origin.
+    """
+
+    def __init__(self, enabled: bool = True, capacity: int = 65536,
+                 now=time.perf_counter, path: Optional[str] = None):
+        self.enabled = enabled
+        self.now = now
+        self.path = path
+        self.capacity = int(capacity)
+        self.events: deque = deque(maxlen=self.capacity)
+        self.emitted = 0           # total ever pushed (ring may evict)
+        self._t0 = now()
+        self._pid = 0              # one trace per process; 0 keeps dumps
+        self._threads: Dict[int, str] = {}        # tid -> thread name
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]],
+                    default_dir: Optional[str] = None) -> "Tracer":
+        """Build from a ``logging.telemetry.trace:`` block. ``None`` (no
+        block) or ``enabled: false`` gives a disabled tracer — every
+        producer can hold one unconditionally."""
+        cfg = dict(cfg or {})
+        enabled = bool(cfg.get("enabled", False))
+        path = cfg.get("path")
+        if path is None and default_dir:
+            path = str(Path(default_dir) / "trace.json")
+        return cls(enabled=enabled,
+                   capacity=int(cfg.get("capacity", 65536)), path=path)
+
+    # -------------------------------------------------------------- recording
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted from the ring (emitted minus retained)."""
+        return max(0, self.emitted - len(self.events))
+
+    def _ts(self, t: Optional[float]) -> float:
+        """Raw clock reading -> microseconds since tracer start."""
+        return ((self.now() if t is None else t) - self._t0) * 1e6
+
+    def _push(self, evt: Dict[str, Any]) -> None:
+        tid = threading.get_ident()
+        if tid not in self._threads:
+            self._threads[tid] = threading.current_thread().name
+        evt["pid"] = self._pid
+        evt["tid"] = tid
+        self.events.append(evt)    # atomic under the GIL: thread-safe
+        self.emitted += 1
+
+    def span(self, name: str, cat: Optional[str] = None, **args):
+        """Duration-span context manager on the calling thread."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args or None)
+
+    def complete(self, name: str, t_start: float, t_end: float,
+                 cat: Optional[str] = None,
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        """Emit a finished span from two raw clock readings — for
+        producers (StepClock) that already timed the region."""
+        if not self.enabled:
+            return
+        evt: Dict[str, Any] = {
+            "name": name, "ph": "X", "ts": self._ts(t_start),
+            "dur": max(0.0, (t_end - t_start) * 1e6)}
+        if cat:
+            evt["cat"] = cat
+        if args:
+            evt["args"] = {k: _sanitize(v) for k, v in args.items()}
+        self._push(evt)
+
+    def instant(self, name: str, t: Optional[float] = None,
+                cat: Optional[str] = None, **args) -> None:
+        if not self.enabled:
+            return
+        evt: Dict[str, Any] = {"name": name, "ph": "i",
+                               "ts": self._ts(t), "s": "t"}
+        if cat:
+            evt["cat"] = cat
+        if args:
+            evt["args"] = {k: _sanitize(v) for k, v in args.items()}
+        self._push(evt)
+
+    def counter(self, name: str, value: float,
+                t: Optional[float] = None) -> None:
+        """One sample on a counter track (rendered as an area series)."""
+        if not self.enabled:
+            return
+        self._push({"name": name, "ph": "C", "ts": self._ts(t),
+                    "args": {"value": _sanitize(float(value))}})
+
+    # ---------------------------------------------------- async span trees
+
+    def _async(self, ph: str, cat: str, name: str, aid: int,
+               t: Optional[float], args: Optional[Dict[str, Any]]) -> None:
+        evt: Dict[str, Any] = {"name": name, "ph": ph, "cat": cat,
+                               "id": int(aid), "ts": self._ts(t)}
+        if args:
+            evt["args"] = {k: _sanitize(v) for k, v in args.items()}
+        self._push(evt)
+
+    def async_begin(self, cat: str, name: str, aid: int,
+                    t: Optional[float] = None, **args) -> None:
+        """Open one async span (Chrome ``"b"``) keyed by ``(cat, id)`` —
+        the serving engine opens one per request id at arrival."""
+        if not self.enabled:
+            return
+        self._async("b", cat, name, aid, t, args or None)
+
+    def async_instant(self, cat: str, name: str, aid: int,
+                      t: Optional[float] = None, **args) -> None:
+        if not self.enabled:
+            return
+        self._async("n", cat, name, aid, t, args or None)
+
+    def async_end(self, cat: str, name: str, aid: int,
+                  t: Optional[float] = None, **args) -> None:
+        if not self.enabled:
+            return
+        self._async("e", cat, name, aid, t, args or None)
+
+    # ----------------------------------------------------------- exporting
+
+    def export(self) -> Dict[str, Any]:
+        """Chrome trace object: metadata (process/thread names) + the
+        retained event ring. Valid input for Perfetto and
+        chrome://tracing."""
+        meta: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": self._pid,
+            "args": {"name": "dla_tpu"}}]
+        for tid, tname in sorted(self._threads.items()):
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": self._pid, "tid": tid,
+                         "args": {"name": tname}})
+        return {"traceEvents": meta + list(self.events),
+                "displayTimeUnit": "ms",
+                "otherData": {"emitted": self.emitted,
+                              "dropped": self.dropped}}
+
+    def dump(self, path: Optional[str] = None) -> Optional[Path]:
+        """Write the trace JSON; returns the path, or None if there is
+        nowhere to write (or the write failed — dump runs on exit paths
+        and must never raise)."""
+        target = Path(path) if path else (Path(self.path) if self.path
+                                          else None)
+        if target is None:
+            return None
+        try:
+            target.parent.mkdir(parents=True, exist_ok=True)
+            tmp = target.with_suffix(target.suffix + ".tmp")
+            tmp.write_text(json.dumps(self.export(), allow_nan=False))
+            tmp.replace(target)    # atomic: no truncated trace files
+            return target
+        except OSError:
+            return None
+
+
+#: Process-wide tracer for producers not handed an instance
+#: (profiling.annotate / step_annotation). Disabled by default.
+_NULL_TRACER = Tracer(enabled=False, capacity=1)
+_GLOBAL: Tracer = _NULL_TRACER
+
+
+def get_tracer() -> Tracer:
+    return _GLOBAL
+
+
+def install_tracer(tracer: Optional[Tracer]) -> Tracer:
+    """Make ``tracer`` the process-wide tracer (None restores the
+    disabled default). Last install wins — a trainer and a serving
+    engine installing the same tracer share one timeline."""
+    global _GLOBAL
+    _GLOBAL = tracer if tracer is not None else _NULL_TRACER
+    return _GLOBAL

@@ -60,9 +60,14 @@ from dla_tpu.telemetry import (
     Gauge,
     MFUCalculator,
     MetricRegistry,
+    PodAggregator,
+    ReadinessProbe,
+    SLOWatch,
     StepClock,
+    Tracer,
     capture as telemetry_capture,
     collect_train_scalars,
+    install_tracer,
 )
 from dla_tpu.training.optim import build_optimizer
 from dla_tpu.training.utils import StepTimer, check_batch_identity
@@ -163,8 +168,19 @@ class Trainer:
         # resilience objects so they can record into the flight recorder.
         tel_cfg = dict(log_cfg.get("telemetry", {}) or {})
         tel_enabled = bool(tel_cfg.get("enabled", True))
-        self.clock = StepClock(enabled=tel_enabled)
         ckpt_dir = log_cfg.get("output_dir", "checkpoints/run")
+        # host tracer (logging.telemetry.trace:): disabled by default —
+        # a disabled tracer's emit paths return before doing any work.
+        # Installed process-wide so annotate/step_annotation mirror in.
+        self.tracer = Tracer.from_config(
+            tel_cfg.get("trace"),
+            default_dir=log_cfg.get("log_dir") or ckpt_dir)
+        if self.tracer.enabled:
+            install_tracer(self.tracer)
+        self.clock = StepClock(enabled=tel_enabled, tracer=self.tracer)
+        # pod-wide aggregation (one tiny collective per log interval;
+        # single-process it degenerates to a local [1, k] row)
+        self.pod_agg = PodAggregator.from_config(tel_cfg.get("aggregate"))
         self.recorder = FlightRecorder(
             capacity=int(tel_cfg.get("flight_recorder_capacity", 256)),
             out_dir=log_cfg.get("log_dir") or ckpt_dir)
@@ -186,7 +202,7 @@ class Trainer:
                 max_retries=self.resilience.save_retries,
                 backoff_s=self.resilience.retry_backoff_s,
                 faults=self.resilience.fault_plan,
-                recorder=self.recorder)
+                recorder=self.recorder, tracer=self.tracer)
         else:
             self.checkpointer = Checkpointer(ckpt_dir, keep_last_n=keep_n)
         swept = self.checkpointer.sweep_stale_tmp()
@@ -202,13 +218,23 @@ class Trainer:
                                   recorder=self.recorder)
                          if self.resilience.watchdog_enabled else None)
         self._register_func_gauges()
+        # SLO watch on the same payloads the log loop emits (top-level
+        # slo: config block; None without declared objectives)
+        self.slo = SLOWatch.from_config(
+            config.get("slo"), registry=self.registry,
+            recorder=self.recorder)
+        # readiness heartbeat behind /healthz: beaten once per completed
+        # step, goes 503 past the staleness threshold
+        self.readiness = ReadinessProbe(
+            threshold_s=float(tel_cfg.get("readiness_timeout_s", 600.0)))
         # optional Prometheus scrape endpoint on the trainer's registry
         self.metrics_server = None
         if tel_cfg.get("metrics_port") is not None \
                 and jax.process_index() == 0:
             from dla_tpu.telemetry import MetricsHTTPServer
             self.metrics_server = MetricsHTTPServer(
-                self.registry, port=int(tel_cfg["metrics_port"]))
+                self.registry, port=int(tel_cfg["metrics_port"]),
+                readiness=self.readiness)
         # trace-time counter (the function body runs once per XLA compile)
         # — how tests pin "the guard adds zero extra train-step compiles"
         self.train_step_compiles = 0
@@ -243,6 +269,8 @@ class Trainer:
                      lambda: self.guard.rollbacks)
         r.func_gauge("resilience/preemptions_requested",
                      lambda: self.preemption.requests_total)
+        r.func_gauge("telemetry/trace_events", lambda: self.tracer.emitted)
+        r.func_gauge("telemetry/trace_dropped", lambda: self.tracer.dropped)
 
     def _registry_update(self, payload: Dict[str, Any]) -> None:
         """Mirror a log payload into the registry (gauges, lazily
@@ -446,10 +474,11 @@ class Trainer:
                   ) -> Tuple[float, Dict[str, float]]:
         while True:
             loss, metrics, ok = self._execute_step(batch, rng)
-            self.clock.end_step(ok=ok)
+            self.clock.end_step(ok=ok, step=self.step)
             if ok:
                 self.guard.on_step(True, loss)
                 self.step += 1
+                self.readiness.beat()
                 self.recorder.record("step_end", step=self.step,
                                      loss=float(loss))
                 return loss, {k: float(v) for k, v in metrics.items()}
@@ -517,7 +546,8 @@ class Trainer:
         wrapper = None
         if prefetch_n > 0 and not isinstance(train_iter, PrefetchIterator) \
                 and hasattr(train_iter, "state_dict"):
-            wrapper = PrefetchIterator(train_iter, prefetch_n)
+            wrapper = PrefetchIterator(train_iter, prefetch_n,
+                                       tracer=self.tracer)
             train_iter = wrapper
             data_state = wrapper.state_dict
 
@@ -562,6 +592,7 @@ class Trainer:
                 self.guard.on_step(True, loss)
                 held = None
                 self.step += 1
+                self.readiness.beat()
                 timer.tick(n_tokens)
                 running.update(loss)
                 self.recorder.record("step_end", step=self.step,
@@ -581,6 +612,16 @@ class Trainer:
                         payload.update(self.clock.interval_metrics())
                         payload["telemetry/mfu"] = self.mfu_calc.mfu(
                             payload.get("tokens_per_sec_per_chip"))
+                        # pod view: one tiny allgather per interval (a
+                        # rendezvous — every host reaches this at the
+                        # same step); host 0 gets the pod-wide gauges
+                        if "telemetry/step_ms" in payload:
+                            payload.update(self.pod_agg.update(
+                                payload["telemetry/step_ms"],
+                                payload.get("telemetry/goodput", 0.0)))
+                        if self.slo is not None:
+                            payload.update(self.slo.observe(
+                                payload, step=self.step))
                         self._registry_update(payload)
                         self.logger.log(payload, self.step)
                         log_rank_zero(
@@ -598,10 +639,12 @@ class Trainer:
                     with self.clock.segment("checkpoint_stall"):
                         self.save(data_state() if data_state else None,
                                   extra_aux)
-                self.clock.end_step(ok=True)
+                self.clock.end_step(ok=True, step=self.step)
         finally:
             # a failed step must not lose an already-open trace window
             self.profile.close()
+            if self.tracer.enabled:
+                self.tracer.dump()
             if self.watchdog is not None:
                 self.watchdog.stop()
             if self.resilience.preemption:

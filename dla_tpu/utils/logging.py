@@ -102,13 +102,16 @@ def percentile(values, q: float) -> float:
 
 
 def latency_summary(values, prefix: str = "") -> Dict[str, float]:
-    """{p50, p95, mean, count} of a latency sample list, keys optionally
-    prefixed ("ttft_ms_" -> ttft_ms_p50, ...)."""
+    """{p50, p95, p99, mean, count} of a latency sample list, keys
+    optionally prefixed ("ttft_ms_" -> ttft_ms_p50, ...). The suffix set
+    mirrors telemetry.registry.HISTOGRAM_SUFFIXES — a new quantile here
+    must be declared there too or strict registration rejects it."""
     xs = [float(v) for v in values]
     mean = sum(xs) / len(xs) if xs else 0.0
     return {
         f"{prefix}p50": percentile(xs, 50.0),
         f"{prefix}p95": percentile(xs, 95.0),
+        f"{prefix}p99": percentile(xs, 99.0),
         f"{prefix}mean": mean,
         f"{prefix}count": float(len(xs)),
     }

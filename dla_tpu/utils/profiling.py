@@ -111,15 +111,26 @@ class ProfileWindow:
         self._done = True
 
 
+@contextlib.contextmanager
 def step_annotation(step: int, name: str = "train"):
     """Per-step trace annotation; no-op cost when no trace is active.
     ``name`` distinguishes loops sharing a trace ("train" vs the serving
-    engine's "serve")."""
-    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+    engine's "serve"). Mirrors into the host tracer (telemetry.trace)
+    under the same name, so the host timeline lines up with XLA profiler
+    step windows — the span name is the constant ``<name>_step`` (one
+    Perfetto track row per loop) with the step number in args."""
+    from dla_tpu.telemetry.trace import get_tracer
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        with get_tracer().span(f"{name}_step", cat=name, step=int(step)):
+            yield
 
 
 @contextlib.contextmanager
 def annotate(name: str):
-    """Named region for traces (host-side; device ops inside still fuse)."""
+    """Named region for traces (host-side; device ops inside still fuse).
+    Mirrored into the host tracer so a region shows up both in the XLA
+    profile and the Chrome-trace dump."""
+    from dla_tpu.telemetry.trace import get_tracer
     with jax.profiler.TraceAnnotation(name):
-        yield
+        with get_tracer().span(name, cat="annotate"):
+            yield

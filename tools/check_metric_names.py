@@ -23,6 +23,7 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from dla_tpu.telemetry.registry import (  # noqa: E402
+    DYNAMIC_PREFIXES,
     catalog_names,
     is_catalog_name,
 )
@@ -31,7 +32,7 @@ from dla_tpu.telemetry.registry import (  # noqa: E402
 #: marks a prefix literal (f-string stem like "serving/ttft_ms_" or
 #: "train/" + key) — validated as a prefix of catalog names.
 _LITERAL_RE = re.compile(
-    r"""["'](?P<name>(?:train|eval|serving|telemetry|resilience)
+    r"""["'](?P<name>(?:train|eval|serving|telemetry|resilience|slo)
         /[A-Za-z0-9_/]*)""", re.VERBOSE)
 
 #: Files whose job is to *declare* names, not emit them.
@@ -40,7 +41,12 @@ _SKIP = {"dla_tpu/telemetry/registry.py"}
 
 def _prefix_ok(literal: str) -> bool:
     stem = literal.rstrip("_/")
-    return any(n.startswith(stem) for n in catalog_names())
+    if any(n.startswith(stem) for n in catalog_names()):
+        return True
+    # f-string stems of dynamic families ("slo/" + name, "train/rms/" +
+    # path) are legal: any completion of them passes is_catalog_name
+    return any(p.rstrip("/").startswith(stem) or literal.startswith(p)
+               for p in DYNAMIC_PREFIXES)
 
 
 def scan_file(path: Path, rel: str):
@@ -58,7 +64,9 @@ def scan_file(path: Path, rel: str):
 
 
 def run(repo: Path = REPO) -> int:
-    files = sorted((repo / "dla_tpu").rglob("*.py")) + [repo / "bench.py"]
+    files = (sorted((repo / "dla_tpu").rglob("*.py"))
+             + sorted((repo / "tools").glob("*.py"))
+             + [repo / "bench.py"])
     bad = []
     for f in files:
         rel = f.relative_to(repo).as_posix()

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Diff two metric snapshots with per-metric tolerance; exit nonzero on
+regression — the CI gate for the BENCH_* trajectory.
+
+Inputs (each side independently auto-detected by content):
+
+- a ``bench.py`` JSON snapshot (one object, possibly nested — nested
+  dicts flatten to "/"-joined keys, numeric leaves only), or
+- a Prometheus text dump (``curl :port/metrics > dump.txt``), parsed by
+  the same strict ``parse_prometheus_text`` the telemetry round-trip
+  test uses (labeled series get a ``{k="v"}`` key suffix).
+
+A metric's *direction* decides what counts as a regression: lower is
+better for latencies/stalls (``*_ms``, ``*latency*``, ``*stall*``,
+``badput*``, ``*overhead*``, ``*wait*``), higher is better for rates
+(``*tokens_per_sec*``, ``*goodput*``, ``*mfu*``, ``*throughput*``,
+``*samples_per_sec*``, ``*_per_second*``). Unclassified metrics are
+informational: reported when they move, never a failure — a diff tool
+that guesses directions for unknown names produces false alarms, not
+protection.
+
+Usage::
+
+    python tools/metrics_diff.py BASELINE.json CANDIDATE.json
+    python tools/metrics_diff.py old_metrics.txt new_metrics.txt \\
+        --tolerance 0.05 --tolerance-for dla_serving_ttft_ms=0.20 \\
+        --require-common
+
+Exit codes: 0 clean, 1 regression(s), 2 usage/input error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dla_tpu.telemetry.registry import parse_prometheus_text  # noqa: E402
+
+LOWER_IS_BETTER = ("_ms", "latency", "stall", "badput", "overhead",
+                   "wait")
+HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
+                    "samples_per_sec", "_per_second")
+
+
+def direction(name: str) -> int:
+    """-1 lower-better, +1 higher-better, 0 unknown (informational).
+    Substring heuristics over the flattened key; higher-better wins a
+    tie ("goodput_stall" is hypothetical, rates are not)."""
+    low = name.lower()
+    if any(tok in low for tok in HIGHER_IS_BETTER):
+        return 1
+    if any(tok in low for tok in LOWER_IS_BETTER):
+        return -1
+    return 0
+
+
+def _flatten(obj, prefix: str, out: Dict[str, float]) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(v, f"{prefix}{k}/", out)
+    elif isinstance(obj, bool):
+        out[prefix.rstrip("/")] = float(obj)
+    elif isinstance(obj, (int, float)):
+        out[prefix.rstrip("/")] = float(obj)
+    # strings/lists: not comparable metrics — dropped
+
+
+def load_snapshot(path: Path) -> Dict[str, float]:
+    """Auto-detect bench JSON vs Prometheus text by leading character."""
+    text = path.read_text()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        out: Dict[str, float] = {}
+        _flatten(json.loads(text), "", out)
+        return out
+    flat: Dict[str, float] = {}
+    for (name, labels), value in parse_prometheus_text(text).items():
+        key = name
+        if labels:
+            key += "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+        flat[key] = value
+    return flat
+
+
+def parse_overrides(pairs) -> Dict[str, float]:
+    out = {}
+    for pair in pairs or ():
+        name, _, tol = pair.rpartition("=")
+        if not name:
+            raise ValueError(
+                f"--tolerance-for wants NAME=FRACTION, got {pair!r}")
+        out[name] = float(tol)
+    return out
+
+
+def compare(base: Dict[str, float], cand: Dict[str, float],
+            tolerance: float, overrides: Dict[str, float]
+            ) -> Tuple[list, list, list]:
+    """-> (regressions, improvements, moved-but-unclassified) rows of
+    (name, base, cand, rel_change, tol)."""
+    regressions, improvements, moved = [], [], []
+    for name in sorted(set(base) & set(cand)):
+        b, c = base[name], cand[name]
+        tol = overrides.get(name, tolerance)
+        denom = abs(b) if b != 0 else 1.0       # new-from-zero: absolute
+        rel = (c - b) / denom
+        if abs(rel) <= tol:
+            continue
+        row = (name, b, c, rel, tol)
+        d = direction(name)
+        if d == 0:
+            moved.append(row)
+        elif rel * d < 0:       # moved against its good direction
+            regressions.append(row)
+        else:
+            improvements.append(row)
+    return regressions, improvements, moved
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("baseline", type=Path)
+    ap.add_argument("candidate", type=Path)
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="default allowed relative change (default 5%%)")
+    ap.add_argument("--tolerance-for", action="append", default=[],
+                    metavar="NAME=FRACTION",
+                    help="per-metric override, repeatable "
+                         "(e.g. dla_serving_ttft_ms=0.20)")
+    ap.add_argument("--require-common", action="store_true",
+                    help="also fail when the two snapshots share no "
+                         "metric names (a renamed catalog would "
+                         "otherwise diff as trivially clean)")
+    args = ap.parse_args(argv)
+
+    try:
+        base = load_snapshot(args.baseline)
+        cand = load_snapshot(args.candidate)
+        overrides = parse_overrides(args.tolerance_for)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"metrics_diff: {exc}", file=sys.stderr)
+        return 2
+
+    common = set(base) & set(cand)
+    if not common:
+        msg = "metrics_diff: no common metric names between snapshots"
+        if args.require_common:
+            print(msg, file=sys.stderr)
+            return 1
+        print(msg + " (nothing compared)")
+        return 0
+
+    regressions, improvements, moved = compare(
+        base, cand, args.tolerance, overrides)
+
+    def show(rows, label):
+        for name, b, c, rel, tol in rows:
+            print(f"  [{label}] {name}: {b:g} -> {c:g} "
+                  f"({rel:+.1%}, tol {tol:.0%})")
+
+    if regressions:
+        print(f"metrics_diff: {len(regressions)} regression(s) over "
+              f"{len(common)} common metrics:")
+        show(regressions, "REGRESSION")
+    if improvements:
+        show(improvements, "improved")
+    if moved:
+        show(moved, "moved")
+    if not regressions:
+        print(f"metrics_diff: OK ({len(common)} common metrics, "
+              f"{len(improvements)} improved, {len(moved)} moved "
+              f"outside tolerance without a known direction)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
