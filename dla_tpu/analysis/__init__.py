@@ -1,0 +1,42 @@
+"""dla-lint: JAX/TPU-aware static analysis for this repo's compile and
+dispatch invariants.
+
+The test suite pins these invariants dynamically (``train_step_compiles
+== 1``, zero-extra-compile collectors, the one-D2H-per-decode-step
+serving loop); this package enforces them at review time, before a
+v5e-256 run burns three minutes discovering a retrace. See
+``docs/ANALYSIS.md`` for the rule catalog and suppression syntax, and
+``tools/dla_lint.py`` for the CLI (``python -m tools.dla_lint``).
+
+Public API::
+
+    from dla_tpu.analysis import run_lint, all_rules
+    result = run_lint(["dla_tpu", "tools", "bench.py", "config"])
+    result.active       # unsuppressed findings -> fail the build
+"""
+from dla_tpu.analysis.core import (
+    Finding,
+    LintResult,
+    Project,
+    Rule,
+    all_rules,
+    collect_files,
+    register,
+    run_lint,
+)
+from dla_tpu.analysis.report import (
+    SCHEMA_ID,
+    build_report,
+    dump_report,
+    finding_row,
+    lint_json_report,
+    lint_text_report,
+    validate_report,
+)
+
+__all__ = [
+    "Finding", "LintResult", "Project", "Rule", "all_rules",
+    "collect_files", "register", "run_lint", "SCHEMA_ID", "build_report",
+    "dump_report", "finding_row", "lint_json_report", "lint_text_report",
+    "validate_report",
+]

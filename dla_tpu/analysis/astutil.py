@@ -1,0 +1,280 @@
+"""Shared AST helpers: import resolution, dotted-name printing, and
+jit-site discovery.
+
+Everything here is deliberately *syntactic*. A linter that imported the
+modules it checks would need a working JAX at lint time and would
+execute arbitrary code on import; instead we resolve names through the
+file's own ``import`` statements, which is exact for the idioms this
+repo actually uses (``import jax``, ``import jax.numpy as jnp``,
+``from functools import partial``, ...).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class ImportMap:
+    """Local-name -> canonical dotted path for one module.
+
+    ``import jax.numpy as jnp``       -> modules["jnp"] = "jax.numpy"
+    ``import numpy``                  -> modules["numpy"] = "numpy"
+    ``from time import time as now``  -> symbols["now"] = "time.time"
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.modules: Dict[str, str] = {}
+        self.symbols: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.modules[local] = (alias.name if alias.asname
+                                           else alias.name.split(".")[0])
+                    if alias.asname is None and "." in alias.name:
+                        # "import jax.numpy" also binds the root "jax";
+                        # remember the full path for submodule lookups
+                        self.modules.setdefault(alias.name.split(".")[0],
+                                                alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.symbols[local] = f"{node.module}.{alias.name}"
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a call target, resolving the leading
+        name through this module's imports. ``jnp.zeros`` ->
+        ``jax.numpy.zeros``; a from-imported ``partial`` ->
+        ``functools.partial``; unresolvable -> the raw dotted text."""
+        raw = dotted(node)
+        if raw is None:
+            return None
+        head, _, rest = raw.partition(".")
+        if head in self.symbols:
+            base = self.symbols[head]
+        elif head in self.modules:
+            base = self.modules[head]
+        else:
+            return raw
+        return f"{base}.{rest}" if rest else base
+
+
+#: Canonical callables that produce a jit-compiled function.
+JIT_WRAPPERS = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+PARTIAL_WRAPPERS = ("functools.partial", "partial")
+
+
+def _const_tuple(node: Optional[ast.AST]) -> Tuple:
+    """Literal int/str tuple value of a keyword arg, else ()."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One function whose body runs under jax tracing.
+
+    ``fn`` is the FunctionDef being traced; ``call`` is the jit() call
+    or decorator node (where static/donate kwargs live); ``bound`` is
+    True when the target was ``self.method`` (so argnums skip self).
+    """
+    fn: ast.FunctionDef
+    call: Optional[ast.Call]
+    bound: bool
+    static_names: Set[str]
+    donate_positions: Tuple[int, ...]
+
+    def traced_params(self) -> List[str]:
+        args = self.fn.args
+        names = [a.arg for a in args.posonlyargs + args.args
+                 + args.kwonlyargs]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        return [n for n in names if n not in self.static_names]
+
+
+def _keyword(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _static_and_donate(call: Optional[ast.Call], fn: ast.FunctionDef,
+                       bound: bool) -> Tuple[Set[str], Tuple[int, ...]]:
+    """Resolve static_argnums/static_argnames/donate_argnums of a jit
+    call against the target function's positional parameter list."""
+    args = fn.args
+    pos = [a.arg for a in args.posonlyargs + args.args]
+    offset = 0
+    if pos and pos[0] in ("self", "cls"):
+        if bound:
+            pos = pos[1:]          # indices count from after self
+        else:
+            offset = 0             # decorated method: index 0 IS self
+    static: Set[str] = set()
+    donate: Tuple[int, ...] = ()
+    if call is not None:
+        for v in _const_tuple(_keyword(call, "static_argnames")):
+            if isinstance(v, str):
+                static.add(v)
+        for v in _const_tuple(_keyword(call, "static_argnums")):
+            if isinstance(v, int) and 0 <= v + offset < len(pos):
+                static.add(pos[v + offset])
+        donate = tuple(v for v in _const_tuple(_keyword(call, "donate_argnums"))
+                       if isinstance(v, int))
+    return static, donate
+
+
+def _jit_call_parts(node: ast.AST, imports: ImportMap
+                    ) -> Optional[Tuple[Optional[ast.Call], Optional[ast.AST]]]:
+    """Recognize a jit-producing expression.
+
+    Returns ``(kwargs_call, target_expr)`` where ``target_expr`` is the
+    function being jitted (None for bare-decorator forms):
+
+      jax.jit                     -> (None, None)           [decorator]
+      jax.jit(f, **kw)            -> (call, f)
+      partial(jax.jit, **kw)      -> (call, None)           [decorator]
+      partial(jax.jit, **kw)(f)   -> handled by outer call case
+    """
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        if imports.canonical(node) in JIT_WRAPPERS:
+            return (None, None)
+        return None
+    if not isinstance(node, ast.Call):
+        return None
+    canon = imports.canonical(node.func)
+    if canon in JIT_WRAPPERS:
+        target = node.args[0] if node.args else None
+        return (node, target)
+    if canon in PARTIAL_WRAPPERS and node.args:
+        first = imports.canonical(node.args[0])
+        if first in JIT_WRAPPERS:
+            return (node, node.args[1] if len(node.args) > 1 else None)
+    return None
+
+
+def find_jit_sites(tree: ast.AST, imports: Optional[ImportMap] = None
+                   ) -> List[JitSite]:
+    """All functions in a module whose bodies run under jax tracing:
+    decorated defs, ``x = jax.jit(local_fn, ...)`` and
+    ``jax.jit(self.method, ...)`` forms."""
+    imports = imports or ImportMap(tree)
+    sites: List[JitSite] = []
+    seen: Set[ast.FunctionDef] = set()
+
+    # function defs indexed by enclosing scope for target resolution
+    class _Scope(ast.NodeVisitor):
+        def __init__(self):
+            self.class_methods: Dict[str, Dict[str, ast.FunctionDef]] = {}
+            self.local_fns: List[Tuple[ast.AST, ast.FunctionDef]] = []
+            self._class: List[str] = []
+
+        def visit_ClassDef(self, node):
+            self.class_methods.setdefault(node.name, {})
+            self._class.append(node.name)
+            for child in node.body:
+                if isinstance(child, ast.FunctionDef):
+                    self.class_methods[node.name][child.name] = child
+            self.generic_visit(node)
+            self._class.pop()
+
+        def visit_FunctionDef(self, node):
+            self.local_fns.append((node, node))
+            self.generic_visit(node)
+
+    scope = _Scope()
+    scope.visit(tree)
+    fn_by_name: Dict[str, ast.FunctionDef] = {}
+    for _, fn in scope.local_fns:
+        fn_by_name.setdefault(fn.name, fn)
+    method_owner: Dict[str, List[ast.FunctionDef]] = {}
+    for methods in scope.class_methods.values():
+        for name, fn in methods.items():
+            method_owner.setdefault(name, []).append(fn)
+
+    def add(fn: ast.FunctionDef, call: Optional[ast.Call], bound: bool):
+        if fn in seen:
+            return
+        seen.add(fn)
+        static, donate = _static_and_donate(call, fn, bound)
+        sites.append(JitSite(fn=fn, call=call, bound=bound,
+                             static_names=static,
+                             donate_positions=donate))
+
+    # 1) decorated functions
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            parts = _jit_call_parts(dec, imports)
+            if parts is not None:
+                add(node, parts[0], bound=False)
+
+    # 2) jit(<target>) call expressions anywhere
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        parts = _jit_call_parts(node, imports)
+        if parts is None or parts[1] is None:
+            continue
+        call, target = parts
+        if isinstance(target, ast.Name) and target.id in fn_by_name:
+            add(fn_by_name[target.id], call, bound=False)
+        elif (isinstance(target, ast.Attribute)
+              and isinstance(target.value, ast.Name)
+              and target.value.id == "self"):
+            for fn in method_owner.get(target.attr, [])[:1]:
+                add(fn, call, bound=True)
+    return sites
+
+
+def local_names(fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names plus every Name ever stored in the function body
+    (including nested scopes) — the complement is the free names."""
+    out: Set[str] = set()
+    a = fn.args
+    for arg in a.posonlyargs + a.args + a.kwonlyargs:
+        out.add(arg.arg)
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def call_args(call: ast.Call) -> Sequence[ast.AST]:
+    return call.args

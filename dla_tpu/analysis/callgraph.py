@@ -1,0 +1,175 @@
+"""Approximate whole-project call graph for reachability rules.
+
+This is a *lint-grade* call graph: name-based, no type inference, built
+once per run from the ASTs the project already parsed. Resolution order
+for a call site inside ``mod::scope``:
+
+1. ``f(...)``        -> ``mod::f`` if defined, else the def an
+   ``from x import f`` points at (when ``x`` is an analyzed module)
+2. ``self.m(...)``   -> ``mod::Class.m`` of the enclosing class
+3. ``mod2.f(...)``   -> ``mod2::f`` when ``mod2`` is an analyzed module
+   imported by this file
+4. ``obj.m(...)``    -> the single ``Class.m`` defined anywhere in the
+   project, but only when exactly one class defines ``m`` — ambiguous
+   method names produce no edge rather than a wrong one
+
+Nested function bodies are merged into their enclosing def: a helper
+defined inside a hot function is almost always called there, and the
+merge also keeps lambda/closure sync sites attributed to the function
+the reader is looking at.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dla_tpu.analysis.astutil import ImportMap
+from dla_tpu.analysis.core import Project, SourceFile
+
+
+@dataclasses.dataclass
+class FuncDef:
+    qualname: str                 # "path.py::Class.method" / "path.py::fn"
+    rel: str
+    cls: Optional[str]
+    name: str
+    node: ast.FunctionDef
+
+
+def _module_name(rel: str) -> str:
+    """'dla_tpu/serving/server.py' -> 'dla_tpu.serving.server'."""
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.defs: Dict[str, FuncDef] = {}
+        self.edges: Dict[str, Set[str]] = {}
+        self._by_module: Dict[str, Dict[str, str]] = {}    # mod -> fn -> qn
+        self._methods: Dict[str, List[str]] = {}           # name -> [qn]
+        self._rel_by_module: Dict[str, str] = {}
+        for sf in project.py_files():
+            self._rel_by_module[_module_name(sf.rel)] = sf.rel
+        for sf in project.py_files():
+            self._index_defs(sf)
+        for sf in project.py_files():
+            self._index_edges(sf)
+
+    # ------------------------------------------------------------ index
+
+    def _index_defs(self, sf: SourceFile) -> None:
+        mod = _module_name(sf.rel)
+        table = self._by_module.setdefault(mod, {})
+        for node in sf.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                qn = f"{sf.rel}::{node.name}"
+                self.defs[qn] = FuncDef(qn, sf.rel, None, node.name, node)
+                table[node.name] = qn
+            elif isinstance(node, ast.ClassDef):
+                for child in node.body:
+                    if isinstance(child, ast.FunctionDef):
+                        qn = f"{sf.rel}::{node.name}.{child.name}"
+                        self.defs[qn] = FuncDef(qn, sf.rel, node.name,
+                                                child.name, child)
+                        self._methods.setdefault(child.name, []).append(qn)
+                        table.setdefault(child.name, qn)
+
+    def _index_edges(self, sf: SourceFile) -> None:
+        mod = _module_name(sf.rel)
+        imports = sf.imports
+        for fd in [d for d in self.defs.values() if d.rel == sf.rel]:
+            targets = self.edges.setdefault(fd.qualname, set())
+            for call in ast.walk(fd.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                qn = self._resolve(call.func, mod, fd, imports)
+                if qn is not None:
+                    targets.add(qn)
+
+    def _resolve(self, func: ast.AST, mod: str, fd: FuncDef,
+                 imports: ImportMap) -> Optional[str]:
+        local = self._by_module.get(mod, {})
+        if isinstance(func, ast.Name):
+            if func.id in local and self.defs[local[func.id]].cls is None:
+                return local[func.id]
+            target = imports.symbols.get(func.id)
+            if target:
+                m, _, f = target.rpartition(".")
+                rel = self._rel_by_module.get(m)
+                if rel and f in self._by_module.get(m, {}):
+                    return self._by_module[m][f]
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        if isinstance(func.value, ast.Name):
+            base = func.value.id
+            if base in ("self", "cls") and fd.cls is not None:
+                qn = f"{fd.rel}::{fd.cls}.{func.attr}"
+                if qn in self.defs:
+                    return qn
+                return self._unique_method(func.attr)
+            target_mod = imports.modules.get(base)
+            if target_mod and target_mod in self._by_module:
+                qn = self._by_module[target_mod].get(func.attr)
+                if qn and self.defs[qn].cls is None:
+                    return qn
+        return self._unique_method(func.attr)
+
+    def _unique_method(self, name: str) -> Optional[str]:
+        owners = self._methods.get(name, [])
+        return owners[0] if len(owners) == 1 else None
+
+    # ------------------------------------------------------ reachability
+
+    def reachable_from(self, roots: List[str]
+                       ) -> Dict[str, Tuple[str, ...]]:
+        """BFS; returns qualname -> call chain (root..self) for every
+        reachable def, shortest chain wins."""
+        chains: Dict[str, Tuple[str, ...]] = {}
+        queue = deque()
+        for r in roots:
+            if r in self.defs:
+                chains[r] = (r,)
+                queue.append(r)
+        while queue:
+            cur = queue.popleft()
+            for nxt in sorted(self.edges.get(cur, ())):
+                if nxt not in chains:
+                    chains[nxt] = chains[cur] + (nxt,)
+                    queue.append(nxt)
+        return chains
+
+    def find_roots(self, specs: List[Tuple[Optional[str], str]],
+                   project: Project) -> List[str]:
+        """Root qualnames from (class, method) specs plus any def whose
+        ``def`` line carries a ``# dla: hot-loop-root`` pragma."""
+        roots = []
+        for qn, fd in self.defs.items():
+            for cls, meth in specs:
+                if fd.name == meth and (cls is None or fd.cls == cls):
+                    roots.append(qn)
+            sf = project.by_rel.get(fd.rel)
+            if sf is not None:
+                line = fd.node.lineno
+                if (1 <= line <= len(sf.lines)
+                        and "dla: hot-loop-root" in sf.lines[line - 1]):
+                    roots.append(qn)
+                else:
+                    for dec in fd.node.decorator_list:
+                        dl = dec.lineno
+                        if (1 <= dl <= len(sf.lines) and
+                                "dla: hot-loop-root" in sf.lines[dl - 1]):
+                            roots.append(qn)
+        return sorted(set(roots))
+
+
+def iter_defs(tree: ast.AST) -> Iterator[Tuple[Optional[str], ast.FunctionDef]]:
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for child in node.body:
+                if isinstance(child, ast.FunctionDef):
+                    yield node.name, child

@@ -1,0 +1,109 @@
+"""dla-lint command line: argument parsing, baseline handling, exit
+codes. Invoked as ``python -m tools.dla_lint`` (the tools/ entry keeps
+repo-root imports working from anywhere).
+
+Exit codes follow the metrics_diff convention: 0 clean, 1 unsuppressed
+finding(s), 2 usage/input error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from dla_tpu.analysis.core import all_rules, run_lint
+from dla_tpu.analysis.report import (
+    apply_baseline,
+    dump_baseline,
+    dump_report,
+    lint_json_report,
+    lint_text_report,
+    load_baseline,
+)
+
+DEFAULT_PATHS = ["dla_tpu", "tools", "bench.py", "config"]
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dla-lint",
+        description="JAX/TPU-aware static analysis: retrace hazards, "
+                    "trace-time side effects, hot-loop host syncs, "
+                    "donation misuse, Pallas tiling, config-schema and "
+                    "metric-name drift.")
+    p.add_argument("paths", nargs="*", default=None,
+                   help=f"files/directories to lint (default: "
+                        f"{' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="report format (json is the shared dla-report/1 "
+                        "schema metrics_diff also emits)")
+    p.add_argument("--rules", default=None, metavar="R1,R2",
+                   help="comma-separated subset of rules to run")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help="JSON baseline of accepted findings "
+                        "(fingerprints survive line-number drift)")
+    p.add_argument("--write-baseline", type=Path, default=None,
+                   metavar="PATH",
+                   help="write current unsuppressed findings as the new "
+                        "baseline and exit 0")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed findings in text output")
+    p.add_argument("--root", type=Path, default=None,
+                   help="anchor for relative paths in reports "
+                        "(default: cwd)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name:24s} {rule.summary}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    paths = args.paths or DEFAULT_PATHS
+
+    t0 = time.perf_counter()
+    try:
+        result = run_lint(paths, rules=rules, root=args.root)
+    except (FileNotFoundError, KeyError) as exc:
+        print(f"dla-lint: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_matched = 0
+    if args.baseline is not None:
+        try:
+            baseline_matched = apply_baseline(
+                result, load_baseline(args.baseline.read_text()))
+        except (OSError, ValueError) as exc:
+            print(f"dla-lint: bad baseline: {exc}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline is not None:
+        args.write_baseline.write_text(dump_baseline(result))
+        print(f"dla-lint: wrote {len(result.active)} fingerprint(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    elapsed_ms = (time.perf_counter() - t0) * 1000.0
+    if args.format == "json":
+        doc = lint_json_report(result, extra_summary={
+            "elapsed_ms": round(elapsed_ms, 3),
+            "baseline_matched": baseline_matched})
+        sys.stdout.write(dump_report(doc))
+    else:
+        sys.stdout.write(lint_text_report(
+            result, show_suppressed=args.show_suppressed))
+    return 1 if result.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

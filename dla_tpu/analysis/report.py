@@ -1,0 +1,162 @@
+"""Reporters and the shared strict-JSON report schema.
+
+One schema (``dla-report/1``) serves every static gate in the repo:
+``dla-lint`` emits it with ``--format json`` and ``tools/metrics_diff.py``
+emits it for bench/Prometheus regressions, so CI tooling parses a single
+shape regardless of which gate fired::
+
+    {
+      "schema": "dla-report/1",
+      "tool": "dla-lint",
+      "status": "ok" | "findings" | "error",
+      "summary": {"files_scanned": N, "findings": N, "suppressed": N, ...},
+      "findings": [
+        {"rule": "...", "path": "...", "line": N, "message": "...",
+         "severity": "error"|"warning"|"info",
+         "suppressed": false, "reason": null, "data": {...} | null},
+        ...
+      ]
+    }
+
+Strictness: :func:`dump_report` refuses NaN/Infinity (``allow_nan=False``
+— the same rule MetricsLogger follows) and :func:`validate_report`
+rejects unknown top-level keys, so a drifted producer fails loudly in
+tests instead of silently in CI.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from dla_tpu.analysis.core import Finding, LintResult
+
+SCHEMA_ID = "dla-report/1"
+
+_TOP_KEYS = {"schema", "tool", "status", "summary", "findings"}
+_FINDING_KEYS = {"rule", "path", "line", "message", "severity",
+                 "suppressed", "reason", "data"}
+_SEVERITIES = {"error", "warning", "info"}
+
+
+def finding_row(rule: str, path: str, line: int, message: str,
+                severity: str = "error", suppressed: bool = False,
+                reason: Optional[str] = None,
+                data: Optional[Dict] = None) -> Dict:
+    """One schema-shaped finding row (for producers that are not the
+    linter, e.g. metrics_diff building regression rows)."""
+    return {"rule": rule, "path": path, "line": int(line),
+            "message": message, "severity": severity,
+            "suppressed": bool(suppressed), "reason": reason, "data": data}
+
+
+def build_report(tool: str, findings: List[Dict],
+                 summary: Optional[Dict] = None,
+                 status: Optional[str] = None) -> Dict:
+    active = [f for f in findings if not f.get("suppressed")]
+    if status is None:
+        status = "findings" if active else "ok"
+    base_summary = {"findings": len(active),
+                    "suppressed": len(findings) - len(active)}
+    base_summary.update(summary or {})
+    return {"schema": SCHEMA_ID, "tool": tool, "status": status,
+            "summary": base_summary, "findings": findings}
+
+
+def validate_report(doc: Dict) -> None:
+    """Raise ValueError on any shape drift from ``dla-report/1``."""
+    if not isinstance(doc, dict):
+        raise ValueError("report must be a JSON object")
+    if set(doc) != _TOP_KEYS:
+        raise ValueError(f"report keys {sorted(doc)} != {sorted(_TOP_KEYS)}")
+    if doc["schema"] != SCHEMA_ID:
+        raise ValueError(f"schema {doc['schema']!r} != {SCHEMA_ID!r}")
+    if doc["status"] not in ("ok", "findings", "error"):
+        raise ValueError(f"bad status {doc['status']!r}")
+    if not isinstance(doc["tool"], str) or not doc["tool"]:
+        raise ValueError("tool must be a non-empty string")
+    if not isinstance(doc["summary"], dict):
+        raise ValueError("summary must be an object")
+    if not isinstance(doc["findings"], list):
+        raise ValueError("findings must be a list")
+    for row in doc["findings"]:
+        if not isinstance(row, dict) or set(row) != _FINDING_KEYS:
+            raise ValueError(f"bad finding row keys: {sorted(row)}")
+        if row["severity"] not in _SEVERITIES:
+            raise ValueError(f"bad severity {row['severity']!r}")
+        if not isinstance(row["line"], int):
+            raise ValueError("finding line must be an int")
+
+
+def dump_report(doc: Dict) -> str:
+    validate_report(doc)
+    return json.dumps(doc, indent=2, sort_keys=True, allow_nan=False) + "\n"
+
+
+# ------------------------------------------------------------- lint views
+
+def _finding_to_row(f: Finding) -> Dict:
+    return finding_row(f.rule, f.path, f.line, f.message,
+                       severity=f.severity, suppressed=f.suppressed,
+                       reason=f.reason, data=f.data)
+
+
+def lint_json_report(result: LintResult,
+                     extra_summary: Optional[Dict] = None) -> Dict:
+    summary = {"files_scanned": len(result.project.files)}
+    summary.update(extra_summary or {})
+    return build_report("dla-lint",
+                        [_finding_to_row(f) for f in result.findings],
+                        summary=summary)
+
+
+def lint_text_report(result: LintResult, show_suppressed: bool = False
+                     ) -> str:
+    """Human lines, one per finding: ``path:line: [rule] message``."""
+    out = []
+    for f in result.active:
+        out.append(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+    if show_suppressed:
+        for f in result.suppressed:
+            out.append(f"{f.path}:{f.line}: [{f.rule}] (suppressed: "
+                       f"{f.reason or 'no reason given'}) {f.message}")
+    n, s = len(result.active), len(result.suppressed)
+    out.append(f"dla-lint: {n} finding(s), {s} suppressed, "
+               f"{len(result.project.files)} file(s) scanned")
+    return "\n".join(out) + "\n"
+
+
+# --------------------------------------------------------------- baseline
+
+def load_baseline(text: str) -> List[Dict[str, str]]:
+    doc = json.loads(text)
+    if (not isinstance(doc, dict) or doc.get("schema") != SCHEMA_ID
+            or not isinstance(doc.get("fingerprints"), list)):
+        raise ValueError(
+            "baseline must be {'schema': 'dla-report/1', 'fingerprints': "
+            "[...]} — regenerate with --write-baseline")
+    return doc["fingerprints"]
+
+
+def dump_baseline(result: LintResult) -> str:
+    rows = [f.fingerprint(result.project) for f in result.active]
+    rows.sort(key=lambda r: (r["path"], r["rule"], r["context"]))
+    return json.dumps({"schema": SCHEMA_ID, "fingerprints": rows},
+                      indent=2, sort_keys=True) + "\n"
+
+
+def apply_baseline(result: LintResult, fingerprints: List[Dict[str, str]]
+                   ) -> int:
+    """Mark active findings matching a baseline fingerprint as
+    suppressed (reason ``baseline``). Returns how many matched."""
+    index = {(r.get("rule"), r.get("path"), r.get("context"))
+             for r in fingerprints}
+    matched = 0
+    for f in result.findings:
+        if f.suppressed:
+            continue
+        fp = f.fingerprint(result.project)
+        if (fp["rule"], fp["path"], fp["context"]) in index:
+            f.suppressed = True
+            f.reason = "baseline"
+            matched += 1
+    return matched

@@ -1,0 +1,131 @@
+"""config-schema-drift: every key in ``config/*.yaml`` must be declared
+by the schema dataclasses in :mod:`dla_tpu.training.config`.
+
+The dict-based config loader deliberately ignores unknown keys (overlay
+merging wants that), which means a typo — ``learning_rte``, an
+``optimizaton:`` block — silently falls back to defaults and the run
+burns a pod at the wrong hyperparameters. This rule closes the gap
+statically: YAML files are *composed* (not loaded) so every key carries
+its line number, then walked against the dataclass field tree.
+
+Schema selection per file: full configs and overlay fragments validate
+against :class:`RootConfigSchema`; ``config/data_sources/*.yaml``
+fragments whose top-level keys match :class:`DataSourceSchema` better
+validate against that. Unknown keys report with a did-you-mean when a
+close field name exists.
+"""
+from __future__ import annotations
+
+import dataclasses
+import difflib
+import typing
+from typing import Any, Dict, Iterator, Optional
+
+import yaml
+
+from dla_tpu.analysis.core import Finding, Project, Rule, SourceFile, register
+
+
+def _field_types(dc) -> Dict[str, Any]:
+    hints = typing.get_type_hints(dc)
+    return {f.name: hints.get(f.name, Any) for f in dataclasses.fields(dc)}
+
+
+def _unwrap_optional(tp):
+    if typing.get_origin(tp) is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+@register
+class ConfigSchemaDriftRule(Rule):
+    name = "config-schema-drift"
+    summary = ("YAML keys in config/*.yaml not declared by the schema "
+               "dataclasses in dla_tpu/training/config.py")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        # imported lazily so the linter core has no repo dependency when
+        # only python rules run
+        from dla_tpu.training.config import (
+            DataSourceSchema,
+            RootConfigSchema,
+        )
+        for sf in project.yaml_files():
+            try:
+                node = yaml.compose(sf.text)
+            except yaml.YAMLError as exc:
+                mark = getattr(exc, "problem_mark", None)
+                yield Finding(self.name, sf.rel,
+                              (mark.line + 1) if mark else 1,
+                              f"unparseable YAML: {exc}")
+                continue
+            if node is None:
+                continue
+            if not isinstance(node, yaml.MappingNode):
+                yield Finding(self.name, sf.rel, node.start_mark.line + 1,
+                              "config file is not a mapping")
+                continue
+            schema = self._pick_schema(node, RootConfigSchema,
+                                       DataSourceSchema)
+            yield from self._walk(sf, node, schema, path="")
+
+    def _pick_schema(self, node: yaml.MappingNode, root, source):
+        """Root schema unless the file reads as a data-source fragment
+        (more top-level keys match DataSourceSchema than Root)."""
+        keys = {k.value for k, _ in node.value
+                if isinstance(k, yaml.ScalarNode)}
+        root_score = len(keys & set(_field_types(root)))
+        src_score = len(keys & set(_field_types(source)))
+        return source if src_score > root_score else root
+
+    def _walk(self, sf: SourceFile, node: yaml.MappingNode, schema,
+              path: str) -> Iterator[Finding]:
+        fields = _field_types(schema)
+        for key_node, value_node in node.value:
+            if not isinstance(key_node, yaml.ScalarNode):
+                continue
+            key = key_node.value
+            line = key_node.start_mark.line + 1
+            dotted = f"{path}{key}"
+            if key not in fields:
+                hint = ""
+                close = difflib.get_close_matches(key, fields, n=1)
+                if close:
+                    hint = f" — did you mean `{close[0]}`?"
+                yield Finding(
+                    self.name, sf.rel, line,
+                    f"key `{dotted}` is not declared by "
+                    f"{schema.__name__} in dla_tpu/training/config.py"
+                    f"{hint} (the loader ignores unknown keys silently)")
+                continue
+            yield from self._descend(sf, value_node,
+                                     _unwrap_optional(fields[key]),
+                                     f"{dotted}.")
+
+    def _descend(self, sf: SourceFile, value_node, tp, path: str
+                 ) -> Iterator[Finding]:
+        origin = typing.get_origin(tp)
+        if dataclasses.is_dataclass(tp):
+            if isinstance(value_node, yaml.MappingNode):
+                yield from self._walk(sf, value_node, tp, path)
+        elif origin in (dict, typing.Dict) or origin is dict:
+            args = typing.get_args(tp)
+            value_tp = _unwrap_optional(args[1]) if len(args) == 2 else Any
+            if (dataclasses.is_dataclass(value_tp)
+                    and isinstance(value_node, yaml.MappingNode)):
+                # dynamic keys (benchmark names, model aliases): values
+                # still validate structurally
+                for _, sub in value_node.value:
+                    if isinstance(sub, yaml.MappingNode):
+                        yield from self._walk(sf, sub, value_tp, path)
+        elif origin in (list, typing.List) or origin is list:
+            args = typing.get_args(tp)
+            item_tp = _unwrap_optional(args[0]) if args else Any
+            if (dataclasses.is_dataclass(item_tp)
+                    and isinstance(value_node, yaml.SequenceNode)):
+                for item in value_node.value:
+                    if isinstance(item, yaml.MappingNode):
+                        yield from self._walk(sf, item, item_tp, path)
+        # Any / scalar types: validated-elsewhere leaf — stop

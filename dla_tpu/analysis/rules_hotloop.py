@@ -1,0 +1,91 @@
+"""host-sync-in-hot-loop: device->host synchronization reachable from
+the two latency-critical loops.
+
+The serving engine's execution model allows exactly one D2H transfer
+per decode step (the sampled tokens) and the trainer's step loop
+materializes the loss only at logging cadence — every *other* host sync
+stalls the dispatch pipeline and shows up as idle TPU time (the
+``.item()``-per-step anti-pattern). This rule walks an approximate call
+graph (:mod:`dla_tpu.analysis.callgraph`) from the hot-loop roots and
+flags the sync idioms:
+
+    ``x.item()``, ``x.block_until_ready()``, ``jax.device_get(x)``,
+    ``np.asarray(x)`` / ``np.array(x)``, ``float(<name or subscript>)``
+
+Roots: ``Trainer.fit`` and ``ServingEngine.step`` when present, plus
+any function whose ``def`` line carries ``# dla: hot-loop-root``.
+Deliberate, cadenced syncs (interval logging, the designed one-per-step
+token fetch) stay — annotated with a suppression pragma whose reason
+documents *why* they are allowed.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from dla_tpu.analysis.astutil import ImportMap
+from dla_tpu.analysis.core import Finding, Project, Rule, register
+from dla_tpu.analysis.callgraph import CallGraph
+
+#: (class, method) seeds; class None would match any owner.
+HOT_LOOP_ROOTS = [("Trainer", "fit"), ("ServingEngine", "step")]
+
+_NUMPY_MODULES = {"numpy"}
+_NUMPY_SYNC_FNS = {"asarray", "array"}
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync-in-hot-loop"
+    summary = ("device->host syncs (.item()/float()/np.asarray/"
+               "device_get/block_until_ready) reachable from Trainer.fit "
+               "or ServingEngine.step")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        graph = CallGraph(project)
+        roots = graph.find_roots(HOT_LOOP_ROOTS, project)
+        if not roots:
+            return
+        chains = graph.reachable_from(roots)
+        for qn, chain in sorted(chains.items()):
+            fd = graph.defs[qn]
+            sf = project.by_rel.get(fd.rel)
+            if sf is None:
+                continue
+            imports = sf.imports
+            via = " -> ".join(q.split("::")[1] for q in chain)
+            for node in ast.walk(fd.node):
+                label = self._sync_label(node, imports)
+                if label is not None:
+                    yield Finding(
+                        self.name, fd.rel, node.lineno,
+                        f"host sync `{label}` on the hot path "
+                        f"({via}) — stalls device dispatch; keep it "
+                        f"out of the loop or batch it behind the "
+                        f"logging cadence",
+                        data={"chain": via, "sync": label})
+
+    def _sync_label(self, node: ast.AST, imports: ImportMap
+                    ) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not node.args:
+                return ".item()"
+            if func.attr == "block_until_ready":
+                return ".block_until_ready()"
+            canon = imports.canonical(func)
+            if canon == "jax.device_get":
+                return "jax.device_get"
+            if canon:
+                mod, _, attr = canon.rpartition(".")
+                if mod in _NUMPY_MODULES and attr in _NUMPY_SYNC_FNS:
+                    return canon
+        elif isinstance(func, ast.Name) and func.id == "float":
+            # float(loss) / float(metrics["k"]) force the value to host;
+            # float(cfg.x) on attribute chains is config math, skipped
+            if node.args and isinstance(node.args[0],
+                                        (ast.Name, ast.Subscript)):
+                return "float(...)"
+        return None

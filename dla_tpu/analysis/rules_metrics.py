@@ -1,0 +1,76 @@
+"""metric-name-drift: every metric-name string literal at an emission
+site must be declared in the telemetry registry's CATALOG.
+
+This is the generalization of the original ``tools/check_metric_names.py``
+ad-hoc checker into the lint framework (that script is now a thin shim
+over this rule). A renamed metric is a silent production failure — the
+dashboard panel flatlines, alerts stop matching, and nobody notices
+until an incident. Here a rename is a loud lint failure instead.
+
+Mechanics (unchanged from the shim era): scan quoted ``area/name``
+literals in the known metric areas; exact names must be in the catalog
+(or a histogram-derived / dynamic-family name); literals ending in
+``/`` or ``_`` are f-string stems and must prefix a catalog name or a
+dynamic family. ``telemetry/registry.py`` — whose job is to *declare*
+names — is skipped, as are test files and this analysis package's own
+fixtures.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterator
+
+from dla_tpu.analysis.core import Finding, Project, Rule, register
+
+# NOTE: the literal regex is split across lines (re.VERBOSE) so this
+# rule's own source never matches the pattern it scans for.
+_LITERAL_RE = re.compile(
+    r"""["'](?P<name>(?:train|eval|serving|telemetry|resilience|slo)
+        /[A-Za-z0-9_/]*)""", re.VERBOSE)
+
+#: Files whose job is to declare names, not emit them.
+_SKIP_SUFFIXES = ("dla_tpu/telemetry/registry.py",)
+
+
+@register
+class MetricNameDriftRule(Rule):
+    name = "metric-name-drift"
+    summary = ("quoted metric names at emission sites that the telemetry "
+               "registry CATALOG does not declare")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        from dla_tpu.telemetry.registry import (
+            DYNAMIC_PREFIXES,
+            catalog_names,
+            is_catalog_name,
+        )
+
+        def prefix_ok(literal: str) -> bool:
+            stem = literal.rstrip("_/")
+            if any(n.startswith(stem) for n in catalog_names()):
+                return True
+            # f-string stems of dynamic families are legal: any
+            # completion of them passes is_catalog_name
+            return any(p.rstrip("/").startswith(stem)
+                       or literal.startswith(p)
+                       for p in DYNAMIC_PREFIXES)
+
+        for sf in project.files:
+            if sf.kind != "py":
+                continue
+            if any(sf.rel.endswith(s) for s in _SKIP_SUFFIXES):
+                continue
+            for m in _LITERAL_RE.finditer(sf.text):
+                name = m.group("name")
+                if name.endswith(("/", "_")):
+                    if prefix_ok(name):
+                        continue
+                elif is_catalog_name(name):
+                    continue
+                lineno = sf.text.count("\n", 0, m.start()) + 1
+                yield Finding(
+                    self.name, sf.rel, lineno,
+                    f"metric name {name!r} is not declared in "
+                    f"telemetry.registry.CATALOG — add a MetricSpec + "
+                    f"docs/OBSERVABILITY.md row, or fix the emission "
+                    f"site", data={"name": name})

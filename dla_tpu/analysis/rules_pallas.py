@@ -1,0 +1,138 @@
+"""pallas-tiling: TPU tile-alignment and interpret-fallback checks for
+Pallas kernels.
+
+The TPU vector unit operates on (8, 128) float32 tiles (sublane x
+lane); a ``BlockSpec`` whose trailing dims are not multiples of that
+tile either fails to lower or silently pads — wasting VMEM bandwidth on
+every grid step. And a ``pl.pallas_call`` with no ``interpret=``
+escape hatch cannot run under the CPU test suite at all, which is how
+kernel regressions sneak to hardware. Checks:
+
+1. ``pl.BlockSpec((..., s, l), ...)`` with *resolvable* dims: the last
+   dim must be 1 or a multiple of 128, the second-to-last 1 or a
+   multiple of 8. Dims are resolved from int literals, module-level
+   constants, and simple local ``name = <int>`` assignments; anything
+   symbolic is skipped (runtime block sizes are validated by the
+   kernels' own guards).
+2. every ``pl.pallas_call(...)`` must either pass ``interpret=`` or sit
+   in a function that takes an ``interpret`` parameter (the repo's
+   convention for threading the fallback down from tests).
+
+Applies to any file that imports ``jax.experimental.pallas``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional
+
+from dla_tpu.analysis.astutil import ImportMap, dotted
+from dla_tpu.analysis.core import Finding, Project, Rule, register
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _int_value(node: ast.AST, env: Dict[str, int]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)):
+        v = _int_value(node.operand, env)
+        return -v if v is not None else None
+    return None
+
+
+def _imports_pallas(imports: ImportMap) -> bool:
+    targets = list(imports.modules.values()) + list(imports.symbols.values())
+    return any(t.startswith("jax.experimental.pallas") for t in targets)
+
+
+@register
+class PallasTilingRule(Rule):
+    name = "pallas-tiling"
+    summary = ("BlockSpec shapes off the (8, 128) TPU tile and "
+               "pallas_call sites without an interpret= fallback")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.py_files():
+            imports = sf.imports
+            if not _imports_pallas(imports):
+                continue
+            module_env = self._module_constants(sf.tree)
+            # enclosing-function env: simple "name = <int>" assignments
+            for fn in [n for n in ast.walk(sf.tree)
+                       if isinstance(n, ast.FunctionDef)]:
+                yield from self._check_scope(sf.rel, fn, imports,
+                                             dict(module_env))
+            yield from self._check_scope(sf.rel, sf.tree, imports,
+                                         module_env, toplevel=True)
+
+    def _module_constants(self, tree: ast.AST) -> Dict[str, int]:
+        env: Dict[str, int] = {}
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)):
+                env[node.targets[0].id] = node.value.value
+        return env
+
+    def _check_scope(self, rel: str, scope: ast.AST, imports: ImportMap,
+                     env: Dict[str, int], toplevel: bool = False
+                     ) -> Iterator[Finding]:
+        has_interpret_param = False
+        if isinstance(scope, ast.FunctionDef):
+            a = scope.args
+            params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+            has_interpret_param = "interpret" in params
+            for node in ast.walk(scope):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    v = _int_value(node.value, env)
+                    if v is not None:
+                        env[node.targets[0].id] = v
+            body_iter = ast.walk(scope)
+        else:
+            # module top level only: skip function bodies (handled above)
+            body_iter = (n for stmt in scope.body
+                         if not isinstance(stmt, (ast.FunctionDef,
+                                                  ast.ClassDef))
+                         for n in ast.walk(stmt))
+
+        for node in body_iter:
+            if not isinstance(node, ast.Call):
+                continue
+            canon = imports.canonical(node.func) or dotted(node.func) or ""
+            tail = canon.rsplit(".", 1)[-1]
+            if tail == "BlockSpec" and node.args:
+                yield from self._check_blockspec(rel, node, env)
+            elif tail == "pallas_call":
+                has_kw = any(kw.arg == "interpret" for kw in node.keywords)
+                if not has_kw and not has_interpret_param:
+                    yield Finding(
+                        self.name, rel, node.lineno,
+                        "pallas_call without an interpret= fallback — "
+                        "thread an `interpret` parameter through so the "
+                        "kernel runs under the CPU test suite")
+
+    def _check_blockspec(self, rel: str, node: ast.Call,
+                         env: Dict[str, int]) -> Iterator[Finding]:
+        shape = node.args[0]
+        if not isinstance(shape, (ast.Tuple, ast.List)):
+            return
+        dims = [(d, _int_value(d, env)) for d in shape.elts]
+        if not dims:
+            return
+        checks = [(dims[-1][1], _LANE, "last")]
+        if len(dims) >= 2:
+            checks.append((dims[-2][1], _SUBLANE, "second-to-last"))
+        for value, mult, which in checks:
+            if value is None or value == 1:
+                continue
+            if value % mult != 0:
+                yield Finding(
+                    self.name, rel, node.lineno,
+                    f"BlockSpec {which} dim {value} is not a multiple of "
+                    f"{mult} — off the (8, 128) TPU tile; the block "
+                    f"pads to the tile and wastes VMEM bandwidth")

@@ -126,6 +126,7 @@ class _ShardReader:
         region = _normalize_index(idx, self.shape)
         exact = self._by_region.get(region)
         if exact is not None:  # fast path: slice == one shard file
+            # dla: disable=host-sync-in-hot-loop -- restore path: runs once at resume, not per step
             return np.asarray(self._load(exact))
         out_shape = tuple(stop - start for start, stop in region)
         out = np.empty(out_shape, self.dtype)

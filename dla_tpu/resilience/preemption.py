@@ -119,6 +119,7 @@ class PreemptionHandler:
         if step % self.sync_every != 0 and not self._flag.is_set():
             return False
         from jax.experimental import multihost_utils
+        # dla: disable=host-sync-in-hot-loop -- host-only int32 input for the allgather, cadenced by sync_every; no device fetch
         local = np.asarray([1 if self._flag.is_set() else 0], np.int32)
         agreed = int(np.max(multihost_utils.process_allgather(local)))
         if agreed:

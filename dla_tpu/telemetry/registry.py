@@ -55,6 +55,7 @@ class Gauge:
         self._peak: Optional[float] = None
 
     def set(self, v: float) -> None:
+        # dla: disable=host-sync-in-hot-loop -- Gauge.set receives host scalars; float() is type coercion, not a device fetch
         self.value = float(v)
         self._peak = (self.value if self._peak is None
                       else max(self._peak, self.value))

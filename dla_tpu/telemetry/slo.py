@@ -142,6 +142,7 @@ class SLOWatch:
         for slo in self.slos:
             st = self._state[slo.name]
             if slo.metric in values:
+                # dla: disable=host-sync-in-hot-loop -- SLO snapshots are host floats already
                 value = float(values[slo.metric])
                 st.samples.append((t, slo.violated(value)))
             else:

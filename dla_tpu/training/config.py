@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import copy
+import dataclasses
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -126,3 +127,345 @@ def make_arg_parser(description: str) -> argparse.ArgumentParser:
 
 def config_from_args(args: argparse.Namespace) -> Dict[str, Any]:
     return load_config(args.config, args.overlay, args.overrides)
+
+
+# ----------------------------------------------------------------- schema
+# Declared YAML schema: one frozen dataclass per config block. The runtime
+# stays dict-based (overlay merging and dotted overrides want plain
+# dicts), but the dataclasses are the single source of truth for which
+# keys exist — dla-lint's ``config-schema-drift`` rule introspects them
+# via ``dataclasses.fields`` and flags any ``config/*.yaml`` key they do
+# not declare, so a typo'd key is a lint failure instead of a silently
+# ignored default three minutes into a pod run.
+#
+# Field *types* encode structure, not value validation: a dataclass or
+# ``Dict[str, <dataclass>]`` / ``List[<dataclass>]`` annotation tells the
+# rule to recurse; ``Any`` marks a validated-elsewhere leaf. Keep new keys
+# in sync with the block they are read from (grep ``cfg.get("<key>")``).
+
+@dataclasses.dataclass(frozen=True)
+class MeshSchema:
+    data: Any = None
+    fsdp: Any = None
+    model: Any = None
+    sequence: Any = None
+    stage: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class HardwareSchema:
+    mesh: Optional[MeshSchema] = None
+    gradient_accumulation_steps: Any = None
+    auto_initialize: Any = None
+    coordinator_address: Any = None
+    # GPU-era keys: tolerated by load_config with a warning (see
+    # GPU_ERA_HARDWARE_KEYS) so reference configs keep launching
+    deepspeed_config: Any = None
+    fsdp: Any = None
+    mixed_precision: Any = None
+    num_processes: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectorSchema:
+    param_norm: Any = None
+    update_norm: Any = None
+    per_layer: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSchema:
+    enabled: Any = None
+    capacity: Any = None
+    path: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateSchema:
+    enabled: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetrySchema:
+    enabled: Any = None
+    metrics_port: Any = None
+    flight_recorder_capacity: Any = None
+    readiness_timeout_s: Any = None
+    collector: Optional[CollectorSchema] = None
+    trace: Optional[TraceSchema] = None
+    aggregate: Optional[AggregateSchema] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileSchema:
+    trace_dir: Any = None
+    start_step: Any = None
+    num_steps: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class LoggingSchema:
+    output_dir: Any = None
+    output_path: Any = None
+    log_dir: Any = None
+    table_path: Any = None
+    log_every_steps: Any = None
+    eval_every_steps: Any = None
+    save_every_steps: Any = None
+    keep_last_n: Any = None
+    use_wandb: Any = None
+    profile: Optional[ProfileSchema] = None
+    telemetry: Optional[TelemetrySchema] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnsSchema:
+    prompt: Any = None
+    response: Any = None
+    chosen: Any = None
+    rejected: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceSchema:
+    """One data source: the ``data:`` block's per-source keys, also the
+    shape of ``config/data_sources/*.yaml`` fragments and
+    ``data.mixture`` entries."""
+    source: Any = None
+    hf_path: Any = None
+    split: Any = None
+    train_split: Any = None
+    eval_split: Any = None
+    train_path: Any = None
+    eval_path: Any = None
+    limit: Any = None
+    template: Any = None
+    prompt_key: Any = None
+    weight: Any = None
+    columns: Optional[ColumnsSchema] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSchema(DataSourceSchema):
+    packing: Any = None
+    mixture: Optional[List[DataSourceSchema]] = None
+    mixture_seed: Any = None
+    mixture_size: Any = None
+    preference_path: Any = None
+    teacher_samples_path: Any = None
+    max_seq_length: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationSchema:
+    learning_rate: Any = None
+    lr_scheduler: Any = None
+    warmup_steps: Any = None
+    weight_decay: Any = None
+    max_grad_norm: Any = None
+    max_train_steps: Any = None
+    micro_batch_size: Any = None
+    total_batch_size: Any = None
+    grad_accum: Any = None
+    grad_accum_dtype: Any = None
+    gradient_accumulation_steps: Any = None
+    adam_beta1: Any = None
+    adam_beta2: Any = None
+    adam_eps: Any = None
+    adam_moment_dtype: Any = None
+    optimizer: Any = None
+    temperature: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSchema:
+    model_name_or_path: Any = None
+    base_model_name_or_path: Any = None
+    policy_model_name_or_path: Any = None
+    reference_model_name_or_path: Any = None
+    student_model_name_or_path: Any = None
+    teacher_path: Any = None
+    tokenizer: Any = None
+    beta: Any = None
+    dropout: Any = None
+    gradient_checkpointing: Any = None
+    label_smoothing: Any = None
+    max_seq_length: Any = None
+    pooling: Any = None
+    lora: Any = None
+    kv_cache_dtype: Any = None
+    context_parallel: Any = None
+    rope_scaling: Any = None
+    use_flash_attention: Any = None
+    pipeline_microbatches: Any = None
+    pipeline_stages: Any = None
+    pipeline_interleave: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationSchema:
+    batch_size: Any = None
+    do_sample: Any = None
+    max_new_tokens: Any = None
+    max_prompt_length: Any = None
+    temperature: Any = None
+    top_p: Any = None
+    draft_model: Any = None
+    speculative_gamma: Any = None
+    speculative_alloc_factor: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PpoSchema:
+    algo: Any = None
+    steps: Any = None
+    batch_size: Any = None
+    mini_batch_size: Any = None
+    epochs: Any = None
+    learning_rate: Any = None
+    clip_ratio: Any = None
+    kl_coef: Any = None
+    target_kl: Any = None
+    gae_lambda: Any = None
+    gamma: Any = None
+    value_clip: Any = None
+    value_coef: Any = None
+    rollout_quantize_weights: Any = None
+    generation_params: Optional[GenerationSchema] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingSchema:
+    source: Any = None
+    hf_path: Any = None
+    split: Any = None
+    prompt_key: Any = None
+    prompt_path: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RewardModelSchema:
+    path: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DistillSchema:
+    on_policy: Any = None
+    teacher_model_name_or_path: Any = None
+    teacher_model_names_or_paths: Any = None
+    use_kl: Any = None
+    temperature: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchmarkSchema:
+    type: Any = None
+    path: Any = None
+    hf_path: Any = None
+    split: Any = None
+    prompt_key: Any = None
+    prompts_path: Any = None
+    max_samples: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeLatencySchema:
+    enabled: Any = None
+    batch_size: Any = None
+    prompt_length: Any = None
+    new_tokens: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingLatencySchema:
+    enabled: Any = None
+    arrival_rate: Any = None
+    num_requests: Any = None
+    prompt_len_min: Any = None
+    prompt_len_max: Any = None
+    new_tokens: Any = None
+    page_size: Any = None
+    num_pages: Any = None
+    num_slots: Any = None
+    max_model_len: Any = None
+    max_prefill_batch: Any = None
+    lookahead: Any = None
+    decode_reserve_pages: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencySchema:
+    batch_sizes: Any = None
+    seq_lengths: Any = None
+    hardware: Any = None
+    measure_steps: Any = None
+    warmup_steps: Any = None
+    decode: Optional[DecodeLatencySchema] = None
+    serving: Optional[ServingLatencySchema] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardSchema:
+    enabled: Any = None
+    rollback: Any = None
+    spike_factor: Any = None
+    max_consecutive_bad: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchdogSchema:
+    enabled: Any = None
+    timeout_s: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceSchema:
+    async_checkpointing: Any = None
+    save_retries: Any = None
+    retry_backoff_s: Any = None
+    preemption: Any = None
+    preemption_sync_every: Any = None
+    fault_plan: Any = None
+    guard: Optional[GuardSchema] = None
+    watchdog: Optional[WatchdogSchema] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ObjectiveSchema:
+    name: Any = None
+    metric: Any = None
+    objective: Any = None
+    kind: Any = None
+    budget: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SloSchema:
+    objectives: Optional[List[ObjectiveSchema]] = None
+    window_s: Any = None
+    budget: Any = None
+    check_every: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RootConfigSchema:
+    """Top level of every full config under ``config/``; overlay
+    fragments (``config/ablations/``) are partial instances of it."""
+    experiment_name: Any = None
+    seed: Any = None
+    backend: Any = None
+    model: Optional[ModelSchema] = None
+    data: Optional[DataSchema] = None
+    optimization: Optional[OptimizationSchema] = None
+    logging: Optional[LoggingSchema] = None
+    hardware: Optional[HardwareSchema] = None
+    ppo: Optional[PpoSchema] = None
+    reward_model: Optional[RewardModelSchema] = None
+    sampling: Optional[SamplingSchema] = None
+    distill: Optional[DistillSchema] = None
+    benchmarks: Optional[Dict[str, BenchmarkSchema]] = None
+    latency: Optional[LatencySchema] = None
+    generation: Optional[GenerationSchema] = None
+    resilience: Optional[ResilienceSchema] = None
+    slo: Optional[SloSchema] = None
+    models: Optional[Dict[str, Any]] = None

@@ -74,6 +74,7 @@ class MetricsLogger:
 
 def _scalar(v: Any) -> Any:
     try:
+        # dla: disable=host-sync-in-hot-loop -- logger normalizes host payload values at logging cadence
         f = float(v)
     except (TypeError, ValueError):
         return v

@@ -1,0 +1,471 @@
+"""dla-lint framework tests (docs/ANALYSIS.md).
+
+THE pins: (a) every rule fires on its bad fixture and stays silent on
+the good twin — the firing fixtures double as executable documentation
+of what each rule means; (b) the repo itself lints clean: zero
+unsuppressed findings over dla_tpu/ + tools/ + bench.py + config/, in
+under the 10 s acceptance bound, and every suppression carries a human
+reason; (c) the JSON report is the shared strict ``dla-report/1``
+schema — the same validator accepts dla-lint and metrics_diff output;
+(d) baselines match by (rule, path, source-line) fingerprint and so
+survive pure line-number drift; (e) CLI exit codes follow the 0/1/2
+convention.
+"""
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from dla_tpu.analysis import all_rules, run_lint  # noqa: E402
+from dla_tpu.analysis.cli import main as lint_main  # noqa: E402
+from dla_tpu.analysis.report import (  # noqa: E402
+    SCHEMA_ID,
+    apply_baseline,
+    dump_baseline,
+    dump_report,
+    lint_json_report,
+    load_baseline,
+    validate_report,
+)
+
+ALL_RULE_NAMES = {
+    "retrace-hazard", "trace-side-effect", "host-sync-in-hot-loop",
+    "donation-misuse", "pallas-tiling", "config-schema-drift",
+    "metric-name-drift",
+}
+
+
+def lint_src(tmp_path, src, rules=None, name="mod.py"):
+    """Write one fixture file and return the active rule names hit."""
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    result = run_lint([p], rules=rules, root=tmp_path)
+    return result
+
+
+def fired(result):
+    return {f.rule for f in result.active}
+
+
+# --------------------------------------------------------------- registry
+
+def test_rule_catalog_is_complete():
+    rules = all_rules()
+    assert set(rules) == ALL_RULE_NAMES
+    for name, rule in rules.items():
+        assert rule.name == name and rule.summary
+
+
+# ---------------------------------------------------------- retrace-hazard
+
+def test_retrace_hazard_fires_on_python_branch_on_traced_arg(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:
+                return x + n
+            return x
+        """)
+    assert "retrace-hazard" in fired(r)
+
+
+def test_retrace_hazard_silent_with_static_argnums(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def f(x, n):
+            if n > 0:
+                return x + n
+            return x
+        """)
+    assert "retrace-hazard" not in fired(r)
+
+
+def test_retrace_hazard_fires_on_traced_shape(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(n):
+            return jnp.zeros(n)
+        """)
+    assert "retrace-hazard" in fired(r)
+
+
+def test_retrace_hazard_split_key_is_not_a_shape(tmp_path):
+    # jax.random.split's first arg is the (traced) key — only its `num`
+    # argument is shape-like. Regression test for the self-apply pass.
+    ok = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def g(key):
+            return jax.random.split(key, 4)
+        """)
+    assert "retrace-hazard" not in fired(ok)
+    bad = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def g(key, n):
+            return jax.random.split(key, n)
+        """, name="bad_split.py")
+    assert "retrace-hazard" in fired(bad)
+
+
+# ------------------------------------------------------- trace-side-effect
+
+def test_trace_side_effect_fires_inside_jit(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+        import time
+
+        @jax.jit
+        def f(x):
+            t = time.time()
+            print(x)
+            return x
+        """)
+    assert "trace-side-effect" in fired(r)
+    assert len([f for f in r.active if f.rule == "trace-side-effect"]) == 2
+
+
+def test_trace_side_effect_silent_outside_jit(tmp_path):
+    r = lint_src(tmp_path, """
+        import time
+
+        def f(x):
+            t = time.time()
+            print(x)
+            return x
+        """)
+    assert "trace-side-effect" not in fired(r)
+
+
+# --------------------------------------------------- host-sync-in-hot-loop
+
+def test_host_sync_fires_via_pragma_root_and_call_chain(tmp_path):
+    r = lint_src(tmp_path, """
+        def hot(xs):  # dla: hot-loop-root
+            for x in xs:
+                helper(x)
+
+        def helper(x):
+            return x.item()
+        """)
+    hits = [f for f in r.active if f.rule == "host-sync-in-hot-loop"]
+    assert hits and "hot -> helper" in hits[0].message
+
+
+def test_host_sync_fires_from_trainer_fit_root(tmp_path):
+    r = lint_src(tmp_path, """
+        class Trainer:
+            def fit(self, xs):
+                for x in xs:
+                    v = float(x)
+        """)
+    assert "host-sync-in-hot-loop" in fired(r)
+
+
+def test_host_sync_silent_without_a_root(tmp_path):
+    r = lint_src(tmp_path, """
+        def cold(xs):
+            return [x.item() for x in xs]
+        """)
+    assert "host-sync-in-hot-loop" not in fired(r)
+
+
+# --------------------------------------------------------- donation-misuse
+
+def test_donation_misuse_fires_on_use_after_donate(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_step(state, batch):
+            return state
+
+        def loop(state, batches):
+            for b in batches:
+                new_state = train_step(state, b)
+                log(state)
+                state = new_state
+            return state
+        """)
+    assert "donation-misuse" in fired(r)
+
+
+def test_donation_misuse_silent_on_same_statement_rebind(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_step(state, batch):
+            return state
+
+        def loop(state, batches):
+            for b in batches:
+                state = train_step(state, b)
+            return state
+        """)
+    assert "donation-misuse" not in fired(r)
+
+
+# ----------------------------------------------------------- pallas-tiling
+
+def test_pallas_tiling_fires_off_tile_and_missing_interpret(tmp_path):
+    r = lint_src(tmp_path, """
+        from jax.experimental import pallas as pl
+
+        def launch(x, kernel):
+            spec = pl.BlockSpec((8, 100), lambda i: (i, 0))
+            return pl.pallas_call(kernel)(x)
+        """)
+    msgs = [f.message for f in r.active if f.rule == "pallas-tiling"]
+    assert any("multiple of 128" in m for m in msgs)
+    assert any("interpret" in m for m in msgs)
+
+
+def test_pallas_tiling_silent_on_tile_aligned_with_fallback(tmp_path):
+    r = lint_src(tmp_path, """
+        from jax.experimental import pallas as pl
+
+        def launch(x, kernel, interpret=False):
+            spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+            return pl.pallas_call(kernel, interpret=interpret)(x)
+        """)
+    assert "pallas-tiling" not in fired(r)
+
+
+# ----------------------------------------------------- config-schema-drift
+
+def test_config_schema_drift_fires_with_suggestion(tmp_path):
+    p = tmp_path / "config" / "exp.yaml"
+    p.parent.mkdir()
+    p.write_text("experiment_name: t\nmodel:\n  max_seq_lenght: 128\n")
+    r = run_lint([p], rules=["config-schema-drift"], root=tmp_path)
+    hits = [f for f in r.active if f.rule == "config-schema-drift"]
+    assert hits and "max_seq_length" in hits[0].message
+
+
+def test_config_schema_drift_silent_on_declared_keys(tmp_path):
+    p = tmp_path / "config" / "exp.yaml"
+    p.parent.mkdir()
+    p.write_text("experiment_name: t\nseed: 0\nmodel:\n"
+                 "  max_seq_length: 128\n")
+    r = run_lint([p], rules=["config-schema-drift"], root=tmp_path)
+    assert "config-schema-drift" not in fired(r)
+
+
+# ------------------------------------------------------- metric-name-drift
+
+def test_metric_name_drift_fires_on_undeclared_name(tmp_path):
+    r = lint_src(tmp_path,
+                 'M = "train/not_a_real_metric_xyz"\n',
+                 rules=["metric-name-drift"])
+    hits = [f for f in r.active if f.rule == "metric-name-drift"]
+    assert hits and hits[0].data["name"] == "train/not_a_real_metric_xyz"
+
+
+def test_metric_name_drift_silent_on_catalog_name(tmp_path):
+    r = lint_src(tmp_path, 'M = "train/loss"\n',
+                 rules=["metric-name-drift"])
+    assert "metric-name-drift" not in fired(r)
+
+
+def test_check_metric_names_shim_delegates_to_rule(tmp_path, capsys):
+    from tools.check_metric_names import run
+    (tmp_path / "dla_tpu").mkdir()
+    (tmp_path / "dla_tpu" / "x.py").write_text(
+        'm = "train/ghost_metric"  '
+        '# dla: disable=metric-name-drift -- fixture\n')
+    (tmp_path / "bench.py").write_text("")
+    # pragma honored through the shim: framework semantics for free
+    assert run(tmp_path) == 0
+
+
+# ------------------------------------------------------------ suppressions
+
+def test_suppression_inline_and_reason_carried(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:  # dla: disable=retrace-hazard -- bounded by caller
+                return x + n
+            return x
+        """)
+    assert not r.active
+    assert r.suppressed and r.suppressed[0].reason == "bounded by caller"
+
+
+def test_suppression_standalone_comment_covers_next_line(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            # dla: disable=retrace-hazard -- fixture
+            if n > 0:
+                return x + n
+            return x
+        """)
+    assert not r.active and r.suppressed
+
+
+def test_suppression_file_level_and_all_wildcard(tmp_path):
+    r = lint_src(tmp_path, """
+        # dla: disable-file=all -- generated fixture
+        import jax
+        import time
+
+        @jax.jit
+        def f(x, n):
+            t = time.time()
+            if n > 0:
+                return x + n
+            return x
+        """)
+    assert not r.active and len(r.suppressed) >= 2
+
+
+def test_wrong_rule_suppression_does_not_hide(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:  # dla: disable=pallas-tiling -- wrong rule
+                return x + n
+            return x
+        """)
+    assert "retrace-hazard" in fired(r)
+
+
+# -------------------------------------------------------------- the report
+
+def test_json_report_is_strict_and_round_trips(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:
+                return x + n
+            return x
+        """)
+    doc = json.loads(dump_report(lint_json_report(r)))
+    validate_report(doc)
+    assert doc["schema"] == SCHEMA_ID and doc["status"] == "findings"
+    with pytest.raises(ValueError):
+        validate_report({**doc, "extra": 1})
+
+
+def test_metrics_diff_emits_the_same_schema(tmp_path, capsys):
+    from tools.metrics_diff import main as mdiff_main
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    base.write_text('{"serving": {"ttft_ms": 100.0}}')
+    cand.write_text('{"serving": {"ttft_ms": 150.0}}')
+    rc = mdiff_main([str(base), str(cand), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    validate_report(doc)
+    assert rc == 1 and doc["tool"] == "metrics-diff"
+    assert doc["findings"][0]["rule"] == "metric-regression"
+    rc = mdiff_main([str(base), str(base), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    validate_report(doc)
+    assert rc == 0 and doc["status"] == "ok"
+
+
+# --------------------------------------------------------------- baselines
+
+def test_baseline_fingerprints_survive_line_drift(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:
+                return x + n
+            return x
+        """
+    r = lint_src(tmp_path, src)
+    baseline = dump_baseline(r)
+    # shift every line down: fingerprint is (rule, path, source line)
+    (tmp_path / "mod.py").write_text(
+        "# a new leading comment\n" + textwrap.dedent(src))
+    r2 = run_lint([tmp_path / "mod.py"], root=tmp_path)
+    assert r2.active
+    matched = apply_baseline(r2, load_baseline(baseline))
+    assert matched == 1 and not r2.active
+    assert r2.suppressed[0].reason == "baseline"
+
+
+def test_baseline_rejects_foreign_json():
+    with pytest.raises(ValueError):
+        load_baseline('{"something": "else"}')
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x, n):\n"
+                   "    if n > 0:\n        return x\n    return n\n")
+    ok = tmp_path / "ok.py"
+    ok.write_text("def g():\n    return 1\n")
+    assert lint_main([str(ok), "--root", str(tmp_path)]) == 0
+    assert lint_main([str(bad), "--root", str(tmp_path)]) == 1
+    assert lint_main([str(tmp_path / "missing.py")]) == 2
+    assert lint_main([str(ok), "--rules", "no-such-rule"]) == 2
+    assert lint_main(["--list-rules"]) == 0
+    capsys.readouterr()
+    assert lint_main([str(bad), "--root", str(tmp_path),
+                      "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    validate_report(doc)
+    assert doc["tool"] == "dla-lint" and doc["summary"]["findings"] == 1
+
+
+def test_cli_write_then_apply_baseline(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x, n):\n"
+                   "    if n > 0:\n        return x\n    return n\n")
+    base = tmp_path / "baseline.json"
+    assert lint_main([str(bad), "--root", str(tmp_path),
+                      "--write-baseline", str(base)]) == 0
+    assert lint_main([str(bad), "--root", str(tmp_path),
+                      "--baseline", str(base)]) == 0
+    assert lint_main([str(bad), "--root", str(tmp_path),
+                      "--baseline", str(tmp_path / "nope.json")]) == 2
+
+
+# ----------------------------------------------------- the repo lints clean
+
+def test_repo_lints_clean_with_documented_suppressions():
+    t0 = time.perf_counter()
+    result = run_lint(["dla_tpu", "tools", "bench.py", "config"], root=REPO)
+    elapsed = time.perf_counter() - t0
+    assert not result.active, "unsuppressed findings:\n" + "\n".join(
+        f"  {f.path}:{f.line}: [{f.rule}] {f.message}"
+        for f in result.active)
+    # every deliberate exception documents WHY it is allowed
+    for f in result.suppressed:
+        assert f.reason and f.reason.strip(), (
+            f"{f.path}:{f.line}: suppression without a reason")
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (bound: 10s)"

@@ -1,0 +1,4 @@
+# Package marker so ``python -m tools.dla_lint`` works from the repo
+# root. The scripts in here remain directly runnable
+# (``python tools/<script>.py``) — each inserts the repo root on
+# sys.path itself.

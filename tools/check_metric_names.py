@@ -1,87 +1,48 @@
 #!/usr/bin/env python
-"""Static metric-name check: every metric-name string literal at an
-emission site must be declared in the telemetry registry's CATALOG
-(dla_tpu/telemetry/registry.py).
+"""Static metric-name check — thin shim over the ``metric-name-drift``
+lint rule.
 
-A renamed metric is a silent production failure — the dashboard panel
-flatlines, alerts stop matching, and nobody notices until an incident.
-This check makes a rename a loud build failure instead: it greps
-``dla_tpu/`` and ``bench.py`` for quoted ``area/name`` literals in the
-known metric areas and fails (exit 1, listing file:line) on any name
-the catalog does not declare. Invoked by tests/test_telemetry.py as a
-fast test; run manually with::
+The original ad-hoc checker grew into
+:mod:`dla_tpu.analysis.rules_metrics`; this entry point survives so the
+existing test hook (tests/test_telemetry.py) and muscle memory keep
+working. Same contract as before: exit 1 listing ``file:line`` on any
+quoted ``area/name`` literal the telemetry registry's CATALOG does not
+declare, exit 0 with an ``OK`` line otherwise. New behaviour comes for
+free from the framework: ``# dla: disable=metric-name-drift`` pragmas
+are honored. Run manually with::
 
     python tools/check_metric_names.py
+
+or, for the full rule set and JSON output::
+
+    python -m tools.dla_lint --rules metric-name-drift --format json
 """
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from dla_tpu.telemetry.registry import (  # noqa: E402
-    DYNAMIC_PREFIXES,
-    catalog_names,
-    is_catalog_name,
-)
-
-#: Quoted literal starting with a known metric area. Trailing "/" or "_"
-#: marks a prefix literal (f-string stem like "serving/ttft_ms_" or
-#: "train/" + key) — validated as a prefix of catalog names.
-_LITERAL_RE = re.compile(
-    r"""["'](?P<name>(?:train|eval|serving|telemetry|resilience|slo)
-        /[A-Za-z0-9_/]*)""", re.VERBOSE)
-
-#: Files whose job is to *declare* names, not emit them.
-_SKIP = {"dla_tpu/telemetry/registry.py"}
-
-
-def _prefix_ok(literal: str) -> bool:
-    stem = literal.rstrip("_/")
-    if any(n.startswith(stem) for n in catalog_names()):
-        return True
-    # f-string stems of dynamic families ("slo/" + name, "train/rms/" +
-    # path) are legal: any completion of them passes is_catalog_name
-    return any(p.rstrip("/").startswith(stem) or literal.startswith(p)
-               for p in DYNAMIC_PREFIXES)
-
-
-def scan_file(path: Path, rel: str):
-    """Yield (line_number, literal) for undeclared names in one file."""
-    text = path.read_text()
-    for m in _LITERAL_RE.finditer(text):
-        name = m.group("name")
-        if name.endswith(("/", "_")):
-            if _prefix_ok(name):
-                continue
-        elif is_catalog_name(name):
-            continue
-        lineno = text.count("\n", 0, m.start()) + 1
-        yield lineno, name
+from dla_tpu.analysis import run_lint  # noqa: E402
 
 
 def run(repo: Path = REPO) -> int:
-    files = (sorted((repo / "dla_tpu").rglob("*.py"))
-             + sorted((repo / "tools").glob("*.py"))
-             + [repo / "bench.py"])
-    bad = []
-    for f in files:
-        rel = f.relative_to(repo).as_posix()
-        if rel in _SKIP:
-            continue
-        for lineno, name in scan_file(f, rel):
-            bad.append((rel, lineno, name))
+    paths = [p for p in (repo / "dla_tpu", repo / "tools", repo / "bench.py")
+             if p.exists()]
+    result = run_lint(paths, rules=["metric-name-drift"], root=repo)
+    scanned = [f for f in result.project.files if f.kind == "py"]
+    bad = result.active
     if bad:
         print("metric names not declared in telemetry.registry.CATALOG "
               "(add a MetricSpec + docs/OBSERVABILITY.md row, or fix the "
               "emission site):", file=sys.stderr)
-        for rel, lineno, name in bad:
-            print(f"  {rel}:{lineno}: {name!r}", file=sys.stderr)
+        for f in bad:
+            name = (f.data or {}).get("name", "")
+            print(f"  {f.path}:{f.line}: {name!r}", file=sys.stderr)
         return 1
-    print(f"check_metric_names: OK ({len(files)} files scanned)")
+    print(f"check_metric_names: OK ({len(scanned)} files scanned)")
     return 0
 
 

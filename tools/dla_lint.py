@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""dla-lint CLI entry point.
+
+Run from the repo root::
+
+    python -m tools.dla_lint                       # default path set
+    python -m tools.dla_lint dla_tpu tools bench.py
+    python -m tools.dla_lint --format json --baseline tools/lint_baseline.json
+    python -m tools.dla_lint --list-rules
+
+The analyzer itself lives in ``dla_tpu/analysis/`` (rule catalog in
+``docs/ANALYSIS.md``); this wrapper only pins the repo root on sys.path
+so the command works no matter how it is invoked. Exit codes: 0 clean,
+1 unsuppressed finding(s), 2 usage/input error.
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dla_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,8 @@ from typing import Dict, Tuple
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+from dla_tpu.analysis.report import (  # noqa: E402
+    build_report, dump_report, finding_row)
 from dla_tpu.telemetry.registry import parse_prometheus_text  # noqa: E402
 
 LOWER_IS_BETTER = ("_ms", "latency", "stall", "badput", "overhead",
@@ -122,6 +124,13 @@ def compare(base: Dict[str, float], cand: Dict[str, float],
     return regressions, improvements, moved
 
 
+def _summary(common, regressions, improvements, moved) -> Dict:
+    return {"common_metrics": len(common),
+            "regressions": len(regressions),
+            "improvements": len(improvements),
+            "moved": len(moved)}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -138,13 +147,22 @@ def main(argv=None) -> int:
                     help="also fail when the two snapshots share no "
                          "metric names (a renamed catalog would "
                          "otherwise diff as trivially clean)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json emits the shared dla-report/1 schema "
+                         "(same shape as `dla_lint --format json`)")
     args = ap.parse_args(argv)
+    as_json = args.format == "json"
+    cand_path = args.candidate.as_posix()
 
     try:
         base = load_snapshot(args.baseline)
         cand = load_snapshot(args.candidate)
         overrides = parse_overrides(args.tolerance_for)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
+        if as_json:
+            print(dump_report(build_report(
+                "metrics-diff", [], status="error",
+                summary={"error": str(exc)})), end="")
         print(f"metrics_diff: {exc}", file=sys.stderr)
         return 2
 
@@ -152,13 +170,44 @@ def main(argv=None) -> int:
     if not common:
         msg = "metrics_diff: no common metric names between snapshots"
         if args.require_common:
+            if as_json:
+                print(dump_report(build_report(
+                    "metrics-diff",
+                    [finding_row("metric-no-overlap", cand_path, 0, msg)],
+                    summary=_summary(common, [], [], []))), end="")
             print(msg, file=sys.stderr)
             return 1
-        print(msg + " (nothing compared)")
+        if as_json:
+            print(dump_report(build_report(
+                "metrics-diff", [], status="ok",
+                summary=_summary(common, [], [], []))), end="")
+        else:
+            print(msg + " (nothing compared)")
         return 0
 
     regressions, improvements, moved = compare(
         base, cand, args.tolerance, overrides)
+
+    if as_json:
+        rows = []
+        for label, severity, group in (("metric-regression", "error",
+                                        regressions),
+                                       ("metric-improvement", "info",
+                                        improvements),
+                                       ("metric-moved", "info", moved)):
+            for name, b, c, rel, tol in group:
+                rows.append(finding_row(
+                    label, cand_path, 0,
+                    f"{name}: {b:g} -> {c:g} ({rel:+.1%}, tol {tol:.0%})",
+                    severity=severity,
+                    data={"metric": name, "baseline": b, "candidate": c,
+                          "rel_change": rel, "tolerance": tol}))
+        print(dump_report(build_report(
+            "metrics-diff", rows,
+            status="findings" if regressions else "ok",
+            summary=_summary(common, regressions, improvements, moved))),
+            end="")
+        return 1 if regressions else 0
 
     def show(rows, label):
         for name, b, c, rel, tol in rows:
