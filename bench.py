@@ -518,6 +518,68 @@ def run_serving_bench() -> dict:
     }
 
 
+def run_serving_prefix_bench() -> dict:
+    """Shared-prefix serving A/B: the same K-families x N-requests trace
+    through the chunked-prefill engine with the prefix cache on vs off.
+    The headline is the fraction of prefill tokens the cache saved
+    (higher is better); detail carries both arms' ITL p95 and the greedy
+    bit-identity check — a caching regression shows up as a saved-frac
+    drop or an outputs_identical flip, both gateable."""
+    import jax
+    from dla_tpu.eval.eval_latency import measure_shared_prefix
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    if on_accel:
+        cfg = ModelConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=24, num_heads=8, num_kv_heads=4,
+            max_seq_length=2048, attention="flash", remat="none",
+            dtype="bfloat16", param_dtype="bfloat16")
+        srv = {"arrival_rate": 64.0, "new_tokens": 32,
+               "page_size": 16, "num_pages": 1024, "num_slots": 8,
+               "max_model_len": 256,
+               "chunked_prefill": {"chunk": 32},
+               "shared_prefix": {"families": 8, "requests_per_family": 16,
+                                 "prefix_len": 96, "suffix_len": 16}}
+    else:
+        cfg = ModelConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=192,
+            num_layers=2, num_heads=4, num_kv_heads=4,
+            max_seq_length=128, remat="none", dtype="float32",
+            param_dtype="float32")
+        srv = {"arrival_rate": 1000.0, "new_tokens": 4,
+               "page_size": 4, "num_pages": 96, "num_slots": 4,
+               "max_model_len": 32,
+               "chunked_prefill": {"chunk": 8},
+               "shared_prefix": {"families": 4, "requests_per_family": 6,
+                                 "prefix_len": 16, "suffix_len": 4}}
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    row = measure_shared_prefix(model, params, srv)
+    return {
+        "metric": "serving_prefill_tokens_saved_frac",
+        "value": round(row["prefill_tokens_saved_frac"], 4),
+        "unit": "frac",
+        "detail": {
+            "cache_hit_rate": round(row["cache_hit_rate"], 4),
+            "outputs_identical": bool(row["outputs_identical"]),
+            "itl_ms_p95_cache_on": round(row["itl_ms_p95_cache_on"], 3),
+            "itl_ms_p95_cache_off": round(row["itl_ms_p95_cache_off"], 3),
+            "ttft_ms_p95_cache_on": round(row["ttft_ms_p95_cache_on"], 2),
+            "ttft_ms_p95_cache_off": round(
+                row["ttft_ms_p95_cache_off"], 2),
+            "cache_evictions": int(row["cache_evictions"]),
+            "families": row["families"],
+            "requests_per_family": row["requests_per_family"],
+            "prefix_len": row["prefix_len"],
+            "suffix_len": row["suffix_len"],
+            "prefill_chunk": row["prefill_chunk"],
+            "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def run_resilience_bench() -> dict:
     """Recovery-overhead microbench for the fault-tolerance stack
     (dla_tpu/resilience): one tiny SFT run with an injected checkpoint
@@ -810,7 +872,8 @@ def _emit_and_maybe_extra() -> None:
     if not os.environ.get("DLA_BENCH_EXTRA"):
         return
     extra = [headline]
-    for fn in (run_ppo_bench, run_decode_bench, run_serving_bench):
+    for fn in (run_ppo_bench, run_decode_bench, run_serving_bench,
+               run_serving_prefix_bench):
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — extras must not kill the line

@@ -38,6 +38,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="also run the continuous-batching serving engine "
                         "on a synthetic Poisson arrival trace (equivalent "
                         "to latency.serving.enabled: true)")
+    p.add_argument("--shared-prefix", action="store_true",
+                   help="also run the shared-prefix serving A/B: K prompt "
+                        "families x N requests each, prefix cache on vs "
+                        "off on the SAME trace (equivalent to "
+                        "latency.serving.shared_prefix.enabled: true)")
     return p.parse_args(argv)
 
 
@@ -130,6 +135,60 @@ def measure_decode(model, params, batch_size: int, prompt_len: int,
     }
 
 
+def _serving_config(srv: Dict, **overrides):
+    """Build a ServingConfig from a ``latency.serving`` mapping —
+    including the nested ``prefix_cache:`` / ``chunked_prefill:``
+    blocks — with keyword overrides applied last."""
+    from dla_tpu.serving import ServingConfig
+
+    pc = srv.get("prefix_cache") or {}
+    cp = srv.get("chunked_prefill") or {}
+    kw = dict(
+        page_size=int(srv.get("page_size", 16)),
+        num_pages=int(srv.get("num_pages", 256)),
+        num_slots=int(srv.get("num_slots", 8)),
+        max_model_len=int(srv.get("max_model_len", 256)),
+        max_prefill_batch=int(srv.get("max_prefill_batch", 4)),
+        prefill_chunk=int(cp.get("chunk", 0)),
+        prefill_token_budget=int(cp.get("token_budget", 0)),
+        prefix_cache=bool(pc.get("enabled", False)),
+        cached_logits_capacity=int(pc.get("cached_logits_capacity", 128)),
+        # pass through the trainer-style profiling window ({trace_dir,
+        # start_step, num_steps}) — an xplane trace of the measured
+        # serving run is one config key away
+        profile=srv.get("profile"))
+    kw.update(overrides)
+    return ServingConfig(**kw)
+
+
+def _drive_open_loop(eng, prompts: List[List[int]], arrivals: np.ndarray,
+                     new_tokens: int) -> tuple:
+    """Open-loop drive: submit each prompt at its SCHEDULED arrival time
+    (so queueing delay under load is measured, not hidden), step the
+    engine whenever it has work, idle-spin otherwise. Returns
+    ``(duration_s, outputs)`` where outputs[i] is the generated token
+    list of prompts[i], collected from the streaming surface."""
+    n = len(prompts)
+    order: List[int] = []
+    toks: Dict[int, List[int]] = {}
+    t0 = time.perf_counter()
+    submitted = 0
+    while submitted < n or eng.has_work():
+        now = time.perf_counter() - t0
+        while submitted < n and arrivals[submitted] <= now:
+            rid = eng.submit(prompts[submitted], new_tokens,
+                             arrival_time=t0 + arrivals[submitted])
+            order.append(rid)
+            toks[rid] = []
+            submitted += 1
+        if not eng.has_work():
+            continue   # open-loop: idle-spin until the next arrival
+        for rid, tok in eng.step():
+            toks[rid].append(tok)
+    dt = time.perf_counter() - t0
+    return dt, [toks[r] for r in order]
+
+
 def measure_serving(model, params, srv: Dict) -> Dict[str, float]:
     """Continuous-batching engine under a synthetic Poisson arrival
     trace: per-request TTFT and inter-token-latency percentiles
@@ -146,16 +205,7 @@ def measure_serving(model, params, srv: Dict) -> Dict[str, float]:
     pmax = int(srv.get("prompt_len_max", 64))
     gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
                            eos_token_id=-1)          # run to length
-    scfg = ServingConfig(
-        page_size=int(srv.get("page_size", 16)),
-        num_pages=int(srv.get("num_pages", 256)),
-        num_slots=int(srv.get("num_slots", 8)),
-        max_model_len=int(srv.get("max_model_len", 256)),
-        max_prefill_batch=int(srv.get("max_prefill_batch", 4)),
-        # pass through the trainer-style profiling window ({trace_dir,
-        # start_step, num_steps}) — an xplane trace of the measured
-        # serving run is one config key away
-        profile=srv.get("profile"))
+    scfg = _serving_config(srv)
     eng = ServingEngine(model, params, gen, scfg)
     rs = np.random.RandomState(int(srv.get("seed", 0)))
     prompts = [list(rs.randint(3, model.cfg.vocab_size - 1,
@@ -175,18 +225,7 @@ def measure_serving(model, params, srv: Dict) -> Dict[str, float]:
     from dla_tpu.serving.metrics import ServingMetrics
     eng.metrics = ServingMetrics()
 
-    t0 = time.perf_counter()
-    submitted = 0
-    while submitted < n or eng.has_work():
-        now = time.perf_counter() - t0
-        while submitted < n and arrivals[submitted] <= now:
-            eng.submit(prompts[submitted], new_tokens,
-                       arrival_time=t0 + arrivals[submitted])
-            submitted += 1
-        if not eng.has_work():
-            continue   # open-loop: idle-spin until the next arrival
-        eng.step()
-    dt = time.perf_counter() - t0
+    dt, _ = _drive_open_loop(eng, prompts, arrivals, new_tokens)
     snap = eng.metrics.snapshot()
     return {
         "num_requests": n,
@@ -207,6 +246,85 @@ def measure_serving(model, params, srv: Dict) -> Dict[str, float]:
         "queue_wait_ms_p99": snap["serving/queue_wait_ms_p99"],
         "preemptions": snap["serving/preemptions"],
         "page_occupancy_peak": snap["serving/page_occupancy_peak"],
+        "prefill_chunks": snap["serving/prefill/chunks"],
+        "prefill_tokens_saved": snap["serving/prefill/tokens_saved"],
+        "prefix_cache_hit_tokens": snap["serving/prefix_cache/hit_tokens"],
+    }
+
+
+def measure_shared_prefix(model, params, srv: Dict) -> Dict[str, object]:
+    """Shared-prefix A/B: K prompt families x N requests per family, the
+    SAME prompts and arrival schedule driven through two engines — prefix
+    cache ON vs OFF (both chunked-prefill, both greedy). Reports the
+    cache hit rate, the fraction of prefill tokens the cache saved, TTFT
+    p50/p95 and ITL p95 for both arms, and whether the generated tokens
+    are bit-identical (greedy decode must not change under caching)."""
+    from dla_tpu.serving import ServingEngine
+    from dla_tpu.serving.metrics import ServingMetrics
+
+    sp = srv.get("shared_prefix") or {}
+    families = int(sp.get("families", 8))
+    per_family = int(sp.get("requests_per_family", 16))
+    prefix_len = int(sp.get("prefix_len", 48))
+    suffix_len = int(sp.get("suffix_len", 16))
+    new_tokens = int(srv.get("new_tokens", 32))
+    rate = float(srv.get("arrival_rate", 16.0))
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)          # greedy, run to length
+    rs = np.random.RandomState(int(srv.get("seed", 0)))
+    vocab = model.cfg.vocab_size
+    prompts: List[List[int]] = []
+    for _ in range(families):
+        head = [int(t) for t in rs.randint(3, vocab - 1, (prefix_len,))]
+        for _ in range(per_family):
+            prompts.append(head + [int(t) for t in
+                                   rs.randint(3, vocab - 1, (suffix_len,))])
+    n = len(prompts)
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n))
+    prompt_tokens = sum(len(p) for p in prompts)
+    cp = srv.get("chunked_prefill") or {}
+    # chunked prefill is what MAKES hits reusable (absolute chunk
+    # schedule) — default a chunk on if the config didn't pick one
+    chunk = int(cp.get("chunk", 0)) or 2 * int(srv.get("page_size", 16))
+
+    def run_arm(cache_on: bool):
+        eng = ServingEngine(model, params, gen, _serving_config(
+            srv, prefill_chunk=chunk, prefix_cache=cache_on))
+        # compile warmup (chunk fn + decode), off the clock; random
+        # tokens can't collide with a family prefix, so the cache stays
+        # cold for the measured trace
+        eng.submit([int(t) for t in
+                    rs.randint(3, vocab - 1, (chunk + 1,))], 1)
+        eng.run_until_drained()
+        eng.metrics = ServingMetrics()
+        dt, outs = _drive_open_loop(eng, prompts, arrivals, new_tokens)
+        return dt, outs, eng.metrics.snapshot()
+
+    dt_on, outs_on, snap_on = run_arm(True)
+    dt_off, outs_off, snap_off = run_arm(False)
+    saved = snap_on["serving/prefill/tokens_saved"]
+    hit_tok = snap_on["serving/prefix_cache/hit_tokens"]
+    return {
+        "families": families,
+        "requests_per_family": per_family,
+        "prefix_len": prefix_len,
+        "suffix_len": suffix_len,
+        "new_tokens": new_tokens,
+        "prefill_chunk": chunk,
+        "prompt_tokens": prompt_tokens,
+        "outputs_identical": outs_on == outs_off,
+        "cache_hit_rate": hit_tok / max(prompt_tokens, 1),
+        "prefill_tokens_saved_frac": saved / max(prompt_tokens, 1),
+        "cache_lookups": snap_on["serving/prefix_cache/lookups"],
+        "cache_evictions": snap_on["serving/prefix_cache/evictions"],
+        "ttft_ms_p50_cache_on": snap_on["serving/ttft_ms_p50"],
+        "ttft_ms_p95_cache_on": snap_on["serving/ttft_ms_p95"],
+        "ttft_ms_p50_cache_off": snap_off["serving/ttft_ms_p50"],
+        "ttft_ms_p95_cache_off": snap_off["serving/ttft_ms_p95"],
+        "itl_ms_p95_cache_on": snap_on["serving/itl_ms_p95"],
+        "itl_ms_p95_cache_off": snap_off["serving/itl_ms_p95"],
+        "duration_s_cache_on": dt_on,
+        "duration_s_cache_off": dt_off,
     }
 
 
@@ -262,6 +380,18 @@ def main(argv=None) -> None:
                     f"itl p50 {entry['serving']['itl_ms_p50']:.2f} "
                     f"p99 {entry['serving']['itl_ms_p99']:.2f} ms "
                     f"({entry['serving']['preemptions']:.0f} preemptions)")
+            if args.shared_prefix or \
+                    (srv.get("shared_prefix") or {}).get("enabled", False):
+                entry["shared_prefix"] = measure_shared_prefix(
+                    bundle.model, bundle.params, srv)
+                spr = entry["shared_prefix"]
+                log_rank_zero(
+                    f"[dla_tpu][latency] shared-prefix: hit rate "
+                    f"{spr['cache_hit_rate']:.2f} saved "
+                    f"{spr['prefill_tokens_saved_frac']:.2f} of prefill, "
+                    f"ttft p95 {spr['ttft_ms_p95_cache_on']:.1f} ms (on) "
+                    f"vs {spr['ttft_ms_p95_cache_off']:.1f} ms (off), "
+                    f"outputs identical: {spr['outputs_identical']}")
         finally:
             # a mid-grid failure must not lose the already-captured trace
             if trace_dir:

@@ -123,21 +123,49 @@ def build_decode_step(model: Transformer, gen: GenerationConfig):
     return decode_step
 
 
-def build_generate_fn(model: Transformer, gen: GenerationConfig):
+def build_generate_fn(model: Transformer, gen: GenerationConfig,
+                      group_size: int = 1):
     """Returns a jittable ``fn(params, input_ids, attention_mask, rng)`` ->
     dict of device arrays:
 
       sequences/sequence_mask  [B, P+N]  prompt + response, left-aligned
       response_tokens/response_mask [B, N]
       lengths [B] total real tokens (prompt + generated, incl. eos)
-    """
+
+    ``group_size`` G > 1 is the GRPO/best-of-N rollout shape: the caller
+    passes B UNIQUE prompts, each prompt is prefilled ONCE, and the
+    prefill outputs (logits + KV cache) are expanded G-fold before
+    decode — G samples per prompt for one prompt's prefill FLOPs (the
+    serving engine's prefix cache, done in-graph). Outputs are laid out
+    grouped ([p0 s0..sG-1, p1 s0..sG-1, ...]) and bit-identical to
+    submitting each prompt G times in that same [B*G] batch order: the
+    per-row decode math is batch-independent and the rng stream is keyed
+    by absolute step, so only the (deduplicated) prefill differs."""
     single_step = build_decode_step(model, gen)
+
+    def _expand(leaf):
+        # cache leaves: pooled KV [L, B, S, KH, D] / int8 scales
+        # [L, B, KH, S] carry batch at axis 1; per-row metadata
+        # (valid/pos [B, S], lengths [B]) at axis 0; scalars
+        # (step, prompt_width) are batch-free
+        if leaf.ndim >= 4:
+            return jnp.repeat(leaf, group_size, axis=1)
+        if leaf.ndim >= 1:
+            return jnp.repeat(leaf, group_size, axis=0)
+        return leaf
 
     def generate(params, input_ids, attention_mask, rng):
         b, p_width = input_ids.shape
         n = gen.max_new_tokens
         logits, cache = model.start_decode(
             params, input_ids, attention_mask, n)
+        if group_size > 1:
+            logits = jnp.repeat(logits, group_size, axis=0)
+            cache = jax.tree_util.tree_map(_expand, cache)
+            input_ids = jnp.repeat(input_ids, group_size, axis=0)
+            attention_mask = jnp.repeat(attention_mask, group_size,
+                                        axis=0)
+            b = b * group_size
 
         rngs = jax.random.split(rng, n)
         done0 = jnp.zeros((b,), bool)

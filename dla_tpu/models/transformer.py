@@ -1743,6 +1743,57 @@ class Transformer:
         logits = self.unembed(params, h[:, 0])
         return logits, k_cols, v_cols
 
+    def prefill_step_paged(self, params: Params, view: Params,
+                           tokens: jnp.ndarray,     # [B, C] chunk tokens
+                           positions: jnp.ndarray,  # [B, C] absolute pos
+                           last_index: jnp.ndarray,  # [B] last real token
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One fixed-width prefill CHUNK against an externally-gathered
+        KV view — the chunked-prefill sibling of ``decode_step_paged``.
+        The chunk's C queries attend jointly over (a) the already-
+        computed prefix held in the paged pool, gathered into the view
+        with ``valid`` marking exactly the columns BEFORE this chunk,
+        and (b) the chunk's own fresh keys, causally by absolute
+        position (pad tokens carry later positions than every real
+        query, so they mask themselves out). Returns
+        (logits [B, V] — the next-token distribution after the token at
+        ``last_index``, only meaningful on the FINAL chunk —
+        k_cols/v_cols [L, B, C, KH, D] for the caller to scatter into
+        the pool; pad columns route to the trash page)."""
+        cfg = self.cfg
+        if self._kv_int8:
+            raise NotImplementedError(
+                "prefill_step_paged serves activation-dtype pages; "
+                "kv_cache_dtype=int8 is only wired into the contiguous "
+                "path")
+        b, c = tokens.shape
+        x = self._embed(params, tokens)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
+        from dla_tpu.ops.attention import block_decode_attention
+
+        def body(carry, xs):
+            layer, k_cache, v_cache = xs
+
+            def attend(q, k, v):
+                return block_decode_attention(
+                    q, k_cache, v_cache, k, v,
+                    kv_valid=view["valid"],
+                    q_positions=positions, kv_positions=view["pos"],
+                    window=self._layer_window(layer),
+                    softmax_scale=self._softmax_scale,
+                    logit_softcap=cfg.attn_logit_softcap)
+
+            return self._decode_layer(layer, carry, cos, sin, attend)
+
+        xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
+              view["k"], view["v"])
+        x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
+        h = self._final_norm(params, x)                     # [B, C, H]
+        last = h[jnp.arange(b), last_index]                 # [B, H]
+        logits = self.unembed(params, last)
+        return logits, k_cols, v_cols
+
     def start_decode(self, params: Params, input_ids: jnp.ndarray,
                      attention_mask: jnp.ndarray, max_new_tokens: int,
                      ) -> Tuple[jnp.ndarray, Params]:

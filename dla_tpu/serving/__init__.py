@@ -19,6 +19,7 @@ from dla_tpu.serving.kv_blocks import (
     PageAllocator,
     PagedKVCache,
     PageGeometry,
+    PrefixCache,
 )
 from dla_tpu.serving.metrics import ServingMetrics
 from dla_tpu.serving.scheduler import (
@@ -33,6 +34,7 @@ __all__ = [
     "PageAllocator",
     "PagedKVCache",
     "PageGeometry",
+    "PrefixCache",
     "Request",
     "RequestState",
     "Scheduler",

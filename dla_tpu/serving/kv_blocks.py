@@ -18,24 +18,45 @@ host-side bookkeeping (PageAllocator) that never touches the graph.
 Physical page 0 is RESERVED as the trash page: free slots' block tables
 point at it, so the static-shape decode step can let inactive rows
 write/read garbage there without branching. The allocator never hands
-page 0 out.
+page 0 out and the prefix cache never indexes it.
+
+THE POOL DOUBLES AS A PREFIX CACHE. Pages are refcounted: several block
+tables may alias one physical page when their requests share a token
+prefix (KV content is position-dependent but prefix-determined, so equal
+prefixes mean bit-equal pages). When the last reference drops, a page
+that the :class:`PrefixCache` still indexes is RETAINED on an LRU list
+instead of freed — zero extra memory, the cache simply delays reuse.
+Allocation under pressure reclaims retained pages LRU-first, unindexing
+them as it goes, so a busy pool degrades gracefully to the uncached
+behavior. Every page is always in exactly one of three states: free,
+used (refcount >= 1), or cached (refcount 0, content retained).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from collections import OrderedDict
+from typing import (Callable, Dict, List, Optional, Sequence, Tuple)
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 
 class PageAllocator:
-    """Host-side free-list allocator over the fixed page pool.
+    """Host-side refcounted free-list allocator over the fixed page pool.
 
     Pages are fixed-size, so there is no external fragmentation — any
     interleaving of alloc/free keeps every free page usable. Allocation
     is all-or-nothing: a request that cannot get ALL ``n`` pages gets
     none (no partial reservations to unwind on admission failure).
+
+    ``alloc`` hands out pages at refcount 1; ``incref`` lets another
+    block table alias a page (prefix sharing); ``decref``/``free`` drop
+    references. A page reaching refcount 0 normally returns to the free
+    list, but when ``retain_hook`` claims it (the prefix cache still
+    indexes its content) it parks on an LRU cached list instead —
+    revivable by ``incref`` (a cache hit) and reclaimable by ``alloc``
+    under pressure (``evict_hook`` fires so the index forgets it).
     """
 
     def __init__(self, num_pages: int):
@@ -45,6 +66,16 @@ class PageAllocator:
         # page 0 reserved: free slots alias it for garbage traffic
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._used: set = set()
+        self._ref: Dict[int, int] = {}
+        # refcount-0 pages whose content the prefix cache still indexes,
+        # insertion-ordered: front = least recently released = evicted
+        # first when alloc outruns the free list
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # policy hooks the PrefixCache installs; absent hooks give the
+        # plain uncached allocator (decref-0 always frees)
+        self.retain_hook: Optional[Callable[[int], bool]] = None
+        self.evict_hook: Optional[Callable[[int], None]] = None
+        self.cache_evictions = 0
 
     @property
     def free_count(self) -> int:
@@ -55,34 +86,98 @@ class PageAllocator:
         return len(self._used)
 
     @property
+    def cached_count(self) -> int:
+        return len(self._cached)
+
+    @property
     def capacity(self) -> int:
         """Allocatable pages (excludes the reserved trash page)."""
         return self.num_pages - 1
 
     @property
     def occupancy(self) -> float:
-        """Fraction of allocatable pages currently owned."""
+        """Fraction of allocatable pages currently owned (cached pages
+        are reclaimable, so they count as free here)."""
         return self.used_count / max(1, self.capacity)
 
+    @property
+    def refcounts(self) -> Dict[int, int]:
+        """Copy of the live page -> refcount map (invariant checks)."""
+        return dict(self._ref)
+
+    @property
+    def cached_pages(self) -> List[int]:
+        """LRU-ordered refcount-0 retained pages (eviction order)."""
+        return list(self._cached)
+
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= len(self._free) + len(self._cached)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` pages, or None if the pool cannot supply all of them."""
+        """``n`` pages at refcount 1, or None if free + reclaimable
+        cached pages cannot supply all of them. Reclaims cached pages
+        LRU-first, unindexing each via ``evict_hook``."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if n > len(self._free) + len(self._cached):
             return None
-        pages = [self._free.pop() for _ in range(n)]
-        self._used.update(pages)
+        pages: List[int] = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.pop()
+            else:
+                p, _ = self._cached.popitem(last=False)
+                self.cache_evictions += 1
+                if self.evict_hook is not None:
+                    self.evict_hook(p)
+            self._used.add(p)
+            self._ref[p] = 1
+            pages.append(p)
         return pages
 
+    def incref(self, page: int) -> None:
+        """Add a reference: another block table now aliases ``page``.
+        Reviving a cached page (a prefix-cache hit) moves it back to the
+        used state."""
+        if page in self._ref:
+            self._ref[page] += 1
+        elif page in self._cached:
+            del self._cached[page]
+            self._used.add(page)
+            self._ref[page] = 1
+        else:
+            raise ValueError(f"incref of free/foreign page {page}")
+
+    def decref(self, page: int) -> None:
+        """Drop a reference. At refcount 0 the page frees — unless the
+        retain hook claims it for the prefix cache, in which case it
+        parks on the cached LRU list (most-recently-released last)."""
+        if page not in self._ref:
+            raise ValueError(f"double free / foreign page {page}")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            del self._ref[page]
+            self._used.discard(page)
+            if self.retain_hook is not None and self.retain_hook(page):
+                self._cached[page] = None
+            else:
+                self._free.append(page)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
     def free(self, pages: List[int]) -> None:
+        """Drop one reference per page (the historical bulk-release
+        surface; exact old behavior when nothing is shared)."""
         for p in pages:
-            if p not in self._used:
-                raise ValueError(f"double free / foreign page {p}")
-            self._used.discard(p)
-            self._free.append(p)
+            self.decref(p)
+
+    def uncache(self, page: int) -> None:
+        """Drop a retained refcount-0 page straight to the free list
+        (its index entry is gone, so there is nothing to hit)."""
+        if page in self._cached:
+            del self._cached[page]
+            self._free.append(page)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +197,184 @@ class PageGeometry:
     def pages_for(self, n_tokens: int) -> int:
         """Pages needed to hold ``n_tokens`` (ceil)."""
         return -(-n_tokens // self.page_size)
+
+
+@dataclasses.dataclass
+class _FullEntry:
+    """Full-exact-prompt cache entry: the partial tail page (None when
+    the prompt is page-aligned) plus the last-token prefill logits, so a
+    repeat of the exact prompt skips prefill entirely."""
+    tail_page: Optional[int]
+    logits: np.ndarray
+
+
+class PrefixCache:
+    """Content-addressed index over the pool's pages.
+
+    Two granularities:
+
+    * **Full pages** — ``_index`` maps the exact token tuple of a
+      page-aligned prefix to the physical page holding its KV. Keys are
+      the tokens themselves (no hashing), so a hit is a guarantee, never
+      a collision. A lookup walks prefixes page by page and stops at the
+      first miss, so an interior eviction simply shortens later hits
+      (orphaned longer entries age out via the allocator's LRU).
+    * **Exact full prompts** — ``_full`` additionally remembers the
+      partial tail page and the last-token prefill LOGITS for recently
+      completed prompts (LRU-capped), so an identical prompt skips
+      prefill completely: all pages alias (including the partial tail,
+      which copy-on-write protects once decode writes into it) and the
+      first token samples from the stored logits.
+
+    The cache holds NO references itself: retention of refcount-0 pages
+    happens through the allocator hooks installed here, and the pool
+    reclaims retained pages LRU-first under allocation pressure.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 logits_capacity: int = 128):
+        self.allocator = allocator
+        self.page_size = page_size
+        self.logits_capacity = max(1, int(logits_capacity))
+        self._index: Dict[Tuple[int, ...], int] = {}
+        # page -> ("page" | "tail", key): which entry retains this page
+        self._page_key: Dict[int, Tuple[str, Tuple[int, ...]]] = {}
+        self._full: "OrderedDict[Tuple[int, ...], _FullEntry]" = \
+            OrderedDict()
+        self.lookups = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+        allocator.retain_hook = self._retain
+        allocator.evict_hook = self._on_evict
+
+    # ------------------------------------------------------------- hooks
+
+    def _retain(self, page: int) -> bool:
+        return page in self._page_key
+
+    def _on_evict(self, page: int) -> None:
+        """The allocator reclaimed a retained page: forget its entry.
+        Children of an evicted interior page stay indexed — harmlessly,
+        since lookups walk from the start and stop at the hole."""
+        self.evictions += 1
+        kind, key = self._page_key.pop(page)
+        if kind == "page":
+            if self._index.get(key) == page:
+                del self._index[key]
+        else:
+            self._full.pop(key, None)
+
+    # ----------------------------------------------------------- queries
+
+    def is_indexed(self, page: int) -> bool:
+        """True when the cache indexes ``page``'s content — writing to
+        it would corrupt future hits, so writers must copy first."""
+        return page in self._page_key
+
+    def lookup(self, tokens: Sequence[int], chunk: int
+               ) -> Tuple[List[int], int, Optional[np.ndarray]]:
+        """Longest usable cached prefix of ``tokens``.
+
+        Returns ``(pages, hit_len, logits)`` with every returned page
+        ALREADY increfed (the caller decrefs on admission failure). An
+        exact-full-prompt hit returns every page plus the stored logits
+        (``hit_len == len(tokens)``: no prefill at all). Otherwise the
+        hit is truncated to a multiple of ``chunk`` and strictly below
+        ``len(tokens)`` — chunked prefill restarts at a fixed absolute
+        chunk boundary, which is what keeps cache-on decoding
+        bit-identical to cache-off."""
+        self.lookups += 1
+        key = tuple(tokens)
+        n = len(key)
+        ps = self.page_size
+        entry = self._full.get(key)
+        if entry is not None:
+            pages = self._assemble_full(key, entry)
+            if pages is not None:
+                self._full.move_to_end(key)
+                for p in pages:
+                    self.allocator.incref(p)
+                self.hit_tokens += n
+                return pages, n, entry.logits
+        # chunk-granular: the last token's logits must be recomputed, so
+        # the hit stays < n; chunk alignment keeps the restart boundary
+        # on the fixed absolute schedule
+        max_hit = ((n - 1) // chunk) * chunk if chunk > 0 else 0
+        pages: List[int] = []
+        k = 1
+        while k * ps <= max_hit:
+            p = self._index.get(key[:k * ps])
+            if p is None:
+                break
+            pages.append(p)
+            k += 1
+        hit = (len(pages) * ps // chunk) * chunk if chunk > 0 else 0
+        pages = pages[:hit // ps]
+        for p in pages:
+            self.allocator.incref(p)
+        self.hit_tokens += hit
+        return pages, hit, None
+
+    def _assemble_full(self, key: Tuple[int, ...], entry: _FullEntry
+                       ) -> Optional[List[int]]:
+        """All physical pages of an exact-prompt entry, or None when an
+        interior page was evicted (fall back to the chunked walk)."""
+        n, ps = len(key), self.page_size
+        pages: List[int] = []
+        for k in range(1, n // ps + 1):
+            p = self._index.get(key[:k * ps])
+            if p is None:
+                return None
+            pages.append(p)
+        if n % ps:
+            if entry.tail_page is None:
+                return None
+            pages.append(entry.tail_page)
+        return pages
+
+    # ------------------------------------------------------- registration
+
+    def register(self, tokens: Sequence[int], pages: Sequence[int],
+                 logits: Optional[np.ndarray] = None) -> None:
+        """Index a freshly prefilled prefix: one entry per FULL page
+        (first writer wins — an existing entry for the same tokens keeps
+        its page), plus, when ``logits`` is given, an exact-full-prompt
+        entry retaining the partial tail page and the last-token logits.
+        The trash page is never indexed."""
+        key = tuple(tokens)
+        n, ps = len(key), self.page_size
+        for k in range(1, n // ps + 1):
+            sub = key[:k * ps]
+            page = pages[k - 1]
+            if sub in self._index or page == 0:
+                continue
+            self._index[sub] = page
+            self._page_key[page] = ("page", sub)
+        if logits is None or key in self._full:
+            return
+        tail: Optional[int] = None
+        if n % ps:
+            tail = pages[n // ps]
+            if tail == 0:
+                return
+            self._page_key[tail] = ("tail", key)
+        self._full[key] = _FullEntry(tail, np.asarray(logits))
+        while len(self._full) > self.logits_capacity:
+            old_key, old = self._full.popitem(last=False)
+            if old.tail_page is not None and \
+                    self._page_key.get(old.tail_page) == ("tail", old_key):
+                del self._page_key[old.tail_page]
+                self.allocator.uncache(old.tail_page)
+
+
+@jax.jit
+def copy_page(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+              src, dst) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Device-side physical page copy — the copy-on-write primitive.
+    ``src``/``dst`` are traced scalars, so this compiles once per pool
+    shape no matter which pages get copied."""
+    return (k_pages.at[:, dst].set(k_pages[:, src]),
+            v_pages.at[:, dst].set(v_pages[:, src]))
 
 
 class PagedKVCache:
@@ -156,6 +429,34 @@ class PagedKVCache:
         self.lengths[slot] = prompt_len
         self.tokens[slot] = first_token
 
+    def open_slot_prefill(self, slot: int, pages: List[int],
+                          cached_len: int) -> None:
+        """Bind ``pages`` for a CHUNKED prefill: columns [0, cached_len)
+        are shared cache pages, already valid and attendable; later
+        columns become valid as chunks scatter into them
+        (``mark_computed``). ``lengths`` stays 0 — the slot joins the
+        decode batch only at ``begin_decode``."""
+        self.block_tables[slot] = 0
+        self.block_tables[slot, :len(pages)] = pages
+        self.valid[slot] = False
+        self.valid[slot, :cached_len] = True
+        self.pos[slot] = np.arange(self.geom.slot_window)
+        self.lengths[slot] = 0
+        self.tokens[slot] = 0
+
+    def mark_computed(self, slot: int, start: int, count: int) -> None:
+        """A prefill chunk scattered columns [start, start+count)."""
+        self.valid[slot, start:start + count] = True
+
+    def begin_decode(self, slot: int, prompt_len: int,
+                     first_token: int) -> None:
+        """Prefill complete (chunked or fully cached): the slot enters
+        the decode batch at position ``prompt_len`` with ``first_token``
+        as its next input."""
+        self.valid[slot, :prompt_len] = True
+        self.lengths[slot] = prompt_len
+        self.tokens[slot] = first_token
+
     def close_slot(self, slot: int) -> None:
         """Reset a slot to trash-page aliasing (pages are freed by the
         scheduler, which owns the request -> pages mapping)."""
@@ -180,3 +481,14 @@ class PagedKVCache:
         """Block-table index the NEXT decode write for ``slot`` needs
         (its write column / page_size)."""
         return int(self.lengths[slot]) // self.geom.page_size
+
+    def cow_page(self, slot: int, page_index: int, new_page: int) -> None:
+        """Copy-on-write: duplicate the physical page behind
+        ``block_tables[slot, page_index]`` into ``new_page`` on device
+        and repoint the table — the shared original stays pristine for
+        its other readers and the index."""
+        src = int(self.block_tables[slot, page_index])
+        self.k_pages, self.v_pages = copy_page(
+            self.k_pages, self.v_pages,
+            jnp.asarray(src, jnp.int32), jnp.asarray(new_page, jnp.int32))
+        self.block_tables[slot, page_index] = new_page

@@ -47,6 +47,14 @@ class ServingMetrics:
         self.decode_steps = r.counter("serving/decode_steps")
         self.prefill_batches = r.counter("serving/prefill_batches")
         self.tokens_generated = r.counter("serving/tokens_generated")
+        self.prefix_lookups = r.counter("serving/prefix_cache/lookups")
+        self.prefix_hit_tokens = r.counter(
+            "serving/prefix_cache/hit_tokens")
+        self.prefix_evictions = r.counter(
+            "serving/prefix_cache/evictions")
+        self.prefill_chunks = r.counter("serving/prefill/chunks")
+        self.prefill_tokens_saved = r.counter(
+            "serving/prefill/tokens_saved")
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {
@@ -66,6 +74,15 @@ class ServingMetrics:
             "serving/decode_steps": float(self.decode_steps.value),
             "serving/prefill_batches": float(self.prefill_batches.value),
             "serving/tokens_generated": float(self.tokens_generated.value),
+            "serving/prefix_cache/lookups": float(
+                self.prefix_lookups.value),
+            "serving/prefix_cache/hit_tokens": float(
+                self.prefix_hit_tokens.value),
+            "serving/prefix_cache/evictions": float(
+                self.prefix_evictions.value),
+            "serving/prefill/chunks": float(self.prefill_chunks.value),
+            "serving/prefill/tokens_saved": float(
+                self.prefill_tokens_saved.value),
         }
         out.update(self.ttft_ms.summary("serving/ttft_ms_"))
         out.update(self.itl_ms.summary("serving/itl_ms_"))

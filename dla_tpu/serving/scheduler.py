@@ -6,22 +6,36 @@ States:  WAITING -> PREFILL -> DECODE -> FINISHED
                         ^         |
                         +-- EVICTED (preempted on page-pool OOM; the
                             request keeps its generated tokens, re-enters
-                            the queue head, and RECOMPUTES its whole
-                            prefix — prompt + generated-so-far — on
-                            re-admission)
+                            the queue head, and RECOMPUTES its prefix —
+                            prompt + generated-so-far — on re-admission;
+                            with the prefix cache on, the recompute
+                            restarts from the longest still-cached
+                            chunk-aligned prefix, not from token 0)
 
-Admission policy: FCFS with LONGEST-PREFIX BUCKETING — the queue head
-fixes the prefill bucket (prompt width rounded up to a power-of-two page
-count), then a bounded lookahead pulls queued requests that pad to the
-same bucket into the same prefill batch. One compiled prefill per bucket
-width, full FCFS fairness for the head, and the lookahead bound keeps a
-stream of short prompts from starving a long one.
+Admission policy (monolithic prefill): FCFS with LONGEST-PREFIX
+BUCKETING — the queue head fixes the prefill bucket (prompt width
+rounded up to a power-of-two page count), then a bounded lookahead pulls
+queued requests that pad to the same bucket into the same prefill batch.
+One compiled prefill per bucket width, full FCFS fairness for the head,
+and the lookahead bound keeps a stream of short prompts from starving a
+long one.
+
+Admission policy (chunked prefill, ``prefill_chunk > 0``): strict FCFS,
+one request prefilling at a time. The head takes a slot plus every page
+its prompt needs up front — aliasing already-cached prefix pages via the
+:class:`~dla_tpu.serving.kv_blocks.PrefixCache` (incref, no copy) and
+allocating only the rest — then the engine advances it one fixed-shape
+chunk per engine step, co-scheduled with the running decode batch under
+``prefill_token_budget``.
 
 Backpressure: admission requires the FULL prompt page count plus one
 decode page up front (no admission that would immediately preempt
 someone). Mid-decode page exhaustion preempts the YOUNGEST running
 request (LIFO eviction — it has the least sunk compute and its
 recompute is the cheapest), freeing pages for requests ahead of it.
+Eviction is refcount-aware: a victim's shared pages just drop one
+reference, so pages another request (or the cache) still needs are
+never actually freed.
 """
 from __future__ import annotations
 
@@ -31,7 +45,7 @@ import itertools
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
-from dla_tpu.serving.kv_blocks import PagedKVCache
+from dla_tpu.serving.kv_blocks import PagedKVCache, PrefixCache
 
 
 class RequestState(enum.Enum):
@@ -61,6 +75,12 @@ class Request:
     finish_reason: Optional[str] = None   # "eos" | "length" | "timeout"
                                           # | "cancelled"
     deadline: Optional[float] = None      # absolute engine-clock cutoff
+    # chunked prefill progress: prefix tokens already in the cache pool
+    # (shared hit pages + chunks computed so far)
+    prefill_pos: int = 0
+    # exact-full-prompt cache hit: the stored last-token prefill logits
+    # (numpy [V]); decoding starts from these with no prefill at all
+    cached_logits: Optional[object] = None
     # wall-clock marks for TTFT / queue-wait / inter-token latency metrics
     admitted_time: Optional[float] = None  # first prefill admission
     first_token_time: Optional[float] = None
@@ -82,6 +102,8 @@ class SchedulerConfig:
     max_prefill_batch: int = 4     # requests per bucketed prefill call
     lookahead: int = 16            # queue scan depth for bucket-mates
     decode_reserve_pages: int = 1  # pages beyond the prompt required to admit
+    prefill_chunk: int = 0         # chunk width in tokens; 0 = monolithic
+    prefill_token_budget: int = 0  # per-engine-step token cap; 0 = none
 
 
 class Scheduler:
@@ -90,20 +112,24 @@ class Scheduler:
 
       1. ``release(req)``      for finished requests (slots/pages back)
       2. ``ensure_decode_pages()``  grow running requests' block tables,
+                                    copy-on-write shared write targets,
                                     preempting on OOM
-      3. ``next_prefill_batch()``   FCFS+bucketed admission into free
-                                    slots
+      3. ``next_prefill_batch()`` / ``admit_chunk_prefill()``
+                                    admission into free slots
     """
 
     def __init__(self, cache: PagedKVCache, cfg: SchedulerConfig,
-                 bucket_widths: List[int]):
+                 bucket_widths: List[int],
+                 prefix_cache: Optional[PrefixCache] = None):
         self.cache = cache
         self.cfg = cfg
+        self.prefix_cache = prefix_cache
         # ascending padded prompt widths (multiples of page_size); a
         # prompt buckets to the smallest width that holds it
         self.bucket_widths = sorted(bucket_widths)
         self.queue: Deque[Request] = deque()
-        self.running: Dict[int, Request] = {}   # slot -> request
+        self.running: Dict[int, Request] = {}    # slot -> request
+        self.prefilling: Dict[int, Request] = {} # slot -> mid-chunk req
         self.free_slots: List[int] = list(
             range(cache.geom.num_slots - 1, -1, -1))
         self.preemptions = 0
@@ -131,7 +157,7 @@ class Scheduler:
             f"prefix length {prefix_len} exceeds the largest prefill "
             f"bucket {self.bucket_widths[-1]}")
 
-    # ---------------------------------------------------------- admission
+    # ----------------------------------------- admission (monolithic)
 
     def next_prefill_batch(self) -> List[Request]:
         """FCFS + longest-prefix bucketing: the queue head fixes the
@@ -177,34 +203,87 @@ class Scheduler:
                 r for r in self.queue if r.rid not in picked_ids)
         return batch
 
+    # -------------------------------------------- admission (chunked)
+
+    def admit_chunk_prefill(self) -> Optional[Request]:
+        """Strict-FCFS chunked admission: at most one request is
+        mid-prefill at a time (its chunks run one per engine step). The
+        head gets a slot plus its FULL page demand up front — cached
+        prefix pages alias (incref, no copy, no recompute), only the
+        uncovered suffix and the decode reserve allocate fresh.
+
+        Returns the admitted request, or None (queue empty, no slot, a
+        request already prefilling, or the pool can't cover the fresh
+        pages — hit pages are released again on that backpressure path).
+        A returned request with ``prefill_pos == len(prefix_tokens)``
+        was an exact-full-prompt hit: ``cached_logits`` is set, no
+        prefill runs, and the engine activates it directly."""
+        if not self.queue or not self.free_slots or self.prefilling:
+            return None
+        req = self.queue[0]
+        geom = self.cache.geom
+        prefix = req.prefix_tokens
+        n = len(prefix)
+        hit_pages: List[int] = []
+        hit = 0
+        logits = None
+        if self.prefix_cache is not None:
+            hit_pages, hit, logits = self.prefix_cache.lookup(
+                prefix, self.cfg.prefill_chunk)
+        total = min(geom.pages_for(n) + self.cfg.decode_reserve_pages,
+                    geom.pages_per_slot)
+        fresh = self.cache.allocator.alloc(total - len(hit_pages))
+        if fresh is None:
+            # backpressure: give the hit references back and wait
+            for p in hit_pages:
+                self.cache.allocator.decref(p)
+            return None
+        self.queue.popleft()
+        req.pages = hit_pages + fresh        # block-table order
+        req.slot = self.free_slots.pop()
+        req.state = RequestState.PREFILL
+        req.prefill_pos = hit
+        req.cached_logits = logits
+        self.cache.open_slot_prefill(req.slot, req.pages, hit)
+        if hit < n:
+            self.prefilling[req.slot] = req
+        return req
+
     def activate(self, req: Request) -> None:
         """PREFILL -> DECODE once the engine has run the prefill forward
-        and opened the slot."""
+        (all chunks, for chunked prefill) and opened the slot."""
         req.state = RequestState.DECODE
+        self.prefilling.pop(req.slot, None)
         self.running[req.slot] = req
 
     # --------------------------------------------------- page-pool safety
 
     def ensure_decode_pages(self) -> List[Request]:
         """Before a decode step: every running request whose next write
-        column crosses into an unallocated page gets one. On exhaustion,
-        preempt the youngest running request (free its slot AND pages)
-        and retry; the preempted requests are returned (already re-queued
-        at the head, FIFO among themselves)."""
+        column crosses into an unallocated page gets one, and a next
+        write landing on a SHARED or cache-indexed page is copy-on-
+        written to a private one first (the shared original stays
+        pristine for its other readers). On exhaustion, preempt the
+        youngest running request (drop its slot AND its page references)
+        and retry; the preempted requests are returned (already
+        re-queued at the head, FIFO among themselves)."""
         evicted: List[Request] = []
         for slot in sorted(self.running):
             req = self.running.get(slot)
             if req is None:
                 continue   # evicted while growing an earlier slot
-            while self._needs_page(req):
-                page = self.cache.allocator.alloc(1)
-                if page is not None:
-                    # table entry i holds req.pages[i]; the new page
-                    # lands at the next free entry
-                    req.pages.extend(page)
-                    self.cache.block_tables[
-                        slot, len(req.pages) - 1] = page[0]
-                    continue
+            while True:
+                if self._needs_page(req):
+                    page = self.cache.allocator.alloc(1)
+                    if page is not None:
+                        # table entry i holds req.pages[i]; the new page
+                        # lands at the next free entry
+                        req.pages.extend(page)
+                        self.cache.block_tables[
+                            slot, len(req.pages) - 1] = page[0]
+                        continue
+                elif self._ensure_writable(req):
+                    break
                 victim = self._youngest_running(exclude_rid=None)
                 if victim is None or victim.rid == req.rid:
                     # nothing left to evict but this request itself:
@@ -221,6 +300,30 @@ class Scheduler:
         next_col = int(self.cache.lengths[req.slot])
         return next_col // geom.page_size >= len(req.pages)
 
+    def _ensure_writable(self, req: Request) -> bool:
+        """Copy-on-write guard: the page under this request's next
+        decode write must be exclusively owned and unindexed, or the
+        write would corrupt a page other readers / the prefix cache
+        still rely on. Returns False only when the COW copy can't get a
+        destination page (caller preempts and retries)."""
+        if self.prefix_cache is None:
+            return True
+        idx = self.cache.slot_page_index(req.slot)
+        page = int(self.cache.block_tables[req.slot, idx])
+        if page == 0:
+            return True
+        alloc = self.cache.allocator
+        if alloc.refcount(page) <= 1 and \
+                not self.prefix_cache.is_indexed(page):
+            return True
+        fresh = alloc.alloc(1)
+        if fresh is None:
+            return False
+        self.cache.cow_page(req.slot, idx, fresh[0])
+        req.pages[idx] = fresh[0]
+        alloc.decref(page)
+        return True
+
     def _youngest_running(self, exclude_rid=None) -> Optional[Request]:
         cands = [r for r in self.running.values()
                  if r.rid != exclude_rid]
@@ -229,8 +332,11 @@ class Scheduler:
         return max(cands, key=lambda r: r.rid)
 
     def evict(self, req: Request) -> None:
-        """Preempt: free slot + pages, keep generated tokens, requeue at
-        the FRONT (it was admitted before everything still waiting)."""
+        """Preempt: free slot, DROP this request's page references
+        (shared pages survive for their other holders — refcounting is
+        what makes eviction safe under prefix sharing), keep generated
+        tokens, requeue at the FRONT (it was admitted before everything
+        still waiting)."""
         self.preemptions += 1
         req.evictions += 1
         self._release_resources(req)
@@ -246,31 +352,41 @@ class Scheduler:
     def cancel(self, req: Request, reason: str,
                state: RequestState = RequestState.FINISHED) -> None:
         """Terminal removal from wherever the request currently lives —
-        the queue (waiting/evicted) or a decode slot. Generated-so-far
-        tokens stay on the request; resources go back to the pool. Used
-        for deadline expiry (state=TIMEOUT) and drain cancellation."""
+        the queue (waiting/evicted), a decode slot, or mid-chunked-
+        prefill. Generated-so-far tokens stay on the request; resources
+        go back to the pool. Used for deadline expiry (state=TIMEOUT)
+        and drain cancellation."""
         self.queue = deque(r for r in self.queue if r.rid != req.rid)
         self._release_resources(req)
         req.finish_reason = reason
         req.state = state
 
     def expired(self, now: float) -> List[Request]:
-        """Every queued or running request whose deadline has passed."""
+        """Every queued, prefilling, or running request whose deadline
+        has passed."""
         out = [r for r in self.queue
                if r.deadline is not None and now >= r.deadline]
         out += [r for r in self.running.values()
+                if r.deadline is not None and now >= r.deadline]
+        out += [r for r in self.prefilling.values()
                 if r.deadline is not None and now >= r.deadline]
         return out
 
     def _release_resources(self, req: Request) -> None:
         if req.slot is not None:
             self.running.pop(req.slot, None)
+            self.prefilling.pop(req.slot, None)
             self.cache.close_slot(req.slot)
             self.free_slots.append(req.slot)
             req.slot = None
         if req.pages:
+            # one decref per held reference: uniquely-owned pages free
+            # (or park on the cache's LRU), shared pages merely lose
+            # this holder
             self.cache.allocator.free(req.pages)
             req.pages = []
+        req.prefill_pos = 0
+        req.cached_logits = None
 
     # ------------------------------------------------------------- status
 
@@ -284,14 +400,30 @@ class Scheduler:
 
     def assert_consistent(self) -> None:
         """Slot/page accounting invariants (tests call this every step):
-        no slot leaks, no page leaks, no slot double-booked."""
+        no slot leaks, no page leaks, no slot double-booked, and — under
+        prefix sharing — reference counts exactly equal to the number of
+        block tables holding each page."""
+        from collections import Counter
         geom = self.cache.geom
-        assert len(self.free_slots) + len(self.running) == geom.num_slots, (
+        holders = list(self.running.values()) + \
+            list(self.prefilling.values())
+        assert len(self.free_slots) + len(holders) == geom.num_slots, (
             f"slot leak: {len(self.free_slots)} free + "
-            f"{len(self.running)} running != {geom.num_slots}")
+            f"{len(holders)} held != {geom.num_slots}")
         assert len(set(self.free_slots)) == len(self.free_slots)
-        assert not (set(self.free_slots) & set(self.running))
-        held = sum(len(r.pages) for r in self.running.values())
-        assert held == self.cache.allocator.used_count, (
-            f"page leak: running hold {held}, allocator says "
-            f"{self.cache.allocator.used_count}")
+        booked = set(self.running) | set(self.prefilling)
+        assert not (set(self.free_slots) & booked)
+        assert not (set(self.running) & set(self.prefilling))
+        held = Counter(p for r in holders for p in r.pages)
+        refs = self.cache.allocator.refcounts
+        assert held == Counter(refs), (
+            f"page refcount drift: requests hold {dict(held)}, "
+            f"allocator says {refs}")
+        alloc = self.cache.allocator
+        assert alloc.used_count + alloc.free_count + \
+            alloc.cached_count == alloc.capacity, (
+            f"page state leak: {alloc.used_count} used + "
+            f"{alloc.free_count} free + {alloc.cached_count} cached "
+            f"!= {alloc.capacity}")
+        assert 0 not in refs and 0 not in alloc.cached_pages, (
+            "trash page entered the allocator")

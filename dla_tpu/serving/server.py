@@ -37,7 +37,11 @@ import numpy as np
 from dla_tpu.generation.engine import GenerationConfig
 from dla_tpu.models.transformer import Transformer
 from dla_tpu.ops.sampling import sample_token
-from dla_tpu.serving.kv_blocks import PagedKVCache, PageGeometry
+from dla_tpu.serving.kv_blocks import (
+    PagedKVCache,
+    PageGeometry,
+    PrefixCache,
+)
 from dla_tpu.serving.metrics import ServingMetrics
 from dla_tpu.serving.scheduler import (
     Request,
@@ -62,6 +66,21 @@ class ServingConfig:
     lookahead: int = 16
     decode_reserve_pages: int = 1
     seed: int = 0
+    # chunked prefill: tokens per fixed-shape prefill chunk (must be a
+    # multiple of page_size); 0 keeps PR-1's monolithic bucketed prefill
+    prefill_chunk: int = 0
+    # co-scheduling cap: a prefill chunk is deferred while the running
+    # decode batch plus the chunk would exceed this many tokens per
+    # engine step (0 = no cap; a chunk always runs when nothing decodes,
+    # so the budget can't livelock prefill)
+    prefill_token_budget: int = 0
+    # share full pages of identical token prefixes across requests via
+    # block-table aliasing (requires prefill_chunk > 0: cache hits are
+    # chunk-granular so the fixed chunk schedule stays compile-stable)
+    prefix_cache: bool = False
+    # LRU cap on stored exact-full-prompt logits entries (each pins its
+    # partial tail page in the cache)
+    cached_logits_capacity: int = 128
     # same {trace_dir, start_step, num_steps} dict the trainer's
     # logging.profile takes: an xplane trace of a serving run is one
     # config flag away (windows count ENGINE steps, not tokens)
@@ -103,6 +122,22 @@ class ServingEngine:
             raise ValueError(
                 f"max_model_len ({cfg.max_model_len}) must be a positive "
                 f"multiple of page_size ({cfg.page_size})")
+        if cfg.prefill_chunk:
+            if cfg.prefill_chunk % cfg.page_size:
+                raise ValueError(
+                    f"prefill_chunk ({cfg.prefill_chunk}) must be a "
+                    f"multiple of page_size ({cfg.page_size}): chunk "
+                    "boundaries must land on page boundaries so cached "
+                    "prefixes alias whole pages")
+            if cfg.prefill_chunk > cfg.max_model_len:
+                raise ValueError(
+                    f"prefill_chunk ({cfg.prefill_chunk}) exceeds "
+                    f"max_model_len ({cfg.max_model_len})")
+        elif cfg.prefix_cache:
+            raise ValueError(
+                "prefix_cache requires prefill_chunk > 0: cache hits "
+                "are chunk-granular, so the monolithic prefill path "
+                "cannot consume them")
         self.model = model
         self.params = params
         self.gen = gen
@@ -112,13 +147,23 @@ class ServingEngine:
             page_size=cfg.page_size, num_pages=cfg.num_pages,
             num_slots=cfg.num_slots, pages_per_slot=cfg.pages_per_slot)
         self.cache = PagedKVCache(model, geom)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if cfg.prefix_cache:
+            self.prefix_cache = PrefixCache(
+                self.cache.allocator, cfg.page_size,
+                logits_capacity=cfg.cached_logits_capacity)
         self.scheduler = Scheduler(
             self.cache,
             SchedulerConfig(max_prefill_batch=cfg.max_prefill_batch,
                             lookahead=cfg.lookahead,
-                            decode_reserve_pages=cfg.decode_reserve_pages),
-            bucket_widths=self._bucket_widths(geom))
+                            decode_reserve_pages=cfg.decode_reserve_pages,
+                            prefill_chunk=cfg.prefill_chunk,
+                            prefill_token_budget=cfg.prefill_token_budget),
+            bucket_widths=self._bucket_widths(geom),
+            prefix_cache=self.prefix_cache)
         self.metrics = ServingMetrics()
+        self._pc_mirrored = {"lookups": 0, "hit_tokens": 0,
+                             "evictions": 0}
         self._results: Dict[int, Request] = {}
         self._rng = jax.random.key(cfg.seed)
         self._draining = False
@@ -160,8 +205,10 @@ class ServingEngine:
         # test asserts on
         self.decode_compiles = 0
         self.prefill_compiles = 0
+        self.prefill_chunk_compiles = 0
         self._decode = jax.jit(self._decode_fn)
         self._prefill = jax.jit(self._prefill_fn)
+        self._prefill_chunk = jax.jit(self._prefill_chunk_fn)
 
     @staticmethod
     def _bucket_widths(geom: PageGeometry) -> List[int]:
@@ -173,6 +220,20 @@ class ServingEngine:
             n *= 2
         widths.append(geom.slot_window)
         return widths
+
+    @staticmethod
+    def _dev(x: np.ndarray) -> jnp.ndarray:
+        """Device-put host scheduler metadata BY VALUE.
+
+        jnp.asarray on suitably-aligned host numpy memory may alias it
+        zero-copy, and the engine mutates these arrays in place (e.g.
+        mark_computed flips `valid` bits right after a chunk dispatch)
+        while the async computation may not have executed yet — an
+        aliased buffer makes the jitted step read torn state. Copying
+        first pins the dispatched values.
+        """
+        # dla: disable=host-sync-in-hot-loop -- host->host copy of tiny scheduler metadata (no device fetch); the copy is the race fix
+        return jnp.asarray(np.array(x))
 
     # -------------------------------------------------------- jitted steps
 
@@ -189,6 +250,48 @@ class ServingEngine:
         vs = vs.reshape(l, pb, w // ps, ps, kh, dh)
         k_pages = k_pages.at[:, page_rows].set(ks)
         v_pages = v_pages.at[:, page_rows].set(vs)
+        return k_pages, v_pages, logits
+
+    def _prefill_chunk_fn(self, params, k_pages, v_pages, btab, valid,
+                          pos, ids, start, nvalid):
+        """One FIXED-SHAPE prefill chunk for a single slot: gather the
+        slot's pages (the already-computed prefix — cached hit pages and
+        earlier chunks — with ``valid`` marking exactly the columns
+        before this chunk), run the chunk forward, scatter its C fresh
+        KV columns into the pool. ``btab`` [1, pages/slot]; ``valid``/
+        ``pos`` [1, S]; ``ids`` [1, C]; ``start``/``nvalid`` traced
+        scalars (chunk's absolute start column / real-token count), so
+        every chunk of every request reuses ONE compile. Returns
+        (k_pages, v_pages, logits [1, V]) — logits are the next-token
+        distribution after the chunk's last real token, meaningful only
+        on a request's final chunk (the only one whose logits the host
+        fetches)."""
+        self.prefill_chunk_compiles += 1  # dla: disable=trace-side-effect -- deliberate trace-time compile counter, pinned by the serving compile-once tests
+        geom = self.cache.geom
+        ps = geom.page_size
+        l = self.model.cfg.num_layers
+        c = self.cfg.prefill_chunk
+        k_view = k_pages[:, btab].reshape(
+            l, 1, geom.slot_window, *k_pages.shape[3:])
+        v_view = v_pages[:, btab].reshape(
+            l, 1, geom.slot_window, *v_pages.shape[3:])
+        view = {"k": k_view, "v": v_view, "valid": valid, "pos": pos}
+        # absolute chunk schedule: positions are fixed by `start`, so a
+        # cache hit changes WHICH chunks run, never the math inside one
+        positions = start + jnp.arange(c, dtype=jnp.int32)[None, :]
+        last_index = jnp.maximum(nvalid - 1, 0)[None]
+        logits, k_cols, v_cols = self.model.prefill_step_paged(
+            params, view, ids, positions, last_index)
+        # scatter the chunk's columns at their physical (page, offset);
+        # pad columns (index >= nvalid) route to the trash page
+        cols = start + jnp.arange(c, dtype=jnp.int32)
+        page_ids = btab[0, cols // ps]
+        offs = cols % ps
+        real = jnp.arange(c) < nvalid
+        page_ids = jnp.where(real, page_ids, 0)
+        offs = jnp.where(real, offs, 0)
+        k_pages = k_pages.at[:, page_ids, offs].set(k_cols[:, 0])
+        v_pages = v_pages.at[:, page_ids, offs].set(v_cols[:, 0])
         return k_pages, v_pages, logits
 
     def _decode_fn(self, params, k_pages, v_pages, block_tables, valid,
@@ -279,7 +382,8 @@ class ServingEngine:
         return self._results[rid]
 
     def has_work(self) -> bool:
-        return bool(self.scheduler.queue or self.scheduler.running)
+        return bool(self.scheduler.queue or self.scheduler.running
+                    or self.scheduler.prefilling)
 
     # --------------------------------------------------------- engine step
 
@@ -296,11 +400,22 @@ class ServingEngine:
             self._expire(self.now())
             for req in self.scheduler.ensure_decode_pages():
                 self.metrics.preemptions.inc()
-            self._admit(emitted)
+            if self.cfg.prefill_chunk:
+                self._admit_chunked(emitted)
+                self._chunk_step(emitted)
+                # second page-safety pass: requests admitted ABOVE (via
+                # cache hit or final chunk) decode THIS step, and their
+                # first write may land in a shared/indexed tail page —
+                # copy-on-write must run before the decode, not next step
+                for req in self.scheduler.ensure_decode_pages():
+                    self.metrics.preemptions.inc()
+            else:
+                self._admit(emitted)
             if self.scheduler.running:
                 emitted.extend(self._decode_step())
         self.engine_steps += 1
         self.readiness.beat()
+        self._mirror_cache_counters()
         m = self.metrics
         m.queue_depth.set(self.scheduler.queue_depth)
         m.active_requests.set(self.scheduler.active_count)
@@ -456,6 +571,103 @@ class ServingEngine:
             self.scheduler.activate(req)
             self._emit(req, tok, t_done, emitted, first_of_prefill=True)
 
+    def _admit_chunked(self, emitted: List[Tuple[int, int]]) -> None:
+        """Strict-FCFS chunked admission. Exact-full-prompt cache hits
+        skip prefill entirely (stored logits -> first token now) and
+        keep admitting behind them; a partial admission occupies the
+        single mid-prefill seat and stops the loop."""
+        while True:
+            req = self.scheduler.admit_chunk_prefill()
+            if req is None:
+                return
+            t = self.now()
+            if req.admitted_time is None:
+                req.admitted_time = t
+                self.metrics.queue_wait_ms.record(
+                    (t - req.arrival_time) * 1000.0)
+                if self.tracer.enabled:
+                    self.tracer.async_instant(
+                        "request", "admitted", req.rid, t=t,
+                        queue_wait_ms=(t - req.arrival_time) * 1000.0)
+            n = len(req.prefix_tokens)
+            self.metrics.prefill_tokens_saved.inc(req.prefill_pos)
+            if req.prefill_pos >= n:
+                # full hit: every prompt page aliased, first-token
+                # logits served from the cache — zero prefill FLOPs
+                # dla: disable=host-sync-in-hot-loop -- cached_logits is already host numpy (stored by register); no device fetch happens
+                logits_row = np.asarray(req.cached_logits)[None, :]
+                tok = int(self._sample_host(logits_row)[0])
+                req.cached_logits = None
+                self.cache.begin_decode(req.slot, n, tok)
+                self.scheduler.activate(req)
+                self._emit(req, tok, t, emitted, first_of_prefill=True)
+
+    def _chunk_step(self, emitted: List[Tuple[int, int]]) -> None:
+        """Advance the (single) mid-prefill request by one fixed-shape
+        chunk, co-scheduled with the running decode batch under the
+        token budget. Only the FINAL chunk's logits cross device->host
+        (the decode step's single-D2H discipline extends to prefill)."""
+        sched = self.scheduler
+        if not sched.prefilling:
+            return
+        budget = self.cfg.prefill_token_budget
+        if budget and sched.running and \
+                len(sched.running) + self.cfg.prefill_chunk > budget:
+            # decode batch fills the budget: the chunk waits a step.
+            # With no running decodes the chunk ALWAYS runs, so an
+            # undersized budget can't livelock prefill.
+            return
+        slot, req = next(iter(sched.prefilling.items()))
+        prefix = req.prefix_tokens
+        n = len(prefix)
+        start = req.prefill_pos
+        nvalid = min(self.cfg.prefill_chunk, n - start)
+        ids = np.zeros((1, self.cfg.prefill_chunk), np.int32)
+        ids[0, :nvalid] = prefix[start:start + nvalid]
+        c = self.cache
+        with annotate("serve_prefill_chunk"):
+            c.k_pages, c.v_pages, logits = self._prefill_chunk(
+                self.params, c.k_pages, c.v_pages,
+                self._dev(c.block_tables[slot:slot + 1]),
+                self._dev(c.valid[slot:slot + 1]),
+                self._dev(c.pos[slot:slot + 1]),
+                jnp.asarray(ids),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(nvalid, jnp.int32))
+        self.metrics.prefill_chunks.inc()
+        c.mark_computed(slot, start, nvalid)
+        req.prefill_pos = start + nvalid
+        if req.prefill_pos < n:
+            return
+        # dla: disable=host-sync-in-hot-loop -- designed prefill D2H: one logits fetch per REQUEST (final chunk only), not per chunk
+        logits_np = np.asarray(logits)
+        t_done = self.now()
+        self.metrics.prefill_batches.inc()
+        tok = int(self._sample_host(logits_np)[0])
+        self.cache.begin_decode(slot, n, tok)
+        if self.prefix_cache is not None:
+            # first-writer-wins: later identical prompts alias these
+            # pages; the stored logits make the NEXT identical prompt a
+            # zero-prefill full hit
+            self.prefix_cache.register(prefix, req.pages, logits_np[0])
+        self.scheduler.activate(req)
+        self._emit(req, tok, t_done, emitted, first_of_prefill=True)
+
+    def _mirror_cache_counters(self) -> None:
+        """Mirror the PrefixCache's plain-int counters into the metrics
+        registry, delta-based with engine-side marks — so a harness that
+        swaps in a fresh ServingMetrics (eval_latency does, to shed
+        warmup) sees only post-swap activity."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        m, seen = self.metrics, self._pc_mirrored
+        m.prefix_lookups.inc(pc.lookups - seen["lookups"])
+        m.prefix_hit_tokens.inc(pc.hit_tokens - seen["hit_tokens"])
+        m.prefix_evictions.inc(pc.evictions - seen["evictions"])
+        seen.update(lookups=pc.lookups, hit_tokens=pc.hit_tokens,
+                    evictions=pc.evictions)
+
     def _sample_host(self, logits: np.ndarray) -> np.ndarray:
         """Sample next tokens from prefill logits — same sampling rule as
         the decode step (ops.sampling), eager jax (once per prefill
@@ -477,9 +689,9 @@ class ServingEngine:
         with annotate("serve_decode"):
             self.cache.k_pages, self.cache.v_pages, toks = self._decode(
                 self.params, c.k_pages, c.v_pages,
-                jnp.asarray(c.block_tables), jnp.asarray(c.valid),
-                jnp.asarray(c.pos), jnp.asarray(c.lengths),
-                jnp.asarray(c.tokens), jnp.asarray(active), self._next_rng())
+                self._dev(c.block_tables), self._dev(c.valid),
+                self._dev(c.pos), self._dev(c.lengths),
+                self._dev(c.tokens), jnp.asarray(active), self._next_rng())
             # dla: disable=host-sync-in-hot-loop -- the designed single D2H per decode step (execution-model invariant)
             toks_np = np.asarray(toks)
         t_done = self.now()

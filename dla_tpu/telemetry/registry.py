@@ -219,6 +219,16 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "inter-token latency between consecutive decodes", "step"),
     _s("serving/queue_wait_ms", "histogram", "ms",
        "arrival -> first prefill admission", "step"),
+    _s("serving/prefix_cache/lookups", "counter", "lookups",
+       "prefix-cache probes at admission", "step"),
+    _s("serving/prefix_cache/hit_tokens", "counter", "tokens",
+       "prompt tokens covered by cached prefix pages", "step"),
+    _s("serving/prefix_cache/evictions", "counter", "pages",
+       "cached pages reclaimed by the allocator (LRU)", "step"),
+    _s("serving/prefill/chunks", "counter", "chunks",
+       "chunked-prefill forward passes", "step"),
+    _s("serving/prefill/tokens_saved", "counter", "tokens",
+       "prefill tokens skipped via cached prefixes", "step"),
     # -- resilience counters bridged into the registry (FuncGauge)
     _s("resilience/ckpt_saves_started", "counter", "saves"),
     _s("resilience/ckpt_saves_completed", "counter", "saves"),

@@ -331,6 +331,8 @@ class PpoSchema:
     value_clip: Any = None
     value_coef: Any = None
     rollout_quantize_weights: Any = None
+    samples_per_prompt: Any = None
+    max_prompt_length: Any = None
     generation_params: Optional[GenerationSchema] = None
 
 
@@ -377,6 +379,27 @@ class DecodeLatencySchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class PrefixCacheSchema:
+    enabled: Any = None
+    cached_logits_capacity: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkedPrefillSchema:
+    chunk: Any = None
+    token_budget: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SharedPrefixSchema:
+    enabled: Any = None
+    families: Any = None
+    requests_per_family: Any = None
+    prefix_len: Any = None
+    suffix_len: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ServingLatencySchema:
     enabled: Any = None
     arrival_rate: Any = None
@@ -391,6 +414,9 @@ class ServingLatencySchema:
     max_prefill_batch: Any = None
     lookahead: Any = None
     decode_reserve_pages: Any = None
+    prefix_cache: Optional[PrefixCacheSchema] = None
+    chunked_prefill: Optional[ChunkedPrefillSchema] = None
+    shared_prefix: Optional[SharedPrefixSchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

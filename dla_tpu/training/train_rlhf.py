@@ -285,6 +285,16 @@ def main(argv=None) -> None:
     clip_ratio = float(ppo_cfg.get("clip_ratio", 0.2))
     n_steps = int(ppo_cfg.get("steps", 1024))
     max_seq = int(model_cfg.get("max_seq_length", 1024))
+    # ppo.samples_per_prompt G > 1: GRPO/best-of-N rollout shape — each
+    # rollout batch holds batch_size/G unique prompts, each prefilled
+    # ONCE and expanded G-fold in-graph before decode (the generation
+    # analog of the serving engine's prefix cache: G samples per prompt
+    # for one prompt's prefill FLOPs). Bit-identical to submitting each
+    # prompt G times in the same batch order.
+    samples_per_prompt = int(ppo_cfg.get("samples_per_prompt", 1))
+    if samples_per_prompt < 1:
+        raise ValueError(
+            f"ppo.samples_per_prompt ({samples_per_prompt}) must be >= 1")
 
     gen = GenerationConfig.from_dict(
         ppo_cfg.get("generation_params"), max_new_tokens=256,
@@ -405,7 +415,8 @@ def main(argv=None) -> None:
         rm_params = jax.device_put(
             rm.params, sharding_tree(rm.specs, mesh))
 
-        generate_fn = jax.jit(build_generate_fn(policy.model, gen))
+        generate_fn = jax.jit(build_generate_fn(
+            policy.model, gen, group_size=samples_per_prompt))
         if algo == "gae":
             score_fn = make_gae_score_fn(policy.model, ref.model, rm.model,
                                          gamma, gae_lambda)
@@ -439,6 +450,13 @@ def main(argv=None) -> None:
 
         host_rng = random.Random(int(config.get("seed", 0)) + jax.process_index())
         local_bs = batch_size // jax.process_count()
+        if local_bs % samples_per_prompt:
+            raise ValueError(
+                f"ppo.samples_per_prompt ({samples_per_prompt}) must "
+                f"divide the per-host rollout batch ({local_bs} = "
+                f"batch_size {batch_size} / {jax.process_count()} hosts)")
+        # unique prompts per host: generate_fn expands each G-fold
+        local_prompts = local_bs // samples_per_prompt
         tok = policy.tokenizer
 
         rollout_idx = 0
@@ -466,9 +484,10 @@ def main(argv=None) -> None:
                 # 1. sample + encode prompts (host, this rank's share only)
                 batch_prompts = [
                     PROMPT_TEMPLATE.format(prompt=p)
-                    for p in (host_rng.sample(prompts, local_bs)
-                              if len(prompts) >= local_bs
-                              else host_rng.choices(prompts, k=local_bs))]
+                    for p in (host_rng.sample(prompts, local_prompts)
+                              if len(prompts) >= local_prompts
+                              else host_rng.choices(prompts,
+                                                    k=local_prompts))]
                 ids, mask = encode_prompt_batch(tok, batch_prompts, prompt_width)
                 gbatch = make_global_batch(
                     {"ids": ids, "mask": mask}, mesh)
@@ -479,7 +498,11 @@ def main(argv=None) -> None:
                 out = generate_fn(rp, gbatch["ids"], gbatch["mask"],
                                   roll_rng)
                 if algo == "gae":
-                    prompt_lens = jnp.sum(gbatch["mask"], axis=1)
+                    # gbatch holds the UNIQUE prompts; rollout rows are
+                    # grouped G-per-prompt in the same order
+                    prompt_lens = jnp.repeat(
+                        jnp.sum(gbatch["mask"], axis=1),
+                        samples_per_prompt, axis=0)
                     if quant_fn is not None:
                         # behavior stats must come from the SAME int8
                         # tree that sampled (rp is already merged for

@@ -46,7 +46,8 @@ from dla_tpu.telemetry.registry import parse_prometheus_text  # noqa: E402
 LOWER_IS_BETTER = ("_ms", "latency", "stall", "badput", "overhead",
                    "wait")
 HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
-                    "samples_per_sec", "_per_second")
+                    "samples_per_sec", "_per_second", "saved_frac",
+                    "hit_rate")
 
 
 def direction(name: str) -> int:
