@@ -580,6 +580,87 @@ def run_serving_prefix_bench() -> dict:
     }
 
 
+def run_serving_resilience_bench() -> dict:
+    """Serving-resilience chaos bench: a supervised engine
+    (dla_tpu/serving/resilience) driven through the full serving fault
+    plan — a wedged step, a device error, NaN logits, and a request
+    burst — with admission control on. The headline is requests lost
+    (MUST be 0: every submitted request reaches a terminal state, work
+    is replayed across engine rebuilds, overload is shed explicitly);
+    detail carries the shed rate, p99 TTFT under the burst, restart
+    count and breaker state. Deterministic, CPU-sized, in-process."""
+    import jax
+    import numpy as np
+    from dla_tpu.generation.engine import GenerationConfig
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.serving import (
+        RequestState,
+        ServingConfig,
+        ServingEngine,
+        Supervisor,
+        SupervisorConfig,
+        TERMINAL_STATES,
+    )
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.utils.logging import percentile
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=128, remat="none", dtype="float32",
+        param_dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    gen = GenerationConfig(max_new_tokens=10, do_sample=False,
+                           eos_token_id=-1)
+    plan = ("engine_step=2:wedge:0.3;engine_step=4:device_error;"
+            "engine_step=6:nan_logits;engine_step=8:burst=8")
+    engines = []
+
+    def factory():
+        eng = ServingEngine(model, params, gen, ServingConfig(
+            page_size=4, num_pages=64, num_slots=2, max_model_len=32,
+            max_prefill_batch=2, fault_plan=plan,
+            shed={"max_queue_depth": 6}))
+        engines.append(eng)
+        return eng
+
+    sup = Supervisor(factory, SupervisorConfig(
+        watchdog_timeout_s=0.05, watchdog_poll_s=0.01, max_restarts=3))
+    rs = np.random.RandomState(0)
+    # uniform prompt length: one prefill bucket, so the only compile-
+    # exempt watchdog window is each engine's first step
+    prompts = [list(rs.randint(3, 500, (6,)).astype(int))
+               for _ in range(8)]
+    for p in prompts:
+        sup.submit(p, 10)
+    results = sup.run()
+    sup.close()
+    reqs = list(results.values())
+    lost = sum(1 for r in reqs if r.state not in TERMINAL_STATES)
+    shed = sum(1 for r in reqs if r.state is RequestState.SHED)
+    ttfts = [(r.first_token_time - r.arrival_time) * 1000.0
+             for r in reqs if r.first_token_time is not None]
+    return {
+        "metric": "serving_requests_lost",
+        "value": lost,
+        "unit": "requests",
+        "detail": {
+            "requests_lost": lost,
+            "requests_total": len(reqs),
+            "shed_rate": round(shed / max(len(reqs), 1), 4),
+            "ttft_ms_p99": round(percentile(ttfts, 99.0), 2)
+            if ttfts else None,
+            "restarts": sup.restarts,
+            "failures": sup.failures,
+            "breaker_tripped": bool(sup.tripped),
+            "replayed_requests": sup.replayed,
+            "decode_compiles_per_engine": [
+                e.decode_compiles for e in engines],
+            "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def run_resilience_bench() -> dict:
     """Recovery-overhead microbench for the fault-tolerance stack
     (dla_tpu/resilience): one tiny SFT run with an injected checkpoint
@@ -912,6 +993,13 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_resilience_bench()))
+        return 0
+    if "serving-resilience" in sys.argv[1:]:
+        # supervised-serving chaos target: same in-process forced-CPU
+        # pattern; headline is requests lost (must be 0)
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_serving_resilience_bench()))
         return 0
     if "telemetry" in sys.argv[1:]:
         # telemetry-overhead target: same in-process forced-CPU pattern

@@ -38,6 +38,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="also run the continuous-batching serving engine "
                         "on a synthetic Poisson arrival trace (equivalent "
                         "to latency.serving.enabled: true)")
+    p.add_argument("--overload", action="store_true",
+                   help="run the overload A/B (burst injected mid-trace,"
+                        " admission control on vs off; equivalent to "
+                        "latency.serving.overload.enabled: true)")
     p.add_argument("--shared-prefix", action="store_true",
                    help="also run the shared-prefix serving A/B: K prompt "
                         "families x N requests each, prefix cache on vs "
@@ -328,6 +332,86 @@ def measure_shared_prefix(model, params, srv: Dict) -> Dict[str, object]:
     }
 
 
+def measure_overload(model, params, srv: Dict) -> Dict[str, object]:
+    """Overload A/B: the serving Poisson trace with a K-request burst
+    injected at the mid-trace instant, driven through two engines —
+    admission control + load shedding ON vs OFF — on the SAME prompts
+    and arrival schedule. Reports the shed rate and p99 TTFT for both
+    arms, and asserts the zero-lost-requests invariant: every submitted
+    request reaches a terminal state (finished, timed out, or shed) in
+    both arms — shedding converts queue collapse into explicit, counted
+    rejections, it never loses work silently."""
+    from dla_tpu.serving import ServingEngine
+    from dla_tpu.serving.metrics import ServingMetrics
+
+    ov = srv.get("overload") or {}
+    n = int(srv.get("num_requests", 16))
+    rate = float(srv.get("arrival_rate", 16.0))
+    burst = int(ov.get("burst", 32))
+    new_tokens = int(ov.get("new_tokens", srv.get("new_tokens", 32)))
+    pmin = int(srv.get("prompt_len_min", 8))
+    pmax = int(srv.get("prompt_len_max", 64))
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)          # run to length
+    rs = np.random.RandomState(int(srv.get("seed", 0)))
+    vocab = model.cfg.vocab_size
+    prompts = [list(rs.randint(3, vocab - 1,
+                               (rs.randint(pmin, pmax + 1),)))
+               for _ in range(n + burst)]
+    base = np.cumsum(rs.exponential(1.0 / rate, n))
+    # the burst: K requests landing at the SAME mid-trace instant —
+    # the adversarial arrival pattern admission control exists for
+    t_burst = base[n // 2]
+    arrivals = np.sort(np.concatenate([base, np.full(burst, t_burst)]))
+    num_slots = int(srv.get("num_slots", 8))
+    shed = dict(srv.get("shed") or {})
+    shed.pop("enabled", None)
+    # a queue bound the burst overflows, so the shed arm actually sheds
+    shed.setdefault("max_queue_depth", 2 * num_slots)
+
+    def run_arm(shed_on: bool):
+        eng = ServingEngine(model, params, gen, _serving_config(
+            srv, shed=shed if shed_on else None))
+        slot_w = eng.cache.geom.slot_window
+        for width in sorted({eng.scheduler.bucket_width(len(p))
+                             for p in prompts}):
+            plen = min(width, slot_w - 1)
+            eng.submit([3 + (i % 251) for i in range(plen)], 1)
+        eng.run_until_drained()
+        eng.metrics = ServingMetrics()
+        dt, _ = _drive_open_loop(eng, prompts, arrivals, new_tokens)
+        snap = eng.metrics.snapshot()
+        submitted = snap["serving/requests_submitted"]
+        terminal = (snap["serving/requests_finished"]
+                    + snap["serving/requests_timed_out"]
+                    + snap["serving/requests_cancelled"]
+                    + snap["serving/requests_shed"])
+        return dt, snap, submitted - terminal
+
+    dt_on, snap_on, lost_on = run_arm(True)
+    dt_off, snap_off, lost_off = run_arm(False)
+    return {
+        "num_requests": n,
+        "burst": burst,
+        "arrival_rate": rate,
+        "new_tokens": new_tokens,
+        "shed_rate": snap_on["serving/requests_shed"]
+        / max(snap_on["serving/requests_submitted"], 1),
+        "requests_shed": snap_on["serving/requests_shed"],
+        "queue_timeouts_shed_on": snap_on["serving/queue_timeouts"],
+        "degradation_level_final": snap_on[
+            "serving/degradation_level"],
+        "ttft_ms_p99_shed_on": snap_on["serving/ttft_ms_p99"],
+        "ttft_ms_p99_shed_off": snap_off["serving/ttft_ms_p99"],
+        "ttft_ms_p50_shed_on": snap_on["serving/ttft_ms_p50"],
+        "ttft_ms_p50_shed_off": snap_off["serving/ttft_ms_p50"],
+        "requests_lost_shed_on": lost_on,
+        "requests_lost_shed_off": lost_off,
+        "duration_s_shed_on": dt_on,
+        "duration_s_shed_off": dt_off,
+    }
+
+
 def main(argv=None) -> None:
     args = parse_args(argv)
     config = load_config(args.config)
@@ -380,6 +464,18 @@ def main(argv=None) -> None:
                     f"itl p50 {entry['serving']['itl_ms_p50']:.2f} "
                     f"p99 {entry['serving']['itl_ms_p99']:.2f} ms "
                     f"({entry['serving']['preemptions']:.0f} preemptions)")
+            if args.overload or \
+                    (srv.get("overload") or {}).get("enabled", False):
+                entry["overload"] = measure_overload(
+                    bundle.model, bundle.params, srv)
+                ovr = entry["overload"]
+                log_rank_zero(
+                    f"[dla_tpu][latency] overload: shed rate "
+                    f"{ovr['shed_rate']:.2f}, ttft p99 "
+                    f"{ovr['ttft_ms_p99_shed_on']:.1f} ms (shed on) vs "
+                    f"{ovr['ttft_ms_p99_shed_off']:.1f} ms (shed off), "
+                    f"lost {ovr['requests_lost_shed_on']:.0f}/"
+                    f"{ovr['requests_lost_shed_off']:.0f}")
             if args.shared_prefix or \
                     (srv.get("shared_prefix") or {}).get("enabled", False):
                 entry["shared_prefix"] = measure_shared_prefix(

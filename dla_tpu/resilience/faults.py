@@ -32,6 +32,30 @@ hang        Trainer sleeps ``arg`` seconds (default 1.0) inside the
 
 An optional third field is the kind's argument: ``step=5:hang:0.25``.
 Entries are thread-safe (checkpoint I/O polls from the writer thread).
+
+Serving scope: entries prefixed ``engine_step=`` arm against the
+serving engine's step counter instead of the training step, with their
+own kind set::
+
+    DLA_FAULT_PLAN="engine_step=8:wedge:0.3;engine_step=20:burst=16"
+
+==============  ===================================================
+wedge           ServingEngine.step sleeps ``arg`` seconds (default
+                0.3) at the top of the step, tripping the serving
+                Supervisor's watchdog
+device_error    the next decode dispatch raises ``DeviceStepError``
+                (stands in for an XLA device failure)
+nan_logits      the next decode step raises ``NaNLogitsError`` as if
+                non-finite logits came back from the model
+burst           the Supervisor injects ``K`` synthetic requests at
+                that engine step (``burst=K`` or ``burst:K``),
+                overloading admission so shedding is exercised
+==============  ===================================================
+
+The two scopes are disjoint: ``take(kind, step)`` only matches
+``step=`` entries and ``take(kind, step, site="engine_step")`` only
+matches ``engine_step=`` entries, so a co-located trainer and engine
+can share one plan string.
 """
 from __future__ import annotations
 
@@ -44,6 +68,11 @@ ENV_VAR = "DLA_FAULT_PLAN"
 
 KNOWN_KINDS = ("io_error", "nan", "preempt", "hang")
 
+# serving-scoped kinds, legal only behind an ``engine_step=`` prefix
+SERVING_KINDS = ("wedge", "device_error", "nan_logits", "burst")
+
+_SITE_KINDS = {"step": KNOWN_KINDS, "engine_step": SERVING_KINDS}
+
 
 @dataclasses.dataclass
 class Fault:
@@ -52,6 +81,7 @@ class Fault:
     kind: str
     arg: Optional[float] = None
     fired: bool = False
+    site: str = "step"           # "step" (training) | "engine_step"
 
 
 class FaultPlan:
@@ -70,7 +100,8 @@ class FaultPlan:
 
     def spec(self) -> str:
         return ";".join(
-            f"step={f.step}:{f.kind}" + ("" if f.arg is None else f":{f.arg:g}")
+            f"{f.site}={f.step}:{f.kind}"
+            + ("" if f.arg is None else f":{f.arg:g}")
             for f in self.entries)
 
     @classmethod
@@ -81,18 +112,34 @@ class FaultPlan:
             if not part:
                 continue
             fields = part.split(":")
-            if len(fields) not in (2, 3) or not fields[0].startswith("step="):
+            site = None
+            for cand in _SITE_KINDS:
+                if fields[0].startswith(cand + "="):
+                    site = cand
+                    break
+            if len(fields) not in (2, 3) or site is None:
                 raise ValueError(
                     f"bad fault entry {part!r}; expected "
-                    f"'step=<N>:<kind>[:<arg>]'")
+                    f"'step=<N>:<kind>[:<arg>]' or "
+                    f"'engine_step=<N>:<kind>[:<arg>]'")
             kind = fields[1].strip()
-            if kind not in KNOWN_KINDS:
+            arg: Optional[float] = None
+            if "=" in kind:
+                # burst=K convenience form: the '=' arg folds into the
+                # kind field so 'engine_step=20:burst=16' parses
+                kind, _, argtxt = kind.partition("=")
+                if len(fields) == 3:
+                    raise ValueError(
+                        f"bad fault entry {part!r}: both '=' and ':' args")
+                arg = float(argtxt)
+            elif len(fields) == 3:
+                arg = float(fields[2])
+            if kind not in _SITE_KINDS[site]:
                 raise ValueError(
                     f"unknown fault kind {kind!r} in {part!r}; "
-                    f"known: {KNOWN_KINDS}")
-            arg = float(fields[2]) if len(fields) == 3 else None
-            entries.append(Fault(step=int(fields[0][len("step="):]),
-                                 kind=kind, arg=arg))
+                    f"known for {site}=: {_SITE_KINDS[site]}")
+            entries.append(Fault(step=int(fields[0][len(site) + 1:]),
+                                 kind=kind, arg=arg, site=site))
         entries.sort(key=lambda f: f.step)
         return cls(entries)
 
@@ -100,13 +147,15 @@ class FaultPlan:
     def from_env(cls) -> "FaultPlan":
         return cls.parse(os.environ.get(ENV_VAR, ""))
 
-    def take(self, kind: str, step: int) -> Optional[Fault]:
-        """Fire-and-consume the earliest unfired ``kind`` entry whose step
-        has been reached; None when nothing is due. One-shot: a taken
-        entry never fires again."""
+    def take(self, kind: str, step: int,
+             site: str = "step") -> Optional[Fault]:
+        """Fire-and-consume the earliest unfired ``kind`` entry of
+        ``site`` whose step has been reached; None when nothing is due.
+        One-shot: a taken entry never fires again."""
         with self._lock:
             for f in self.entries:
-                if f.kind == kind and not f.fired and step >= f.step:
+                if f.kind == kind and f.site == site and not f.fired \
+                        and step >= f.step:
                     f.fired = True
                     return f
         return None

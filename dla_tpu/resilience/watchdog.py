@@ -56,6 +56,7 @@ class Watchdog:
         # last completed step BEFORE on_hang/abort can kill the process
         self.recorder = recorder
         self.fired = False
+        self._armed = True
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -78,8 +79,21 @@ class Watchdog:
     def beat(self) -> None:
         self._last_beat = time.monotonic()
 
+    def pause(self) -> None:
+        """Disarm between supervised sections: an embedder that only
+        wants hang coverage INSIDE a step (the serving Supervisor —
+        the engine may legitimately sit idle between open-loop
+        arrivals) brackets the step with resume()/pause()."""
+        self._armed = False
+
+    def resume(self) -> None:
+        self._last_beat = time.monotonic()
+        self._armed = True
+
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
+            if not self._armed:
+                continue
             if time.monotonic() - self._last_beat <= self.timeout_s:
                 continue
             self.fired = True

@@ -14,6 +14,9 @@ Modules:
   server     the host engine loop driving jitted prefill/decode steps
   metrics    queue depth, TTFT, inter-token latency, page occupancy,
              preemption counters
+  resilience admission control + load shedding, degradation ladder,
+             engine Supervisor (watchdog/rebuild/deterministic replay),
+             circuit breaker
 """
 from dla_tpu.serving.kv_blocks import (
     PageAllocator,
@@ -22,7 +25,18 @@ from dla_tpu.serving.kv_blocks import (
     PrefixCache,
 )
 from dla_tpu.serving.metrics import ServingMetrics
+from dla_tpu.serving.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    DegradationLadder,
+    DeviceStepError,
+    NaNLogitsError,
+    ShedConfig,
+    Supervisor,
+    SupervisorConfig,
+)
 from dla_tpu.serving.scheduler import (
+    TERMINAL_STATES,
     Request,
     RequestState,
     Scheduler,
@@ -31,6 +45,11 @@ from dla_tpu.serving.scheduler import (
 from dla_tpu.serving.server import ServingConfig, ServingEngine
 
 __all__ = [
+    "AdmissionController",
+    "CircuitBreaker",
+    "DegradationLadder",
+    "DeviceStepError",
+    "NaNLogitsError",
     "PageAllocator",
     "PagedKVCache",
     "PageGeometry",
@@ -42,4 +61,8 @@ __all__ = [
     "ServingConfig",
     "ServingEngine",
     "ServingMetrics",
+    "ShedConfig",
+    "Supervisor",
+    "SupervisorConfig",
+    "TERMINAL_STATES",
 ]

@@ -179,6 +179,23 @@ class PageAllocator:
             del self._cached[page]
             self._free.append(page)
 
+    def reclaim_cached(self) -> int:
+        """Evict EVERY retained refcount-0 page back to the free list,
+        unindexing each via ``evict_hook`` — the degradation ladder's
+        first rung under sustained pressure (alloc would reclaim them
+        one-by-one anyway; this trades the whole cache for headroom at
+        once). Pages still referenced by live block tables are untouched.
+        Returns the number of pages reclaimed."""
+        n = 0
+        while self._cached:
+            p, _ = self._cached.popitem(last=False)
+            self.cache_evictions += 1
+            if self.evict_hook is not None:
+                self.evict_hook(p)
+            self._free.append(p)
+            n += 1
+        return n
+
 
 @dataclasses.dataclass(frozen=True)
 class PageGeometry:

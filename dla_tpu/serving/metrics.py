@@ -55,6 +55,14 @@ class ServingMetrics:
         self.prefill_chunks = r.counter("serving/prefill/chunks")
         self.prefill_tokens_saved = r.counter(
             "serving/prefill/tokens_saved")
+        self.requests_shed = r.counter("serving/requests_shed")
+        self.queue_timeouts = r.counter("serving/queue_timeouts")
+        self.degradation_level = r.gauge("serving/degradation_level")
+        self.supervisor_restarts = r.counter(
+            "serving/supervisor/restarts")
+        self.replayed_requests = r.counter(
+            "serving/supervisor/replayed_requests")
+        self.breaker_open = r.gauge("serving/supervisor/breaker_open")
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {
@@ -83,6 +91,14 @@ class ServingMetrics:
             "serving/prefill/chunks": float(self.prefill_chunks.value),
             "serving/prefill/tokens_saved": float(
                 self.prefill_tokens_saved.value),
+            "serving/requests_shed": float(self.requests_shed.value),
+            "serving/queue_timeouts": float(self.queue_timeouts.value),
+            "serving/degradation_level": self.degradation_level.value,
+            "serving/supervisor/restarts": float(
+                self.supervisor_restarts.value),
+            "serving/supervisor/replayed_requests": float(
+                self.replayed_requests.value),
+            "serving/supervisor/breaker_open": self.breaker_open.value,
         }
         out.update(self.ttft_ms.summary("serving/ttft_ms_"))
         out.update(self.itl_ms.summary("serving/itl_ms_"))

@@ -55,6 +55,13 @@ class RequestState(enum.Enum):
     FINISHED = "finished"
     EVICTED = "evicted"
     TIMEOUT = "timeout"      # deadline passed before completion
+    SHED = "shed"            # dropped by admission control / load shed
+
+
+#: states a request never leaves — the "every request terminates"
+#: contract the resilience layer (and its chaos tests) assert on
+TERMINAL_STATES = (RequestState.FINISHED, RequestState.TIMEOUT,
+                   RequestState.SHED)
 
 
 _rid_counter = itertools.count()
@@ -73,8 +80,11 @@ class Request:
     pages: List[int] = dataclasses.field(default_factory=list)
     evictions: int = 0
     finish_reason: Optional[str] = None   # "eos" | "length" | "timeout"
-                                          # | "cancelled"
+                                          # | "cancelled" | "shed"
     deadline: Optional[float] = None      # absolute engine-clock cutoff
+    # load-shed ranking: HIGHER outranks lower; shedding drops the
+    # lowest-priority queued request first (FIFO-tail among equals)
+    priority: int = 0
     # chunked prefill progress: prefix tokens already in the cache pool
     # (shared hit pages + chunks computed so far)
     prefill_pos: int = 0
@@ -133,6 +143,18 @@ class Scheduler:
         self.free_slots: List[int] = list(
             range(cache.geom.num_slots - 1, -1, -1))
         self.preemptions = 0
+        # degradation-ladder batch shrink: admission stops once this many
+        # requests hold slots (None = every slot usable). Purely an
+        # admission cap — shapes stay static, running requests finish.
+        self.max_active: Optional[int] = None
+
+    def _admission_headroom(self) -> Optional[int]:
+        """Slots admission may still fill under ``max_active``; None
+        means unlimited."""
+        if self.max_active is None:
+            return None
+        held = len(self.running) + len(self.prefilling)
+        return max(0, self.max_active - held)
 
     # ------------------------------------------------------------- intake
 
@@ -173,6 +195,11 @@ class Scheduler:
         width = self.bucket_width(len(head.prefix_tokens))
         geom = self.cache.geom
         limit = min(self.cfg.max_prefill_batch, len(self.free_slots))
+        headroom = self._admission_headroom()
+        if headroom is not None:
+            limit = min(limit, headroom)
+        if limit <= 0:
+            return batch
         scanned = 0
         picked_ids = set()
         for req in list(self.queue):
@@ -219,6 +246,8 @@ class Scheduler:
         was an exact-full-prompt hit: ``cached_logits`` is set, no
         prefill runs, and the engine activates it directly."""
         if not self.queue or not self.free_slots or self.prefilling:
+            return None
+        if self._admission_headroom() == 0:
             return None
         req = self.queue[0]
         geom = self.cache.geom
@@ -371,6 +400,16 @@ class Scheduler:
         out += [r for r in self.prefilling.values()
                 if r.deadline is not None and now >= r.deadline]
         return out
+
+    def sheddable_queued(self) -> List[Request]:
+        """Queued requests load shedding may drop, worst-first: lowest
+        priority, then latest arrival (least sunk wait) among equals.
+        Evicted in-flight requests are exempt — they hold generated
+        tokens and sunk compute, and shedding them would break the
+        streaming contract mid-request."""
+        cands = [r for r in self.queue if not r.generated]
+        cands.sort(key=lambda r: (r.priority, -r.arrival_time, -r.rid))
+        return cands
 
     def _release_resources(self, req: Request) -> None:
         if req.slot is not None:

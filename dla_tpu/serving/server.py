@@ -37,12 +37,20 @@ import numpy as np
 from dla_tpu.generation.engine import GenerationConfig
 from dla_tpu.models.transformer import Transformer
 from dla_tpu.ops.sampling import sample_token
+from dla_tpu.resilience.faults import FaultPlan
 from dla_tpu.serving.kv_blocks import (
     PagedKVCache,
     PageGeometry,
     PrefixCache,
 )
 from dla_tpu.serving.metrics import ServingMetrics
+from dla_tpu.serving.resilience import (
+    AdmissionController,
+    DegradationLadder,
+    DeviceStepError,
+    NaNLogitsError,
+    ShedConfig,
+)
 from dla_tpu.serving.scheduler import (
     Request,
     RequestState,
@@ -50,6 +58,7 @@ from dla_tpu.serving.scheduler import (
     SchedulerConfig,
 )
 from dla_tpu.telemetry.exporter import MetricsHTTPServer, ReadinessProbe
+from dla_tpu.telemetry.flight_recorder import FlightRecorder
 from dla_tpu.telemetry.slo import SLOWatch
 from dla_tpu.telemetry.trace import Tracer, get_tracer, install_tracer
 from dla_tpu.utils.profiling import ProfileWindow, annotate, step_annotation
@@ -98,6 +107,16 @@ class ServingConfig:
     slo: Optional[Dict] = None
     # /healthz flips to 503 when no engine step completed for this long
     readiness_timeout_s: float = 600.0
+    # admission control / load shedding / degradation ladder: the
+    # serving.resilience ShedConfig fields as a dict (None or
+    # {enabled: false} = no gate, PR-1 behavior)
+    shed: Optional[Dict] = None
+    # serving-scoped fault injection: an explicit plan spec string
+    # ("engine_step=3:wedge;engine_step=6:nan_logits"); None falls back
+    # to $DLA_FAULT_PLAN — only engine_step= entries fire here
+    fault_plan: Optional[str] = None
+    # flight-recorder postmortem directory (None = in-memory ring only)
+    postmortem_dir: Optional[str] = None
 
     @property
     def pages_per_slot(self) -> int:
@@ -189,10 +208,28 @@ class ServingEngine:
             self._installed_tracer = True
         else:
             self.tracer = get_tracer()
+        # resilience surface: flight recorder for postmortems, the
+        # admission gate + degradation ladder (both off unless cfg.shed
+        # enables them), and the serving-scoped fault plan
+        self.recorder = FlightRecorder(capacity=256,
+                                       out_dir=cfg.postmortem_dir)
+        shed_cfg = ShedConfig.from_config(cfg.shed)
+        self.admission = (AdmissionController(shed_cfg)
+                          if shed_cfg is not None else None)
+        self.ladder = (DegradationLadder(shed_cfg, recorder=self.recorder)
+                       if shed_cfg is not None else None)
+        self._applied_level = 0
+        self.faults = (FaultPlan.parse(cfg.fault_plan)
+                       if cfg.fault_plan is not None
+                       else FaultPlan.from_env())
+        # armed by _poll_faults, consumed by the next decode dispatch
+        self._fault_device_error = False
+        self._fault_nan_logits = False
         # SLO watch over the serving snapshot (TTFT p95 etc.), checked
         # every `check_every` engine steps; /healthz readiness heartbeat
         self.slo = SLOWatch.from_config(cfg.slo,
-                                        registry=self.metrics.registry)
+                                        registry=self.metrics.registry,
+                                        recorder=self.recorder)
         self._slo_every = max(1, int((cfg.slo or {}).get("check_every",
                                                          100)))
         self.readiness = ReadinessProbe(
@@ -335,7 +372,8 @@ class ServingEngine:
 
     def submit(self, prompt_tokens: List[int], max_new_tokens: int,
                arrival_time: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> int:
         """Queue a request; returns its id. Guards that the request can
         EVER fit: its worst-case page demand (re-admission prefix padded
         to a bucket, plus the decode reserve) within pool capacity.
@@ -343,7 +381,13 @@ class ServingEngine:
         ``deadline_s`` is a per-request latency budget relative to
         arrival: past it the scheduler finishes the request with TIMEOUT
         status at the next engine step, whether it is still queued or
-        mid-decode (generated-so-far tokens are kept)."""
+        mid-decode (generated-so-far tokens are kept).
+
+        With admission control on (cfg.shed) the request may come back
+        already terminal: SHED at the gate (bucket empty, or it is the
+        worst of a full queue) — or it may displace a lower-priority
+        queued request, which is shed instead. Check
+        ``result(rid).state``."""
         if self._draining:
             raise RuntimeError(
                 "engine is draining (SIGTERM received): admission closed")
@@ -351,7 +395,8 @@ class ServingEngine:
         req = Request(prompt_tokens=list(prompt_tokens),
                       max_new_tokens=int(max_new_tokens),
                       arrival_time=(self.now() if arrival_time is None
-                                    else arrival_time))
+                                    else arrival_time),
+                      priority=int(priority))
         if deadline_s is not None:
             req.deadline = req.arrival_time + float(deadline_s)
         worst = len(req.prompt_tokens) + req.max_new_tokens
@@ -376,10 +421,45 @@ class ServingEngine:
                 "request", "request", req.rid, t=req.arrival_time,
                 prompt_tokens=len(req.prompt_tokens),
                 max_new_tokens=req.max_new_tokens)
+        if self.admission is not None:
+            _, victims = self.admission.on_submit(
+                self.scheduler, req, req.arrival_time)
+            for victim in victims:
+                self._shed(victim, at="gate")
         return req.rid
 
     def result(self, rid: int) -> Request:
         return self._results[rid]
+
+    def restore(self, prompt_tokens: List[int], max_new_tokens: int, *,
+                generated: List[int], arrival_time: float,
+                deadline: Optional[float] = None, priority: int = 0,
+                rid: Optional[int] = None) -> Request:
+        """Re-enter a journaled in-flight request after a supervisor
+        rebuild: the eviction deterministic-recompute contract taken
+        cross-engine. ``generated`` pre-seeds the tokens the client
+        already streamed, so ``prefix_tokens`` is prompt + streamed —
+        the engine re-prefills that prefix and continues from the next
+        token. Nothing is re-emitted, and a greedy continuation is
+        bit-identical to the fault-free run. Bypasses the admission
+        gate and the drain closure: replayed requests ARE the in-flight
+        work a drain exists to finish."""
+        req = Request(prompt_tokens=list(prompt_tokens),
+                      max_new_tokens=int(max_new_tokens),
+                      arrival_time=arrival_time,
+                      priority=int(priority))
+        if rid is not None:
+            req.rid = rid
+        req.deadline = deadline
+        req.generated = list(generated)
+        self.scheduler.submit(req)
+        if req.remaining_new_tokens <= 0:
+            # every token already streamed before the failure: nothing
+            # left to recompute
+            self.scheduler.cancel(req, "length")
+            self.metrics.requests_finished.inc()
+        self._results[req.rid] = req
+        return req
 
     def has_work(self) -> bool:
         return bool(self.scheduler.queue or self.scheduler.running
@@ -397,7 +477,9 @@ class ServingEngine:
         self.profile.on_step(self.engine_steps)
         emitted: List[Tuple[int, int]] = []
         with step_annotation(self.engine_steps, name="serve"):
+            self._poll_faults()
             self._expire(self.now())
+            self._resilience_pass()
             for req in self.scheduler.ensure_decode_pages():
                 self.metrics.preemptions.inc()
             if self.cfg.prefill_chunk:
@@ -474,6 +556,10 @@ class ServingEngine:
         if self._draining:
             return
         self._draining = True
+        # /healthz answers 503 body "draining" from here on (load
+        # balancers stop routing before admission starts rejecting);
+        # the tripped-circuit-breaker path flips the same switch
+        self.readiness.set_draining("draining")
         for req in [r for r in self.scheduler.queue if not r.generated]:
             self.scheduler.cancel(req, "cancelled")
             self.metrics.requests_cancelled.inc()
@@ -509,10 +595,98 @@ class ServingEngine:
         for req in self.scheduler.expired(now):
             self.scheduler.cancel(req, "timeout", RequestState.TIMEOUT)
             self.metrics.requests_timed_out.inc()
+            if req.admitted_time is None:
+                # expired straight out of the queue, never admitted:
+                # queue wait alone blew the deadline — the admission-
+                # pressure signal, distinct from slow decode
+                self.metrics.queue_timeouts.inc()
             if self.tracer.enabled:
                 self.tracer.async_end(
                     "request", "request", req.rid, t=now,
                     status="timeout", tokens=len(req.generated))
+
+    # ----------------------------------------------------------- resilience
+
+    def _shed(self, req: Request, at: str = "queue") -> None:
+        """Terminal SHED for one queued request: cancel out of the
+        queue, count, record, close the trace span. Only never-started
+        requests are ever shed (``sheddable_queued`` guarantees it), so
+        beyond the scheduler's cancel path there is no slot or page
+        state to unwind."""
+        self.scheduler.cancel(req, "shed", RequestState.SHED)
+        self.metrics.requests_shed.inc()
+        self.recorder.record("request_shed", step=self.engine_steps,
+                             rid=req.rid, priority=req.priority, at=at)
+        if self.tracer.enabled:
+            self.tracer.async_end("request", "request", req.rid,
+                                  status="shed", tokens=0)
+
+    def _resilience_pass(self) -> None:
+        """Once per step, after deadline expiry and before scheduling:
+        feed the pressure signal (max of page occupancy and queue-depth
+        fraction) to the degradation ladder, apply its rungs, and run
+        the SLO-aware shed pass over the queue."""
+        if self.admission is None:
+            return
+        shed_cfg = self.admission.cfg
+        qfrac = self.scheduler.queue_depth / max(1,
+                                                 shed_cfg.max_queue_depth)
+        pressure = max(self.cache.allocator.occupancy, min(1.0, qfrac))
+        prev = self._applied_level
+        level = self.ladder.update(pressure, step=self.engine_steps)
+        self.metrics.degradation_level.set(level)
+        if level != prev:
+            if prev == 0 and level >= 1 and self.prefix_cache is not None:
+                # rung 1 entry: give cached-but-unreferenced prefix
+                # pages back to the free pool (throughput optimization
+                # goes first, requests go last)
+                n_pages = self.cache.allocator.reclaim_cached()
+                self.recorder.record("degradation_cache_flush",
+                                     step=self.engine_steps,
+                                     pages=n_pages)
+            self._applied_level = level
+        # rung 3: halve the concurrent-request ceiling so queue wait
+        # trades against decode interference under pressure
+        self.scheduler.max_active = (
+            None if not self.ladder.shrink_batch
+            else max(1, self.cfg.num_slots // 2))
+        burn = 0.0
+        if self.slo is not None:
+            for objective in self.slo.slos:
+                rate = self.slo.burn_rate(objective)
+                if rate > burn:
+                    burn = rate
+        for victim in self.admission.shed_pass(self.scheduler, burn,
+                                               level):
+            self._shed(victim, at="slo" if burn else "ladder")
+
+    def _poll_faults(self) -> None:
+        """Fire any serving-scoped (``engine_step=``) fault-plan entries
+        due this step. ``wedge`` sleeps right here — inside the step, so
+        a supervising watchdog sees it; ``device_error``/``nan_logits``
+        arm a flag the next decode dispatch consumes. ``burst`` is the
+        Supervisor's to consume (it owns intake); the engine ignores
+        it."""
+        if not self.faults:
+            return
+        f = self.faults.take("wedge", self.engine_steps,
+                             site="engine_step")
+        if f is not None:
+            self.recorder.record("fault_injected", step=self.engine_steps,
+                                 fault="wedge")
+            time.sleep(0.3 if f.arg is None else f.arg)
+        f = self.faults.take("device_error", self.engine_steps,
+                             site="engine_step")
+        if f is not None:
+            self.recorder.record("fault_injected", step=self.engine_steps,
+                                 fault="device_error")
+            self._fault_device_error = True
+        f = self.faults.take("nan_logits", self.engine_steps,
+                             site="engine_step")
+        if f is not None:
+            self.recorder.record("fault_injected", step=self.engine_steps,
+                                 fault="nan_logits")
+            self._fault_nan_logits = True
 
     # ------------------------------------------------------------ internals
 
@@ -610,6 +784,12 @@ class ServingEngine:
         sched = self.scheduler
         if not sched.prefilling:
             return
+        if self.ladder is not None and self.ladder.no_coschedule \
+                and sched.running:
+            # degradation rung 2: never co-schedule a chunk with a live
+            # decode batch. Same no-livelock shape as the budget below —
+            # with nothing decoding the chunk always runs.
+            return
         budget = self.cfg.prefill_token_budget
         if budget and sched.running and \
                 len(sched.running) + self.cfg.prefill_chunk > budget:
@@ -672,6 +852,11 @@ class ServingEngine:
         """Sample next tokens from prefill logits — same sampling rule as
         the decode step (ops.sampling), eager jax (once per prefill
         batch, off the hot loop)."""
+        if np.isnan(logits).any():
+            # real detection on the only logits the host ever sees: the
+            # serving analog of the trainer's NaN guard. The supervisor
+            # turns this into a rebuild-and-replay.
+            raise NaNLogitsError("non-finite prefill logits")
         if not self.gen.do_sample or self.gen.temperature == 0.0:
             return np.argmax(logits, axis=-1).astype(np.int32)
         toks = sample_token(
@@ -686,6 +871,13 @@ class ServingEngine:
         active_slots = sorted(self.scheduler.running)
         active = np.zeros((c.geom.num_slots,), bool)
         active[active_slots] = True
+        if self._fault_device_error:
+            # injected BEFORE dispatch: no KV column was written, no
+            # token sampled — exactly the state a real dispatch failure
+            # leaves behind, so supervisor replay recomputes cleanly
+            self._fault_device_error = False
+            raise DeviceStepError(
+                "injected device error (fault plan engine_step)")
         with annotate("serve_decode"):
             self.cache.k_pages, self.cache.v_pages, toks = self._decode(
                 self.params, c.k_pages, c.v_pages,
@@ -694,6 +886,13 @@ class ServingEngine:
                 self._dev(c.tokens), jnp.asarray(active), self._next_rng())
             # dla: disable=host-sync-in-hot-loop -- the designed single D2H per decode step (execution-model invariant)
             toks_np = np.asarray(toks)
+        if self._fault_nan_logits:
+            # injected AFTER the fetch, where the real NaN guard below
+            # (_sample_host) and a device-side check would trip: the
+            # sampled tokens are garbage, so nothing is committed
+            self._fault_nan_logits = False
+            raise NaNLogitsError(
+                "injected non-finite logits (fault plan engine_step)")
         t_done = self.now()
         self.metrics.decode_steps.inc()
         emitted: List[Tuple[int, int]] = []

@@ -8,8 +8,11 @@ One daemon-threaded ``ThreadingHTTPServer`` serving two routes:
   loop last completed a step (``ok age_s=1.2``) and flips to HTTP 503
   (``stale age_s=...``) past the staleness threshold — so an external
   probe (k8s, a pod launcher) catches a wedged loop *before* the
-  watchdog's SIGABRT, while the process is still scrapeable. Without a
-  probe it stays the plain liveness ``ok``.
+  watchdog's SIGABRT, while the process is still scrapeable. While the
+  owner is refusing new work (SIGTERM drain, tripped serving circuit
+  breaker) it answers 503 with body ``draining`` even though the loop
+  still beats — load balancers stop routing before admission starts
+  rejecting. Without a probe it stays the plain liveness ``ok``.
 
 No dependencies beyond ``http.server`` — the container bakes nothing
 extra in and the endpoint must work in the leanest serving image.
@@ -35,9 +38,17 @@ class ReadinessProbe:
         self.threshold_s = float(threshold_s)
         self.now = now
         self._last = now()     # construction counts as the first beat
+        # set while the owner refuses new work (SIGTERM drain, tripped
+        # restart circuit breaker): /healthz answers 503 with this body
+        # so load balancers stop routing BEFORE admission starts
+        # rejecting — even though the loop is still beating
+        self.drain_reason: Optional[str] = None
 
     def beat(self) -> None:
         self._last = self.now()
+
+    def set_draining(self, reason: str = "draining") -> None:
+        self.drain_reason = reason
 
     @property
     def age_s(self) -> float:
@@ -79,6 +90,9 @@ class MetricsHTTPServer:
                     probe = outer.readiness
                     if probe is None:
                         status, body = 200, b"ok\n"
+                    elif probe.drain_reason is not None:
+                        status = 503
+                        body = (probe.drain_reason + "\n").encode()
                     elif probe.ready:
                         status = 200
                         body = f"ok age_s={probe.age_s:.1f}\n".encode()

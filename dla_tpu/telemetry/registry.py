@@ -229,6 +229,22 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "chunked-prefill forward passes", "step"),
     _s("serving/prefill/tokens_saved", "counter", "tokens",
        "prefill tokens skipped via cached prefixes", "step"),
+    # -- serving resilience (serving.resilience): admission control,
+    #    degradation ladder, engine supervision
+    _s("serving/requests_shed", "counter", "requests",
+       "requests dropped by admission control / load shedding", "step"),
+    _s("serving/queue_timeouts", "counter", "requests",
+       "deadline expiries resolved straight from the wait queue "
+       "(never admitted)", "step"),
+    _s("serving/degradation_level", "gauge", "level",
+       "graceful-degradation ladder rung (0=none .. 4=shedding)",
+       "step"),
+    _s("serving/supervisor/restarts", "counter", "restarts",
+       "engine teardown+rebuild cycles (wedge/device error/NaN logits)"),
+    _s("serving/supervisor/replayed_requests", "counter", "requests",
+       "in-flight requests replayed after an engine rebuild"),
+    _s("serving/supervisor/breaker_open", "gauge", "bool",
+       "1 while the restart circuit breaker is tripped (draining)"),
     # -- resilience counters bridged into the registry (FuncGauge)
     _s("resilience/ckpt_saves_started", "counter", "saves"),
     _s("resilience/ckpt_saves_completed", "counter", "saves"),

@@ -400,6 +400,40 @@ class SharedPrefixSchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class ShedSchema:
+    """serving.resilience.ShedConfig: admission control + load
+    shedding + degradation-ladder thresholds."""
+    enabled: Any = None
+    max_queue_depth: Any = None
+    rate: Any = None
+    burst: Any = None
+    slo_burn_threshold: Any = None
+    degrade_high: Any = None
+    degrade_low: Any = None
+    degrade_patience: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorSchema:
+    """serving.resilience.SupervisorConfig: watchdog + restart budget
+    for the supervised serving engine."""
+    enabled: Any = None
+    watchdog_timeout_s: Any = None
+    watchdog_poll_s: Any = None
+    max_restarts: Any = None
+    restart_window_s: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OverloadSchema:
+    """eval_latency --overload: burst size injected mid-trace for the
+    shed-on vs shed-off A/B."""
+    enabled: Any = None
+    burst: Any = None
+    new_tokens: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ServingLatencySchema:
     enabled: Any = None
     arrival_rate: Any = None
@@ -417,6 +451,9 @@ class ServingLatencySchema:
     prefix_cache: Optional[PrefixCacheSchema] = None
     chunked_prefill: Optional[ChunkedPrefillSchema] = None
     shared_prefix: Optional[SharedPrefixSchema] = None
+    shed: Optional[ShedSchema] = None
+    supervisor: Optional[SupervisorSchema] = None
+    overload: Optional[OverloadSchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

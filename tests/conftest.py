@@ -43,6 +43,14 @@ jax.config.update("jax_cpu_enable_async_dispatch", False)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs with `-m 'not slow'`: long soaks (e.g. the serving
+    # chaos soak) register here so deselection works without warnings
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/stress test, excluded from "
+        "the tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     import jax

@@ -399,6 +399,31 @@ def test_resume_falls_back_past_corrupt_checkpoints(mesh8, tmp_path):
         assert t3.step == 4                   # next fallback: step_00000004
 
 
+def test_resume_with_every_tag_corrupt_raises_instead_of_looping(
+        mesh8, tmp_path):
+    """When `final` AND every step_* tag is corrupt there is nothing to
+    fall back to: try_resume must surface the original corruption error
+    promptly — not spin through fallbacks forever, and not leave the
+    trainer half-restored."""
+    with jax.sharding.set_mesh(mesh8):
+        out = tmp_path / "run"
+        tr = _make_trainer(mesh8, out, max_steps=8, save_every=4)
+        it = CountingIter()
+        tr.fit(it, rng=jax.random.key(0), data_state=it.state_dict)
+        ckdir = tr.checkpointer.dir
+        ntags = 0
+        for tag_dir in ckdir.iterdir():
+            if tag_dir.is_dir():
+                (tag_dir / "index.json").write_text('{"leaves": [')
+                ntags += 1
+        assert ntags >= 3                     # final + two step tags
+
+        t2 = _make_trainer(mesh8, out, max_steps=8, save_every=4)
+        with pytest.raises(ValueError):       # the ORIGINAL error, loud
+            t2.try_resume()
+        assert t2.step == 0                   # no half-restored state
+
+
 # ---------------------------------------------------------------------------
 # serving: per-request deadlines + graceful drain
 # ---------------------------------------------------------------------------
@@ -489,3 +514,43 @@ def test_serving_sigterm_triggers_drain(serve_setup):
     finally:
         for sig, old in eng._old_handlers.items():
             signal.signal(sig, old)
+
+
+def test_serving_preemption_notice_mid_drain_is_idempotent(serve_setup):
+    """Preemption notices landing MID-DRAIN — the cluster agent retries
+    SIGTERM, plus a programmatic PreemptionHandler.request() — must not
+    double-cancel the already-shed queue or disturb the in-flight
+    decode: the drain keeps its nothing-dropped-mid-token guarantee."""
+    from dla_tpu.resilience.preemption import PreemptionHandler
+    from dla_tpu.serving import RequestState
+    eng = _engine(serve_setup, num_slots=1)
+    eng.install_drain_handler()
+    handler = PreemptionHandler(recorder=eng.recorder)
+    try:
+        p = _prompts(2, seed=13)
+        r_run = eng.submit(p[0], 5)
+        r_wait = eng.submit(p[1], 5)
+        eng.step()                          # r_run holds the slot
+        eng.begin_drain()                   # drain begins: queue shed
+        assert eng.result(r_wait).finish_reason == "cancelled"
+        cancelled = eng.metrics.requests_cancelled.value
+        eng.step()                          # mid-drain...
+        os.kill(os.getpid(), signal.SIGTERM)    # ...the retry arrives
+        time.sleep(0.05)
+        handler.request()                   # and the agent RPC path
+        assert eng.draining
+        assert handler.requested_local()
+        # idempotent: no double cancellation, no new terminal states
+        assert eng.metrics.requests_cancelled.value == cancelled
+        results = eng.run_until_drained(max_steps=500)
+        assert results[r_run].state is RequestState.FINISHED
+        assert len(results[r_run].generated) > 0
+        # the RPC-path request landed on the engine's flight recorder
+        assert any(e["kind"] == "preempt_requested"
+                   for e in eng.recorder.events)
+        assert eng.cache.allocator.used_count == 0
+        eng.scheduler.assert_consistent()
+    finally:
+        for sig, old in eng._old_handlers.items():
+            signal.signal(sig, old)
+        eng.close()
