@@ -879,6 +879,111 @@ def run_telemetry_bench() -> dict:
     }
 
 
+def run_introspect_bench() -> dict:
+    """XLA-introspection overhead target (dla_tpu/telemetry/
+    xla_introspect): the same tiny SFT run twice with telemetry on —
+    ``xla_introspect.enabled: true`` (AOT-dispatching wrapper,
+    per-call argument fingerprinting, cost/memory gauges) vs ``false``
+    (plain jit dispatch) — reporting ms/step overhead. Also asserts the
+    wrapper's zero-extra-compile contract (train_step_compiles == 1
+    both ways) and surfaces the compiled-fn analytics the wrapper read.
+
+    Deterministic, CPU-sized, in-process (no tunnel involved)."""
+    import shutil as _shutil
+    import tempfile
+
+    import jax
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.ops.fused_ce import model_fused_ce
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.training.trainer import Trainer
+
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=64, remat="none", dtype="float32",
+        param_dtype="float32")
+    micro, seq, max_steps = 2, 64, 24
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1, model=1, sequence=1))
+    model = Transformer(cfg)
+
+    def loss_fn(p, frozen, batch, rng):
+        del frozen, rng
+        loss, _ = model_fused_ce(model, p, batch)
+        return loss, {}
+
+    def batches(seed):
+        rs = np.random.RandomState(seed)
+        local_bs = micro * mesh.devices.size
+        while True:
+            yield {
+                "input_ids": rs.randint(1, cfg.vocab_size, (local_bs, seq)
+                                        ).astype(np.int32),
+                "attention_mask": np.ones((local_bs, seq), np.int32),
+                "labels": rs.randint(1, cfg.vocab_size, (local_bs, seq)
+                                     ).astype(np.int32),
+            }
+
+    def one_run(introspect: bool) -> tuple:
+        out_dir = tempfile.mkdtemp(prefix="dla_bench_xi_")
+        try:
+            config = {
+                "experiment_name": "bench_introspect",
+                "optimization": {
+                    "total_batch_size": micro * mesh.devices.size,
+                    "micro_batch_size": micro, "learning_rate": 1e-4,
+                    "max_train_steps": max_steps,
+                    "lr_scheduler": "constant", "max_grad_norm": 1.0,
+                },
+                "logging": {"output_dir": out_dir, "log_dir": None,
+                            "save_every_steps": 0,
+                            "log_every_steps": 8,
+                            "telemetry": {"enabled": True,
+                                          "xla_introspect": {
+                                              "enabled": introspect}}},
+                "hardware": {"gradient_accumulation_steps": 1},
+                "resilience": {"watchdog": {"enabled": False}},
+            }
+            with jax.sharding.set_mesh(mesh):
+                trainer = Trainer(config=config, mesh=mesh,
+                                  loss_fn=loss_fn,
+                                  params=model.init(jax.random.key(0)),
+                                  param_specs=model.partition_specs())
+                t0 = time.perf_counter()
+                trainer.fit(batches(0), rng=jax.random.key(1))
+                wall = time.perf_counter() - t0
+                stats = dict(getattr(trainer._jit_train_step, "stats",
+                                     None) or {})
+                return (wall * 1000.0 / max_steps,
+                        trainer.train_step_compiles, stats)
+        finally:
+            _shutil.rmtree(out_dir, ignore_errors=True)
+
+    base_ms, base_compiles, _ = one_run(introspect=False)
+    xi_ms, xi_compiles, stats = one_run(introspect=True)
+    overhead_ms = xi_ms - base_ms
+
+    return {
+        "metric": "introspect_overhead_ms_per_step",
+        "value": round(overhead_ms, 3),
+        "unit": "ms",
+        # ratio of introspected to plain-jit step time: ~1.0 = free
+        "vs_baseline": round(xi_ms / max(base_ms, 1e-9), 4),
+        "detail": {
+            "base_ms_per_step": round(base_ms, 3),
+            "introspect_ms_per_step": round(xi_ms, 3),
+            # both must be 1: the AOT wrapper adds ZERO extra compiles
+            "train_step_compiles_base": int(base_compiles),
+            "train_step_compiles_introspect": int(xi_compiles),
+            "xla_flops": stats.get("flops"),
+            "xla_bytes_accessed": stats.get("bytes_accessed"),
+            "roofline_compute_bound": stats.get("roofline_compute_bound"),
+            "steps": int(max_steps),
+        },
+    }
+
+
 def _child_env(mode: str) -> dict:
     from _cpuhost import prepend_pythonpath, scrubbed_cpu_env
     if mode == "cpu":
@@ -1006,6 +1111,13 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_telemetry_bench()))
+        return 0
+    if "introspect" in sys.argv[1:]:
+        # XLA-introspection overhead target: same in-process forced-CPU
+        # pattern; headline is ms/step added by the AOT wrapper
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_introspect_bench()))
         return 0
     mode = os.environ.get("DLA_BENCH_PLATFORM")
     if mode == "cpu":

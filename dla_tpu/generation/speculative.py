@@ -150,8 +150,14 @@ def build_speculative_generate_fn(
             pending_next = sample_from(re_keys[rnd], nxt_dist)
 
             # ---- retract the rejected suffix in BOTH caches: the
-            # pending column plus k accepted proposals stay
-            keep = 1 + k
+            # pending column plus k accepted proposals stay. Rows
+            # already done at round entry keep NOTHING: they spin with
+            # garbage k until the all-done exit, and 1 + k would keep
+            # growing their cache lengths — dead rows driving the
+            # batch-max position (and with it any length-derived
+            # switch, e.g. rope scaling's original-context threshold)
+            # past what the row actually holds
+            keep = jnp.where(done_at_entry, 0, 1 + k)
             t_cache = Transformer.retract_block(t_cache, keep, gamma)
             d_cache = Transformer.retract_block(d_cache, keep, gamma)
 
@@ -196,8 +202,8 @@ def build_speculative_generate_fn(
         state = (jnp.int32(0), t_cache, d_cache, p0, done0, ptr0, toks,
                  emits, jnp.zeros((), jnp.int32),
                  jnp.zeros((), jnp.int32))
-        (rnd, _, _, _, _, ptr, toks, emits, acc_total, prop_total) = \
-            jax.lax.while_loop(cond, round_body, state)
+        (rnd, t_cache, _, _, _, ptr, toks, emits, acc_total,
+         prop_total) = jax.lax.while_loop(cond, round_body, state)
 
         response_mask = emits.astype(jnp.int32)
         raw_ids = jnp.concatenate([input_ids, toks], axis=1)
@@ -213,6 +219,11 @@ def build_speculative_generate_fn(
             "accepted_tokens": acc_total,
             "proposal_slots": prop_total,  # live-row proposals offered
             "verify_rounds": rnd,
+            # target-cache logical lengths at exit: a row finished at
+            # round R must sit exactly at its frozen length, not at
+            # whatever the remaining rounds would have pushed it to —
+            # the regression surface for the done-row retraction above
+            "cache_lengths": t_cache["lengths"],
         }
 
     return generate

@@ -91,6 +91,12 @@ class ModelConfig:
     # additionally routes bf16 caches through it (fill-bounded reads vs
     # the XLA einsum's full-S reads — sweepable per chip); "off" forces
     # the XLA decode_attention path everywhere.
+    # Eligibility (transformer.decode_step): head_dim % 128 == 0 (lane
+    # alignment), num_heads/num_kv_heads <= the kernel's GQA group cap,
+    # and no multi-device auto mesh. An ineligible model falls back to
+    # the XLA path — with int8 KV that path re-materializes a bf16 cache
+    # copy per layer per step, so int8 + ineligible is SLOWER than bf16
+    # (logged once per shape at decode time).
     decode_kernel: str = "auto"
     # flash kernel tile sizes (0 = the kernel's measured default, 512).
     # 512-wide blocks measured ~1.8x faster than 128 on v5e; exposed so

@@ -102,17 +102,39 @@ def model_config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
     if cfg.attention_bias:
         out["attention_bias"] = True
     if cfg.rope_scaling:
-        out["rope_scaling"] = dict(cfg.rope_scaling)
-        if (cfg.rope_scaling.get("rope_type") == "longrope"
-                and "original_max_position_embeddings"
-                in cfg.rope_scaling):
-            # transformers reads the short/long switch point and the
-            # derived attention factor from the TOP-LEVEL attribute
-            # only (verified 4.57: a dict-level value is ignored) — a
-            # reload that missed this would silently use max_position_
-            # embeddings as the switch and never apply long_factor
-            out["original_max_position_embeddings"] = int(
-                cfg.rope_scaling["original_max_position_embeddings"])
+        # the importer folds top-level config.json fallbacks INTO the
+        # dict so ops/rotary needs no config back-reference; exporting
+        # those copies verbatim would persist values HF configs leave
+        # implicit, so strip any key that re-derives to the same value
+        # on the way back through hf_import._validated_rope_scaling
+        rs = dict(cfg.rope_scaling)
+        rope_type = rs.get("rope_type")
+        if (rope_type == "yarn"
+                and rs.get("original_max_position_embeddings")
+                == cfg.max_seq_length):
+            rs.pop("original_max_position_embeddings")
+        if (rope_type == "dynamic"
+                and rs.get("max_position_embeddings")
+                == cfg.max_seq_length):
+            rs.pop("max_position_embeddings")
+        if rope_type == "longrope":
+            orig = rs.get("original_max_position_embeddings")
+            if orig and int(orig) != int(cfg.max_seq_length):
+                # transformers reads the short/long switch point and the
+                # derived attention factor from the TOP-LEVEL attribute
+                # only (verified 4.57: a dict-level value is ignored) — a
+                # reload that missed this would silently use max_position_
+                # embeddings as the switch and never apply long_factor
+                out["original_max_position_embeddings"] = int(orig)
+                if rs.get("factor") == (float(cfg.max_seq_length)
+                                        / float(orig)):
+                    rs.pop("factor")
+            # dict-level copy is an importer artifact either way: the
+            # real switch point now lives at the top level, and an
+            # orig == max_seq_length value was the importer's own
+            # max_position_embeddings fallback
+            rs.pop("original_max_position_embeddings", None)
+        out["rope_scaling"] = rs
     if cfg.sliding_window:
         out["sliding_window"] = int(cfg.sliding_window)
         if _hf_model_type(cfg) == "qwen2":

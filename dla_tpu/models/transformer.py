@@ -1587,6 +1587,24 @@ class Transformer:
                       "but ineligible (head_dim % 128 != 0, GQA group "
                       f"> {_KGP}, or multi-device auto mesh) — decoding "
                       "via the XLA path", file=sys.stderr, flush=True)
+        if (cfg.decode_kernel == "auto" and self._kv_int8
+                and not kernel_eligible):
+            # 'auto' + int8 KV exists to dequantize in VMEM; an
+            # ineligible model silently pays the per-layer-per-step
+            # bf16 materialization the kernel was chosen to avoid —
+            # the exact regression the r5 sweep measured
+            key = ("decode_kernel_auto_int8", cfg.head_dim_,
+                   cfg.num_heads, tokens.shape)
+            if key not in _REPLICATED_FLASH_LOGGED and \
+                    jax.process_index() == 0:
+                _REPLICATED_FLASH_LOGGED.add(key)
+                print("[dla_tpu][decode] decode_kernel: 'auto' with an "
+                      "int8 KV cache but the fused kernel is ineligible "
+                      "(head_dim % 128 != 0, GQA group "
+                      f"> {_KGP}, or multi-device auto mesh) — each "
+                      "decode step dequantizes the full cache via XLA; "
+                      "expect int8 KV to run SLOWER than bf16 here",
+                      file=sys.stderr, flush=True)
 
         attn_bias = attn_bias_win = None
         if use_decode_kernel:

@@ -57,10 +57,16 @@ from dla_tpu.serving.scheduler import (
     Scheduler,
     SchedulerConfig,
 )
+from dla_tpu.telemetry.anomaly import AnomalyConfig, AnomalyMonitor
 from dla_tpu.telemetry.exporter import MetricsHTTPServer, ReadinessProbe
 from dla_tpu.telemetry.flight_recorder import FlightRecorder
+from dla_tpu.telemetry.mfu import MFUCalculator
 from dla_tpu.telemetry.slo import SLOWatch
 from dla_tpu.telemetry.trace import Tracer, get_tracer, install_tracer
+from dla_tpu.telemetry.xla_introspect import (
+    IntrospectedFunction,
+    register_live_bytes_gauge,
+)
 from dla_tpu.utils.profiling import ProfileWindow, annotate, step_annotation
 
 
@@ -117,6 +123,15 @@ class ServingConfig:
     fault_plan: Optional[str] = None
     # flight-recorder postmortem directory (None = in-memory ring only)
     postmortem_dir: Optional[str] = None
+    # XLA introspection (telemetry.xla_introspect): the three jitted
+    # entry points dispatch through IntrospectedFunction for retrace
+    # attribution + per-fn cost/memory/roofline gauges.
+    # {enabled: bool (default true), max_entries: int}
+    xla_introspect: Optional[Dict] = None
+    # anomaly auto-triage (telemetry.anomaly.AnomalyConfig fields as a
+    # dict) over inter-token latency and unattributed recompiles; the
+    # capture dumps land in postmortem_dir. None = off.
+    anomaly: Optional[Dict] = None
 
     @property
     def pages_per_slot(self) -> int:
@@ -246,6 +261,50 @@ class ServingEngine:
         self._decode = jax.jit(self._decode_fn)
         self._prefill = jax.jit(self._prefill_fn)
         self._prefill_chunk = jax.jit(self._prefill_chunk_fn)
+        # anomaly auto-triage over inter-token latency + unattributed
+        # recompiles; captures land next to the other postmortems
+        anomaly_cfg = AnomalyConfig.from_config(cfg.anomaly)
+        self.anomaly = None
+        if anomaly_cfg is not None:
+            self.anomaly = AnomalyMonitor(
+                anomaly_cfg, recorder=self.recorder, tracer=self.tracer,
+                registry=self.metrics.registry, out_dir=cfg.postmortem_dir)
+        # XLA introspection: the wrappers OWN dispatch via the AOT path,
+        # so the trace-time counters above still tick exactly once per
+        # compile (the serving compile-once pins are unchanged). Rooflines
+        # use the 2N inference cost model. First compiles never reach
+        # on_compile, so every event it forwards is a true recompile.
+        xi_cfg = dict(cfg.xla_introspect or {})
+        self.xla_introspect_enabled = bool(xi_cfg.get("enabled", True))
+        if self.xla_introspect_enabled:
+            n_params = sum(int(np.prod(x.shape))
+                           for x in jax.tree_util.tree_leaves(params))
+            dev = jax.devices()[0]
+            self.mfu_calc = MFUCalculator(
+                n_params, device_kind=getattr(dev, "device_kind", "cpu"),
+                platform=dev.platform, training=False)
+            register_live_bytes_gauge(self.metrics.registry)
+            max_entries = int(xi_cfg.get("max_entries", 16))
+            self._decode, self._prefill, self._prefill_chunk = (
+                IntrospectedFunction(
+                    name, fn, registry=self.metrics.registry,
+                    recorder=self.recorder, mfu_calc=self.mfu_calc,
+                    on_compile=self._on_recompile,
+                    max_entries=max_entries)
+                for name, fn in (("decode", self._decode),
+                                 ("prefill", self._prefill),
+                                 ("prefill_chunk", self._prefill_chunk)))
+        else:
+            self.mfu_calc = None
+
+    def _on_recompile(self, event: Dict) -> None:
+        """Recompile-event feed from the introspection wrappers: an
+        UNattributed one (nothing in the fingerprint changed, yet XLA
+        compiled) is an anomaly trigger after warmup."""
+        if self.anomaly is not None:
+            self.anomaly.note_recompile(
+                int(event.get("step") or self.engine_steps), event["fn"],
+                attributed=bool(event.get("attributed")))
 
     @staticmethod
     def _bucket_widths(geom: PageGeometry) -> List[int]:
@@ -475,6 +534,11 @@ class ServingEngine:
         needs a page in the same step. Returns the (rid, token) pairs
         emitted this step, in slot order — the streaming surface."""
         self.profile.on_step(self.engine_steps)
+        if self.xla_introspect_enabled:
+            # stamp compile events from this step's dispatches
+            self._decode.step = self.engine_steps
+            self._prefill.step = self.engine_steps
+            self._prefill_chunk.step = self.engine_steps
         emitted: List[Tuple[int, int]] = []
         with step_annotation(self.engine_steps, name="serve"):
             self._poll_faults()
@@ -497,6 +561,8 @@ class ServingEngine:
                 emitted.extend(self._decode_step())
         self.engine_steps += 1
         self.readiness.beat()
+        if self.anomaly is not None:
+            self.anomaly.on_step(self.engine_steps)
         self._mirror_cache_counters()
         m = self.metrics
         m.queue_depth.set(self.scheduler.queue_depth)
@@ -536,6 +602,8 @@ class ServingEngine:
         metrics endpoint). Device state is dropped with the object as
         usual."""
         self.profile.close()
+        if self.anomaly is not None:
+            self.anomaly.close()
         if self._installed_tracer:
             self.tracer.dump()
             install_tracer(None)     # don't leak into the next engine
@@ -922,7 +990,10 @@ class ServingEngine:
         elif not first_of_prefill and req.last_token_time is not None:
             # inter-token latency only between consecutive decode steps
             # (a re-prefill after eviction restarts the clock)
-            self.metrics.itl_ms.record((t - req.last_token_time) * 1000.0)
+            itl_ms = (t - req.last_token_time) * 1000.0
+            self.metrics.itl_ms.record(itl_ms)
+            if self.anomaly is not None:
+                self.anomaly.observe("itl_ms", itl_ms, self.engine_steps)
             if traced:
                 self.tracer.async_instant(
                     "request", "decode", req.rid, t=t,

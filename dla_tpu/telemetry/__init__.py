@@ -11,6 +11,8 @@ through (docs/OBSERVABILITY.md).
 - trace           — thread-aware spans exported as Chrome-trace JSON
 - aggregate       — pod-wide per-host step-time/goodput + straggler
 - slo             — rolling-window SLOs with burn-rate alerting
+- xla_introspect  — retrace attribution + compiled-fn cost/memory gauges
+- anomaly         — rolling median/MAD triage with one-shot capture
 """
 from dla_tpu.telemetry.registry import (
     CATALOG,
@@ -46,15 +48,27 @@ from dla_tpu.telemetry.exporter import MetricsHTTPServer, ReadinessProbe
 from dla_tpu.telemetry.trace import Tracer, get_tracer, install_tracer
 from dla_tpu.telemetry.aggregate import PodAggregator, SkewSimulator
 from dla_tpu.telemetry.slo import SLO, SLOWatch
+from dla_tpu.telemetry.xla_introspect import (
+    IntrospectedFunction,
+    live_array_bytes,
+    register_live_bytes_gauge,
+)
+from dla_tpu.telemetry.anomaly import (
+    AnomalyConfig,
+    AnomalyMonitor,
+    RollingDetector,
+)
 
 __all__ = [
-    "CATALOG", "CollectorConfig", "Counter", "FlightRecorder",
-    "FuncGauge", "Gauge", "Histogram", "MFUCalculator",
-    "MetricRegistry", "MetricSpec", "MetricsHTTPServer",
-    "PEAK_BF16_FLOPS", "PEAK_HBM_BW", "PodAggregator", "ReadinessProbe",
-    "SLO", "SLOWatch", "SkewSimulator", "StepClock", "Tracer",
-    "capture", "catalog_names", "collect_train_scalars",
-    "flops_per_token", "get_tracer", "hbm_bw_for", "install_tracer",
-    "is_catalog_name", "parse_prometheus_text", "peak_flops_for",
-    "prometheus_name", "stash_rms", "stash_scalar",
+    "AnomalyConfig", "AnomalyMonitor", "CATALOG", "CollectorConfig",
+    "Counter", "FlightRecorder", "FuncGauge", "Gauge", "Histogram",
+    "IntrospectedFunction", "MFUCalculator", "MetricRegistry",
+    "MetricSpec", "MetricsHTTPServer", "PEAK_BF16_FLOPS", "PEAK_HBM_BW",
+    "PodAggregator", "ReadinessProbe", "RollingDetector", "SLO",
+    "SLOWatch", "SkewSimulator", "StepClock", "Tracer", "capture",
+    "catalog_names", "collect_train_scalars", "flops_per_token",
+    "get_tracer", "hbm_bw_for", "install_tracer", "is_catalog_name",
+    "live_array_bytes", "parse_prometheus_text", "peak_flops_for",
+    "prometheus_name", "register_live_bytes_gauge", "stash_rms",
+    "stash_scalar",
 ]

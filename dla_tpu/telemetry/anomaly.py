@@ -1,0 +1,238 @@
+"""Anomaly auto-triage: rolling median/MAD detection over step time and
+inter-token latency, with one-shot evidence capture.
+
+A 3am step-time spike is useless to the on-call unless the run captured
+its own evidence. :class:`AnomalyMonitor` watches the per-step host
+metrics the loops already compute (trainer step wall time, serving ITL)
+through :class:`RollingDetector` — a robust z-score over a rolling
+window's median/MAD (median absolute deviation), immune to the very
+outliers it hunts. A breach, or any *unattributed* recompile after
+warmup (``xla_introspect`` saw the compile counter tick without a
+fingerprint delta), arms a ONE-SHOT capture covering the next K steps:
+
+- the host tracer's Chrome-trace ring is dumped to
+  ``anomaly_trace_step<N>.json`` (the ring is retrospective, so the dump
+  contains the anomalous steps themselves plus K steps of aftermath),
+- optionally a :class:`~dla_tpu.utils.profiling.ProfileWindow` is armed
+  for an xplane capture of the same K steps (``xplane_dir`` config key),
+- ``postmortem_anomaly.json`` is written through the flight recorder,
+  naming the metric, the window stats (median/MAD/z), and the captured
+  trace paths — the file ``tools/dla_doctor.py`` correlates offline.
+
+Triage is rate-limited (cooldown + a total capture budget) and disabled
+during warmup; it adds zero compiles — everything here is host-side
+arithmetic on scalars the loops already fetched.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: floor on the MAD as a fraction of the median: a near-constant window
+#: (synthetic clocks, perfectly steady steps) must not make microscopic
+#: jitter look like an infinite z-score.
+_MAD_FLOOR_FRAC = 0.05
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class RollingDetector:
+    """Robust one-sided outlier detector over a rolling window.
+
+    ``observe(x)`` returns a breach dict (median/mad/z) when ``x`` sits
+    ``z_threshold`` robust standard deviations ABOVE the window median
+    (only slow is anomalous), else None. The robust z uses the normal-
+    consistency constant: ``z = 0.6745 * (x - median) / MAD``. Breaching
+    samples are excluded from the window so an excursion cannot teach
+    the detector that slow is normal.
+    """
+
+    def __init__(self, window: int = 64, warmup: int = 16,
+                 z_threshold: float = 8.0):
+        self.window = max(8, int(window))
+        self.warmup = max(0, int(warmup))
+        self.z_threshold = float(z_threshold)
+        self.values: deque = deque(maxlen=self.window)
+        self.seen = 0
+        self.last_z = 0.0
+
+    def observe(self, x: float) -> Optional[Dict[str, float]]:
+        x = float(x)
+        breach = None
+        if self.seen >= self.warmup and len(self.values) >= 8:
+            med = _median(list(self.values))
+            mad = _median([abs(v - med) for v in self.values])
+            scale = max(mad, _MAD_FLOOR_FRAC * abs(med), 1e-12)
+            z = 0.6745 * (x - med) / scale
+            self.last_z = z
+            if z >= self.z_threshold:
+                breach = {"value": x, "median": med, "mad": mad, "z": z,
+                          "threshold": self.z_threshold,
+                          "window": float(len(self.values))}
+        self.seen += 1
+        if breach is None:
+            self.values.append(x)
+        return breach
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyConfig:
+    """The ``logging.telemetry.anomaly`` / ``ServingConfig.anomaly``
+    block (docs/OBSERVABILITY.md "Anomaly auto-capture")."""
+    enabled: bool = True
+    window: int = 64               # rolling-window samples per metric
+    warmup_steps: int = 16         # no triggers before this step
+    z_threshold: float = 8.0       # robust z-score trip line
+    capture_steps: int = 4         # K steps of aftermath per capture
+    cooldown_steps: int = 50       # min steps between triggers
+    max_captures: int = 4          # total capture budget per run
+    xplane_dir: Optional[str] = None  # arm a ProfileWindow too when set
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]]
+                    ) -> Optional["AnomalyConfig"]:
+        """None (block absent) or ``enabled: false`` -> None: the loops
+        skip the monitor entirely."""
+        if cfg is None:
+            return None
+        cfg = dict(cfg)
+        if not cfg.get("enabled", True):
+            return None
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in cfg.items() if k in known})
+
+
+class AnomalyMonitor:
+    """Detector bank + one-shot capture state machine for one loop.
+
+    Drive it with ``observe(metric, value, step)`` for each watched
+    scalar, ``note_recompile(...)`` from the compile-attribution path,
+    and ``on_step(step)`` once per loop iteration (advances an active
+    capture). ``close()`` flushes a capture cut short by the loop ending.
+    """
+
+    def __init__(self, cfg: AnomalyConfig, *, recorder, tracer=None,
+                 registry=None, out_dir: Optional[str] = None):
+        self.cfg = cfg
+        self.recorder = recorder
+        self.tracer = tracer
+        self.registry = registry
+        self.out_dir = out_dir
+        self.detectors: Dict[str, RollingDetector] = {}
+        self.triggers = 0
+        self.captures = 0
+        self.postmortem_paths: List[str] = []
+        self._capture: Optional[Dict[str, Any]] = None
+        self._last_trigger_step: Optional[int] = None
+        self._profile_window = None
+        if registry is not None:
+            self._c_triggers = _counter(registry,
+                                        "telemetry/anomaly/triggers")
+            self._c_captures = _counter(registry,
+                                        "telemetry/anomaly/captures")
+        else:
+            self._c_triggers = self._c_captures = None
+
+    # ------------------------------------------------------------ observers
+
+    def observe(self, metric: str, value: float, step: int) -> None:
+        det = self.detectors.get(metric)
+        if det is None:
+            det = self.detectors[metric] = RollingDetector(
+                window=self.cfg.window, warmup=self.cfg.warmup_steps,
+                z_threshold=self.cfg.z_threshold)
+        breach = det.observe(value)
+        if breach is not None and step >= self.cfg.warmup_steps:
+            self._trigger(step, trigger="metric", metric=metric, **breach)
+
+    def note_recompile(self, step: int, fn: str, attributed: bool,
+                       first: bool = False) -> None:
+        """Feed from the retrace-attribution path: a first compile is
+        expected, an attributed recompile is explained (named argument
+        change), an UNattributed one after warmup is an anomaly — some
+        shape leaked past the fingerprint, or the jit cache was thrashed
+        externally."""
+        if first or attributed or step < self.cfg.warmup_steps:
+            return
+        self._trigger(step, trigger="recompile", metric="recompile", fn=fn)
+
+    def on_step(self, step: int) -> None:
+        cap = self._capture
+        if cap is None:
+            return
+        if self._profile_window is not None:
+            self._profile_window.on_step(step)
+        cap["remaining"] -= 1
+        if cap["remaining"] <= 0:
+            self._finish(step)
+
+    def close(self) -> None:
+        if self._capture is not None:
+            self._finish(self._capture["trigger_step"])
+
+    # ------------------------------------------------------- capture machine
+
+    def _trigger(self, step: int, **info: Any) -> None:
+        if self._capture is not None:
+            return                       # already capturing this excursion
+        if self.captures >= self.cfg.max_captures:
+            return                       # budget spent: detector stays on,
+        last = self._last_trigger_step   # capture machinery stays quiet
+        if last is not None and step - last < self.cfg.cooldown_steps:
+            return
+        self.triggers += 1
+        self._last_trigger_step = step
+        if self._c_triggers is not None:
+            self._c_triggers.inc()
+        if self.recorder is not None:
+            self.recorder.record("anomaly", step=step, **info)
+        if self.cfg.xplane_dir:
+            self._profile_window = self._make_profile_window(step)
+        self._capture = {"trigger_step": step, "info": dict(info),
+                         "remaining": max(1, self.cfg.capture_steps)}
+
+    def _make_profile_window(self, step: int):
+        from dla_tpu.utils.profiling import ProfileWindow
+        pw = ProfileWindow({"trace_dir": self.cfg.xplane_dir,
+                            "start_step": step,
+                            "num_steps": self.cfg.capture_steps})
+        return pw if pw.enabled else None
+
+    def _finish(self, step: int) -> None:
+        cap, self._capture = self._capture, None
+        pw, self._profile_window = self._profile_window, None
+        if pw is not None:
+            pw.close()
+        trigger_step = cap["trigger_step"]
+        trace_path = None
+        if self.tracer is not None and getattr(self.tracer, "enabled",
+                                               False) and self.out_dir:
+            dumped = self.tracer.dump(
+                f"{self.out_dir}/anomaly_trace_step{trigger_step}.json")
+            trace_path = str(dumped) if dumped is not None else None
+        self.captures += 1
+        if self._c_captures is not None:
+            self._c_captures.inc()
+        extra = {"anomaly": {
+            **cap["info"],
+            "trigger_step": trigger_step,
+            "capture_end_step": step,
+            "capture_steps": self.cfg.capture_steps,
+            "trace_path": trace_path,
+            "xplane_dir": self.cfg.xplane_dir,
+        }}
+        if self.recorder is not None:
+            path = self.recorder.dump("anomaly", extra=extra)
+            if path is not None:
+                self.postmortem_paths.append(str(path))
+
+
+def _counter(registry, name: str):
+    inst = registry._instruments.get(name)
+    return inst if inst is not None else registry.counter(name)

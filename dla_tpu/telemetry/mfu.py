@@ -15,7 +15,16 @@ trainer, bench, and the sweep tools all read ONE set of peak numbers.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
+
+#: Documented tolerance for the XLA-vs-6N FLOPs cross-check
+#: (``MFUCalculator.check_estimate``). 6N ignores attention's quadratic
+#: term and counts fwd+bwd as exactly 3x forward, while XLA counts every
+#: lowered op (2mnk per matmul, rematerialized fwd under checkpointing,
+#: embedding gathers); on dense transformer steps the two land well
+#: inside +-35% of each other, and a larger divergence means one of the
+#: two numbers is wrong (docs/OBSERVABILITY.md "XLA introspection").
+ESTIMATE_TOLERANCE = 0.35
 
 #: Per-chip peak bf16 FLOP/s by device kind (substring match against
 #: jax's ``device_kind``). "cpu" is a nominal figure so CPU-hosted smoke
@@ -81,7 +90,9 @@ class MFUCalculator:
                  platform: str = "cpu", training: bool = True):
         self.n_params = int(n_params)
         self.device_kind = device_kind
+        self.platform = platform
         self.peak = peak_flops_for(device_kind, platform)
+        self.hbm_bw, self.hbm_bw_assumed = hbm_bw_for(device_kind, platform)
         self.flops_per_token = flops_per_token(self.n_params, training)
 
     def mfu(self, tokens_per_sec_per_chip: Optional[float]) -> float:
@@ -90,3 +101,43 @@ class MFUCalculator:
         if not tokens_per_sec_per_chip or self.peak <= 0:
             return 0.0
         return tokens_per_sec_per_chip * self.flops_per_token / self.peak
+
+    def roofline(self, flops: float, bytes_accessed: float
+                 ) -> Dict[str, float]:
+        """Analytic roofline verdict for one compiled function from its
+        ``cost_analysis()`` FLOPs and bytes accessed.
+
+        Arithmetic intensity (FLOPs per HBM byte) above the chip's ridge
+        point (peak FLOP/s over peak HBM bytes/s) means the function is
+        compute-bound; below it, bandwidth-bound. Values are plain
+        floats so they publish directly as gauges:
+        ``compute_bound`` 1.0/0.0, ``bw_assumed`` flags a fallback
+        bandwidth table entry (unknown chip)."""
+        intensity = (float(flops) / float(bytes_accessed)
+                     if bytes_accessed > 0 else 0.0)
+        ridge = self.peak / self.hbm_bw if self.hbm_bw > 0 else 0.0
+        return {
+            "intensity": intensity,
+            "ridge": ridge,
+            "compute_bound": 1.0 if intensity >= ridge else 0.0,
+            "bw_assumed": 1.0 if self.hbm_bw_assumed else 0.0,
+        }
+
+    def check_estimate(self, xla_flops: float, tokens: float,
+                       tolerance: float = ESTIMATE_TOLERANCE
+                       ) -> Dict[str, float]:
+        """Cross-check XLA's analytic FLOPs against the 6N estimate for
+        a step over ``tokens`` tokens. ``ratio`` is XLA / 6N (1.0 =
+        perfect agreement); ``within_tolerance`` is 0.0 when the
+        divergence exceeds ``tolerance`` — the flagged condition the
+        introspection layer publishes."""
+        # dla: disable=host-sync-in-hot-loop -- plain python floats from cost_analysis, no device fetch; called at logging cadence
+        estimate = self.flops_per_token * float(tokens)
+        # dla: disable=host-sync-in-hot-loop -- plain python floats from cost_analysis, no device fetch; called at logging cadence
+        ratio = float(xla_flops) / estimate if estimate > 0 else 0.0
+        return {
+            "estimate_flops": estimate,
+            "ratio": ratio,
+            "within_tolerance": (1.0 if abs(ratio - 1.0) <= tolerance
+                                 else 0.0),
+        }

@@ -245,6 +245,20 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "in-flight requests replayed after an engine rebuild"),
     _s("serving/supervisor/breaker_open", "gauge", "bool",
        "1 while the restart circuit breaker is tripped (draining)"),
+    # -- XLA introspection (telemetry.xla_introspect); per-fn series
+    #    (telemetry/xla/<fn>/flops, .../recompiles, ...) ride the
+    #    telemetry/xla/ dynamic prefix below
+    _s("telemetry/xla/recompiles", "counter", "compiles",
+       "re-traces observed across all introspected jitted fns"),
+    _s("telemetry/xla/live_bytes", "gauge", "bytes",
+       "total bytes of live jax arrays in this process (live-HBM proxy)",
+       "scrape"),
+    # -- anomaly auto-triage (telemetry.anomaly); per-metric series ride
+    #    the telemetry/anomaly/ dynamic prefix below
+    _s("telemetry/anomaly/triggers", "counter", "events",
+       "anomaly detector trips (z breach or unattributed recompile)"),
+    _s("telemetry/anomaly/captures", "counter", "captures",
+       "completed one-shot evidence captures (postmortem_anomaly.json)"),
     # -- resilience counters bridged into the registry (FuncGauge)
     _s("resilience/ckpt_saves_started", "counter", "saves"),
     _s("resilience/ckpt_saves_completed", "counter", "saves"),
@@ -262,7 +276,8 @@ CATALOG: Tuple[MetricSpec, ...] = (
 #: ``train/<k>`` / ``eval/<k>``; the per-layer collector emits
 #: ``train/rms/<param path>``).
 DYNAMIC_PREFIXES: Tuple[str, ...] = ("train/rms/", "train/aux/", "eval/",
-                                     "slo/")
+                                     "slo/", "telemetry/xla/",
+                                     "telemetry/anomaly/")
 
 #: Derived suffixes ``latency_summary`` appends to histogram base names.
 HISTOGRAM_SUFFIXES: Tuple[str, ...] = ("p50", "p95", "p99", "mean",

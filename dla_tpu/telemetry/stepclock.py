@@ -85,6 +85,9 @@ class StepClock:
         self.other_total = 0.0
         self.steps_ok = 0
         self.steps_failed = 0
+        # last completed attempt's wall time (ms): the anomaly monitor's
+        # per-step feed — no second timer around the same loop
+        self.last_wall_ms = 0.0
         # interval window (reset by interval_metrics)
         self._win: List[Dict[str, float]] = []
 
@@ -135,6 +138,7 @@ class StepClock:
             return
         t_end = self.now()
         wall = t_end - self._step_start
+        self.last_wall_ms = 1000.0 * wall
         seg = dict(self._seg_acc)
         other = max(0.0, wall - sum(seg.values()))
         compute = seg.get("compute", 0.0)

@@ -1,0 +1,347 @@
+"""XLA introspection: retrace attribution + compiled-function accounting.
+
+The telemetry spine measures the host side of a run; this module opens
+the XLA layer underneath it. Two blind spots it removes:
+
+- **Why did that recompile happen?** ``jax.jit`` silently re-traces when
+  any argument's shape/dtype/structure changes, and ``log_compiles``
+  only says *that* it happened. :class:`IntrospectedFunction` wraps a
+  jitted entry point, fingerprints every call's argument avals, and on a
+  fingerprint change names exactly which argument changed and how
+  (``batch['input_ids']: i32[8,16] -> i32[8,32]``) — emitted as a
+  ``compile`` flight-recorder event and ``telemetry/xla/*recompiles``
+  counters.
+
+- **What did XLA actually lower?** At each compile the wrapper reads
+  ``lowered.compile().cost_analysis()`` / ``memory_analysis()`` and
+  publishes per-function analytic FLOPs, bytes accessed, and
+  argument/output/temp/generated-code memory as always-on
+  ``telemetry/xla/<fn>/*`` gauges — the ``tools/scale_rehearsal.py``
+  offline pattern promoted into the live registry — plus a roofline
+  verdict (compute- vs bandwidth-bound) when given an
+  :class:`~dla_tpu.telemetry.mfu.MFUCalculator`.
+
+Zero extra compiles, by construction: the wrapper OWNS dispatch via the
+AOT path. The first call for a fingerprint runs ``jitted.lower(args)``
+(the ONE trace — the in-body trace-time compile counters tick exactly
+once) then ``.compile()``, and every subsequent call with the same
+fingerprint dispatches through the cached ``Compiled`` object without
+touching the tracing machinery. A changed fingerprint re-lowers, exactly
+as plain ``jax.jit`` would have re-traced — same compile count, but now
+attributed. Any AOT failure (an exotic backend, a Compiled call
+signature mismatch) permanently falls back to the raw jitted callable
+for that wrapper; attribution then still works from the fingerprint
+diff, only the cost/memory accounting is lost.
+
+Fingerprints deliberately cover structure + shape + dtype, not values:
+traced scalars (the guard EMA, fault injectors) change value every step
+and must never re-key the cache — mirroring jit's own cache key.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from dla_tpu.telemetry.mfu import MFUCalculator
+from dla_tpu.telemetry.registry import Counter, Gauge, MetricRegistry
+
+#: memory_analysis fields published as ``telemetry/xla/<fn>/<name>``.
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ("peak_memory_in_bytes", "peak_bytes"),
+)
+
+
+def _leaf_sig(x: Any) -> str:
+    """One argument leaf's cache-key contribution: ``dtype[shape]`` for
+    anything array-like (value changes never re-key, mirroring jit),
+    ``repr`` for static leaves (a changed static IS a retrace)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if isinstance(x, (bool, int, float, complex)):
+        # python scalars trace as weak-typed () arrays: key on the type,
+        # not the value, exactly like jit's weak-type cache key
+        return f"weak_{type(x).__name__}[]"
+    return f"static:{x!r}"
+
+
+def fingerprint_args(args: Tuple[Any, ...]) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    """(treedef string, ((arg path, leaf signature), ...)) — hashable,
+    and diffable leaf-by-leaf with human-readable paths."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(args)
+    sigs = tuple((f"args{jax.tree_util.keystr(path)}", _leaf_sig(leaf))
+                 for path, leaf in flat)
+    return (str(treedef), sigs)
+
+
+def diff_fingerprints(old, new, limit: int = 4) -> List[Dict[str, str]]:
+    """Name what changed between two fingerprints: up to ``limit``
+    ``{"arg", "old", "new"}`` rows. A structure (treedef / leaf count)
+    change is reported as one ``args`` row."""
+    if old is None:
+        return []
+    old_tree, old_sigs = old
+    new_tree, new_sigs = new
+    changes: List[Dict[str, str]] = []
+    if old_tree != new_tree or len(old_sigs) != len(new_sigs):
+        return [{"arg": "args", "old": "structure", "new": "structure "
+                 f"changed ({len(old_sigs)} -> {len(new_sigs)} leaves)"}]
+    for (path, osig), (_, nsig) in zip(old_sigs, new_sigs):
+        if osig != nsig:
+            changes.append({"arg": path, "old": osig, "new": nsig})
+            if len(changes) >= limit:
+                break
+    return changes
+
+
+def normalize_cost_analysis(cost: Any) -> Dict[str, float]:
+    """``Compiled.cost_analysis()`` returns a dict on new jax, a
+    one-element list of dicts on older releases; flatten either into
+    ``{"flops", "bytes_accessed", "transcendentals"}``."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    return {
+        "flops": float(cost.get("flops", 0.0) or 0.0),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0) or 0.0),
+        "transcendentals": float(cost.get("transcendentals", 0.0) or 0.0),
+    }
+
+
+def memory_stats(compiled: Any) -> Dict[str, float]:
+    """``memory_analysis()`` fields under their telemetry names; empty
+    when the backend does not implement compiled memory stats."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:                                 # noqa: BLE001
+        return {}
+    if ma is None:
+        return {}
+    out: Dict[str, float] = {}
+    for attr, name in _MEMORY_FIELDS:
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[name] = float(v)
+    return out
+
+
+def live_array_bytes() -> float:
+    """Total bytes of every live jax array in this process — the live-HBM
+    number (on TPU these buffers are HBM-resident). Read-through at
+    snapshot/scrape cadence via a FuncGauge, never per step."""
+    try:
+        arrays = jax.live_arrays()
+    except Exception:                                 # noqa: BLE001
+        return 0.0
+    total = 0
+    for a in arrays:
+        try:
+            total += int(a.nbytes)
+        except Exception:                             # noqa: BLE001
+            continue
+    return float(total)
+
+
+def register_live_bytes_gauge(registry: MetricRegistry):
+    """``telemetry/xla/live_bytes``: live-array byte total at scrape/log
+    cadence (idempotent per registry)."""
+    if "telemetry/xla/live_bytes" in registry._instruments:
+        return registry.get("telemetry/xla/live_bytes")
+    return registry.func_gauge("telemetry/xla/live_bytes", live_array_bytes)
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One compiled specialization: the AOT executable + its analysis."""
+    compiled: Any
+    stats: Dict[str, float]
+
+
+class IntrospectedFunction:
+    """Dispatch-owning wrapper around one jitted entry point.
+
+    Call it exactly like the jitted function. Attributes of interest:
+
+    - ``compiles`` / ``recompiles`` — wrapper-observed compile counts
+      (recompiles = compiles beyond the first)
+    - ``last_event`` — the compile event dict for the most recent
+      dispatch, ``None`` when the dispatch hit the cache (the trainer
+      reads this to tell attributed from unattributed compile-counter
+      ticks)
+    - ``stats`` — the latest compile's cost/memory analysis
+    - ``step`` — caller-maintained current step, stamped onto events
+    """
+
+    def __init__(self, name: str, jitted: Callable, *,
+                 registry: Optional[MetricRegistry] = None,
+                 recorder: Any = None,
+                 mfu_calc: Optional[MFUCalculator] = None,
+                 on_compile: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 enabled: bool = True,
+                 max_entries: int = 16):
+        self.name = name
+        self.jitted = jitted
+        self.registry = registry
+        self.recorder = recorder
+        self.mfu_calc = mfu_calc
+        self.on_compile = on_compile
+        self.enabled = enabled
+        self.max_entries = max(1, int(max_entries))
+        self.step: Optional[int] = None
+        self.compiles = 0
+        self.recompiles = 0
+        self.fallback = False
+        self.fallback_reason: Optional[str] = None
+        self.last_event: Optional[Dict[str, Any]] = None
+        self.stats: Dict[str, float] = {}
+        self._cache: "OrderedDict[Any, _Entry]" = OrderedDict()
+        self._last_fp = None
+
+    # ------------------------------------------------------------- dispatch
+
+    def __call__(self, *args):
+        self.last_event = None
+        if not self.enabled:
+            return self.jitted(*args)
+        # fingerprint BEFORE dispatch: donated buffers are dead after
+        fp = fingerprint_args(args)
+        if self.fallback:
+            if self._last_fp is not None and fp != self._last_fp:
+                self._emit_compile_event(fp, aot=False)
+            self._last_fp = fp
+            return self.jitted(*args)
+        entry = self._cache.get(fp)
+        if entry is None:
+            entry = self._compile(fp, args)
+            if entry is None:               # AOT failed -> raw jit path
+                self._last_fp = fp
+                return self.jitted(*args)
+        else:
+            self._cache.move_to_end(fp)
+        self._last_fp = fp
+        try:
+            return entry.compiled(*args)
+        except (TypeError, ValueError) as exc:
+            # Compiled-call signature/sharding mismatch the fingerprint
+            # could not see: drop to the raw jitted path for good (it
+            # re-traces, which the caller's compile counter will surface
+            # as an unattributed recompile)
+            self._note_fallback(f"aot call failed: {exc}")
+            return self.jitted(*args)
+
+    def _compile(self, fp, args) -> Optional[_Entry]:
+        is_recompile = self.compiles > 0
+        if is_recompile:
+            self._emit_compile_event(fp, aot=True)
+        try:
+            compiled = self.jitted.lower(*args).compile()
+        except Exception as exc:                      # noqa: BLE001
+            self._note_fallback(f"lower/compile failed: {exc}")
+            return None
+        self.compiles += 1
+        if not is_recompile and self.recorder is not None:
+            # first compile is expected, not a recompile: ring event only
+            # (last_event stays None so the caller reads it as attributed)
+            self.recorder.record("compile", step=self.step, fn=self.name,
+                                 first=True, attributed=True,
+                                 n_compiles=1, aot=True)
+        stats = dict(normalize_cost_analysis(
+            _safe_cost_analysis(compiled)))
+        stats.update(memory_stats(compiled))
+        if self.mfu_calc is not None and stats.get("flops"):
+            verdict = self.mfu_calc.roofline(
+                stats["flops"], stats.get("bytes_accessed", 0.0))
+            stats["roofline_intensity"] = verdict["intensity"]
+            stats["roofline_ridge"] = verdict["ridge"]
+            stats["roofline_compute_bound"] = verdict["compute_bound"]
+        self.stats = stats
+        self._publish(stats)
+        entry = _Entry(compiled, stats)
+        self._cache[fp] = entry
+        while len(self._cache) > self.max_entries:
+            self._cache.popitem(last=False)
+        return entry
+
+    # -------------------------------------------------------- event plumbing
+
+    def note_unattributed_compile(self, step: Optional[int] = None) -> None:
+        """The caller's trace-time compile counter ticked but this wrapper
+        saw no fingerprint delta (fallback-path re-trace, external jit
+        cache thrash): count and record it as an unattributed recompile so
+        it still shows up in the ring and the counters."""
+        if step is not None:
+            self.step = step
+        self._emit_compile_event(self._last_fp, aot=False)
+
+    def _emit_compile_event(self, new_fp, aot: bool) -> None:
+        changes = diff_fingerprints(self._last_fp, new_fp)
+        event = {
+            "fn": self.name,
+            "attributed": bool(changes),
+            "changed": changes,
+            "n_compiles": self.compiles + 1,
+            "aot": aot,
+        }
+        self.recompiles += 1
+        self.last_event = event
+        if self.registry is not None:
+            _get_counter(self.registry, "telemetry/xla/recompiles").inc()
+            _get_counter(self.registry,
+                         f"telemetry/xla/{self.name}/recompiles").inc()
+        if self.recorder is not None:
+            self.recorder.record("compile", step=self.step, **{
+                k: (v if k != "changed" else _changes_text(v))
+                for k, v in event.items()})
+        if self.on_compile is not None:
+            self.on_compile(dict(event, step=self.step))
+
+    def _note_fallback(self, reason: str) -> None:
+        self.fallback = True
+        self.fallback_reason = reason
+        if self.recorder is not None:
+            self.recorder.record("xla_introspect_fallback", step=self.step,
+                                 fn=self.name, reason=reason[:300])
+
+    def _publish(self, stats: Dict[str, float]) -> None:
+        if self.registry is None:
+            return
+        for key, value in stats.items():
+            _get_gauge(self.registry,
+                       f"telemetry/xla/{self.name}/{key}").set(value)
+
+
+def _safe_cost_analysis(compiled: Any) -> Any:
+    try:
+        return compiled.cost_analysis()
+    except Exception:                                 # noqa: BLE001
+        return {}
+
+
+def _changes_text(changes: List[Dict[str, str]]) -> str:
+    if not changes:
+        return "unattributed (no fingerprint delta)"
+    return "; ".join(f"{c['arg']}: {c['old']} -> {c['new']}"
+                     for c in changes)
+
+
+def _get_counter(registry: MetricRegistry, name: str) -> Counter:
+    inst = registry._instruments.get(name)
+    if inst is None:
+        inst = registry.counter(name)
+    return inst
+
+
+def _get_gauge(registry: MetricRegistry, name: str) -> Gauge:
+    inst = registry._instruments.get(name)
+    if inst is None:
+        inst = registry.gauge(name)
+    return inst

@@ -186,6 +186,28 @@ class AggregateSchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class XlaIntrospectSchema:
+    """``logging.telemetry.xla_introspect``: retrace attribution +
+    compiled-fn cost/memory gauges (telemetry.xla_introspect)."""
+    enabled: Any = None
+    max_entries: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalySchema:
+    """``logging.telemetry.anomaly``: rolling median/MAD auto-triage
+    with one-shot capture (telemetry.anomaly.AnomalyConfig)."""
+    enabled: Any = None
+    window: Any = None
+    warmup_steps: Any = None
+    z_threshold: Any = None
+    capture_steps: Any = None
+    cooldown_steps: Any = None
+    max_captures: Any = None
+    xplane_dir: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class TelemetrySchema:
     enabled: Any = None
     metrics_port: Any = None
@@ -194,6 +216,8 @@ class TelemetrySchema:
     collector: Optional[CollectorSchema] = None
     trace: Optional[TraceSchema] = None
     aggregate: Optional[AggregateSchema] = None
+    xla_introspect: Optional[XlaIntrospectSchema] = None
+    anomaly: Optional[AnomalySchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

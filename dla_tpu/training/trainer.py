@@ -55,9 +55,12 @@ from dla_tpu.resilience import (
     Watchdog,
 )
 from dla_tpu.telemetry import (
+    AnomalyConfig,
+    AnomalyMonitor,
     CollectorConfig,
     FlightRecorder,
     Gauge,
+    IntrospectedFunction,
     MFUCalculator,
     MetricRegistry,
     PodAggregator,
@@ -68,6 +71,8 @@ from dla_tpu.telemetry import (
     capture as telemetry_capture,
     collect_train_scalars,
     install_tracer,
+    live_array_bytes,
+    register_live_bytes_gauge,
 )
 from dla_tpu.training.optim import build_optimizer
 from dla_tpu.training.utils import StepTimer, check_batch_identity
@@ -192,6 +197,26 @@ class Trainer:
             self.n_params, getattr(dev, "device_kind", dev.platform),
             dev.platform)
         self.registry = MetricRegistry()
+        # ---- XLA introspection (telemetry.xla_introspect): the jitted
+        # train step dispatches through an AOT wrapper that attributes
+        # every recompile to the argument that changed and publishes
+        # cost/memory analysis as telemetry/xla/* gauges — zero extra
+        # compiles (the wrapper's lower() IS the one trace).
+        xi_cfg = dict(tel_cfg.get("xla_introspect", {}) or {})
+        self.xla_introspect_enabled = (tel_enabled
+                                       and bool(xi_cfg.get("enabled", True)))
+        self._xi_max_entries = int(xi_cfg.get("max_entries", 16))
+        # ---- anomaly auto-triage (telemetry.anomaly): rolling
+        # median/MAD over step time; a breach or unattributed recompile
+        # arms a one-shot evidence capture. Off unless the
+        # logging.telemetry.anomaly block is present.
+        anomaly_cfg = AnomalyConfig.from_config(tel_cfg.get("anomaly"))
+        self.anomaly = None
+        if anomaly_cfg is not None and tel_enabled:
+            self.anomaly = AnomalyMonitor(
+                anomaly_cfg, recorder=self.recorder, tracer=self.tracer,
+                registry=self.registry,
+                out_dir=log_cfg.get("log_dir") or ckpt_dir)
         # ---- resilience: async checkpointing, preemption, guard, watchdog
         self.resilience = ResilienceConfig.from_config(
             config.get("resilience"))
@@ -271,6 +296,10 @@ class Trainer:
                      lambda: self.preemption.requests_total)
         r.func_gauge("telemetry/trace_events", lambda: self.tracer.emitted)
         r.func_gauge("telemetry/trace_dropped", lambda: self.tracer.dropped)
+        if self.xla_introspect_enabled:
+            # live-HBM accounting: jax.live_arrays() byte total, read
+            # through at snapshot/scrape cadence only
+            register_live_bytes_gauge(r)
 
     def _registry_update(self, payload: Dict[str, Any]) -> None:
         """Mirror a log payload into the registry (gauges, lazily
@@ -400,6 +429,11 @@ class Trainer:
                            NamedSharding(self.mesh, P()),
                            None),
         )
+        if self.xla_introspect_enabled:
+            fn = IntrospectedFunction(
+                "train_step", fn, registry=self.registry,
+                recorder=self.recorder, mfu_calc=self.mfu_calc,
+                max_entries=self._xi_max_entries)
         self._jit_train_step = fn
         return fn
 
@@ -482,6 +516,10 @@ class Trainer:
                 self.readiness.beat()
                 self.recorder.record("step_end", step=self.step,
                                      loss=float(loss))
+                if self.anomaly is not None:
+                    self.anomaly.observe("step_ms", self.clock.last_wall_ms,
+                                         self.step)
+                    self.anomaly.on_step(self.step)
                 return loss, {k: float(v) for k, v in metrics.items()}
             verdict = self.guard.on_step(False, loss)
             if verdict == RETRY:
@@ -507,6 +545,8 @@ class Trainer:
                   else np.float32(0.0))
         self.profile.on_step(self.step)
         compiles_before = self.train_step_compiles
+        if isinstance(step_fn, IntrospectedFunction):
+            step_fn.step = self.step   # stamps compile events with the step
         with self.clock.segment("compute"), step_annotation(self.step):
             self.params, self.opt_state, loss, metrics = step_fn(
                 self.params, self.opt_state, self.frozen, batch, rng,
@@ -517,10 +557,29 @@ class Trainer:
             # the body traced during that dispatch -> this attempt's
             # compute is compile time, not goodput
             self.clock.mark_compile()
+            self._attribute_compile(step_fn)
         ok = (not self.guard.cfg.enabled
               # dla: disable=host-sync-in-hot-loop -- guard flag rides the same materialization as the loss fetch above
               or bool(float(metrics["guard_ok"])))
         return loss_f, metrics, ok
+
+    def _attribute_compile(self, step_fn) -> None:
+        """The trace-time compile counter ticked during that dispatch:
+        name why. The introspection wrapper's ``last_event`` carries the
+        argument diff; a tick it did not predict (AOT fallback re-trace)
+        is recorded as an UNattributed recompile — the anomaly monitor
+        treats those as triage triggers after warmup."""
+        if not isinstance(step_fn, IntrospectedFunction):
+            return
+        first = self.train_step_compiles == 1
+        ev = step_fn.last_event
+        if ev is None and not first:
+            step_fn.note_unattributed_compile(self.step)
+            ev = step_fn.last_event
+        if self.anomaly is not None:
+            self.anomaly.note_recompile(
+                self.step, "train_step",
+                attributed=bool(ev and ev.get("attributed")), first=first)
 
     # ------------------------------------------------------------- the loop
 
@@ -617,6 +676,22 @@ class Trainer:
                         payload.update(self.clock.interval_metrics())
                         payload["telemetry/mfu"] = self.mfu_calc.mfu(
                             payload.get("tokens_per_sec_per_chip"))
+                        if self.xla_introspect_enabled:
+                            payload["telemetry/xla/live_bytes"] = \
+                                live_array_bytes()
+                            xstats = getattr(self._jit_train_step,
+                                             "stats", None)
+                            if xstats and xstats.get("flops") and n_tokens:
+                                # analytic-FLOPs sanity: XLA's count vs the
+                                # 6N estimate the MFU gauge is built on
+                                chk = self.mfu_calc.check_estimate(
+                                    xstats["flops"], n_tokens)
+                                payload["telemetry/xla/train_step/"
+                                        "flops_vs_6n_ratio"] = chk["ratio"]
+                                # dla: disable=host-sync-in-hot-loop -- plain python float from the analytic check, no device fetch; gated by log_every
+                                wtol = float(chk["within_tolerance"])
+                                payload["telemetry/xla/train_step/"
+                                        "flops_within_tolerance"] = wtol
                         # pod view: one tiny allgather per interval (a
                         # rendezvous — every host reaches this at the
                         # same step); host 0 gets the pod-wide gauges
@@ -645,9 +720,15 @@ class Trainer:
                         self.save(data_state() if data_state else None,
                                   extra_aux)
                 self.clock.end_step(ok=True, step=self.step)
+                if self.anomaly is not None:
+                    self.anomaly.observe("step_ms", self.clock.last_wall_ms,
+                                         self.step)
+                    self.anomaly.on_step(self.step)
         finally:
             # a failed step must not lose an already-open trace window
             self.profile.close()
+            if self.anomaly is not None:
+                self.anomaly.close()
             if self.tracer.enabled:
                 self.tracer.dump()
             if self.watchdog is not None:

@@ -101,6 +101,20 @@ class ProfileWindow:
             jax.profiler.start_trace(self.trace_dir)
             self._active = True
 
+    def arm(self, start_step: int, num_steps: Optional[int] = None) -> None:
+        """Re-arm the one-shot window at runtime — the anomaly
+        auto-capture path (telemetry.anomaly) points an already-spent
+        window at the steps right after a detector trip. Resets the
+        done latch; a window currently capturing is left alone (the
+        open capture finishes first, exactly once)."""
+        if self._active:
+            return
+        self.start_step = int(start_step)
+        if num_steps is not None:
+            self.num_steps = max(1, int(num_steps))
+        self._done = False
+        self._captured = 0
+
     def close(self) -> None:
         if self._active:
             self._stop()

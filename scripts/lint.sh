@@ -1,17 +1,21 @@
 #!/usr/bin/env bash
 # CI static-analysis gate: run dla-lint over the default path set with
 # the committed baseline, emitting the machine-readable dla-report/1
-# JSON (the same schema tools/metrics_diff.py emits).
+# JSON (the same schema tools/metrics_diff.py emits), then run the
+# dla-doctor self-check against its committed fixture run directory so
+# a refactor that breaks postmortem correlation fails at commit time.
 #
 #   scripts/lint.sh                    # full run, JSON to stdout
 #   scripts/lint.sh dla_tpu/serving    # subset
 #
-# Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/input error.
+# Exit codes: 0 clean, 1 unsuppressed findings or a failed doctor
+# self-check, 2 usage/input error.
 # The baseline (tools/lint_baseline.json) is empty — the repo lints
 # clean — but gives CI a stable interface if a temporary exception is
 # ever needed: regenerate with
 #   python -m tools.dla_lint --write-baseline tools/lint_baseline.json
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m tools.dla_lint --format json \
+python -m tools.dla_lint --format json \
     --baseline tools/lint_baseline.json --root . "$@"
+python tools/dla_doctor.py --self-check >&2

@@ -1,0 +1,218 @@
+"""Anomaly auto-triage (docs/OBSERVABILITY.md "Anomaly auto-capture"):
+rolling median/MAD detection, the one-shot capture state machine, and
+the full acceptance loop — a DLA_FAULT_PLAN checkpoint stall trips the
+detector exactly once, the capture leaves a loadable Chrome trace plus
+a ``postmortem_anomaly.json`` referencing it, and ``dla-doctor``
+correlates the anomaly back to the checkpoint stall in its ranked
+diagnosis.
+"""
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from dla_tpu.telemetry import (
+    AnomalyConfig,
+    AnomalyMonitor,
+    FlightRecorder,
+    MetricRegistry,
+    RollingDetector,
+)
+from dla_tpu.telemetry.trace import Tracer, install_tracer
+
+
+# ---------------------------------------------------------------------------
+# detector: robust z over a rolling window
+# ---------------------------------------------------------------------------
+
+def test_rolling_detector_warmup_then_breach():
+    det = RollingDetector(window=16, warmup=8, z_threshold=6.0)
+    assert det.observe(1000.0) is None     # warmup: even a spike passes
+    for _ in range(9):
+        assert det.observe(10.0) is None
+    breach = det.observe(500.0)
+    assert breach is not None
+    assert breach["z"] >= 6.0
+    assert breach["median"] == pytest.approx(10.0, rel=0.5)
+
+
+def test_rolling_detector_excludes_breaches_from_window():
+    """A sustained excursion must not teach the detector that slow is
+    normal: breaching samples never enter the window."""
+    det = RollingDetector(window=16, warmup=0, z_threshold=6.0)
+    for _ in range(10):
+        det.observe(10.0)
+    for _ in range(20):
+        assert det.observe(500.0) is not None   # every one still breaches
+
+
+def test_rolling_detector_one_sided():
+    det = RollingDetector(window=16, warmup=0, z_threshold=6.0)
+    for _ in range(10):
+        det.observe(10.0)
+    assert det.observe(0.001) is None      # fast is never anomalous
+
+
+def test_anomaly_config_absent_or_disabled_is_none():
+    assert AnomalyConfig.from_config(None) is None
+    assert AnomalyConfig.from_config({"enabled": False}) is None
+    cfg = AnomalyConfig.from_config({"window": 8, "unknown_key": 1})
+    assert cfg is not None and cfg.window == 8
+
+
+# ---------------------------------------------------------------------------
+# monitor: one-shot capture, rate limiting, recompile triggers
+# ---------------------------------------------------------------------------
+
+def _monitor(tmp_path, **over):
+    cfg = AnomalyConfig(**{**dict(window=16, warmup_steps=8,
+                                  z_threshold=6.0, capture_steps=2,
+                                  cooldown_steps=100, max_captures=1),
+                           **over})
+    reg = MetricRegistry()
+    rec = FlightRecorder(capacity=64, out_dir=str(tmp_path))
+    tracer = Tracer(enabled=True, capacity=256,
+                    path=str(tmp_path / "trace.json"))
+    mon = AnomalyMonitor(cfg, recorder=rec, tracer=tracer,
+                         registry=reg, out_dir=str(tmp_path))
+    return mon, reg, rec
+
+
+def _drive(mon, steps, value=10.0, spike_at=None, spike=500.0):
+    for step in range(1, steps + 1):
+        x = spike if step == spike_at else value
+        mon.observe("step_ms", x, step)
+        mon.on_step(step)
+
+
+def test_breach_arms_exactly_one_capture_with_evidence(tmp_path):
+    mon, reg, rec = _monitor(tmp_path)
+    _drive(mon, steps=16, spike_at=12)
+    assert mon.triggers == 1 and mon.captures == 1
+    snap = reg.snapshot()
+    assert snap["telemetry/anomaly/triggers"] == 1.0
+    assert snap["telemetry/anomaly/captures"] == 1.0
+
+    # the postmortem names the metric, window stats, and the trace path
+    pm_path = tmp_path / "postmortem_anomaly.json"
+    assert pm_path.exists()
+    doc = json.loads(pm_path.read_text())
+    block = doc["anomaly"]
+    assert block["trigger"] == "metric" and block["metric"] == "step_ms"
+    assert block["trigger_step"] == 12
+    assert block["z"] >= 6.0
+    # K=2 aftermath counted from the trigger step itself
+    assert block["capture_end_step"] == 13
+
+    # the referenced capture trace exists and is loadable Chrome JSON
+    trace = tmp_path / "anomaly_trace_step12.json"
+    assert block["trace_path"] == str(trace)
+    parsed = json.loads(trace.read_text())
+    assert isinstance(parsed.get("traceEvents"), list)
+
+
+def test_capture_budget_and_cooldown_rate_limit(tmp_path):
+    mon, _, _ = _monitor(tmp_path, max_captures=1, cooldown_steps=100)
+    _drive(mon, steps=40, spike_at=12)
+    # a second excursion after the first finished: budget says no
+    mon.observe("step_ms", 500.0, 41)
+    mon.on_step(41)
+    assert mon.triggers == 1 and mon.captures == 1
+    assert len(mon.postmortem_paths) == 1
+
+    # with budget left, cooldown still spaces triggers out
+    mon2, _, _ = _monitor(tmp_path / "b", max_captures=4,
+                          cooldown_steps=50)
+    (tmp_path / "b").mkdir()
+    _drive(mon2, steps=16, spike_at=12)
+    mon2.observe("step_ms", 500.0, 20)      # 8 steps later: cooling down
+    assert mon2.triggers == 1
+    mon2.observe("step_ms", 500.0, 80)      # past cooldown: fires again
+    assert mon2.triggers == 2
+
+
+def test_unattributed_recompile_triggers_after_warmup(tmp_path):
+    mon, _, rec = _monitor(tmp_path)
+    mon.note_recompile(2, "train_step", attributed=False)   # warmup
+    mon.note_recompile(20, "train_step", attributed=True)   # explained
+    mon.note_recompile(21, "train_step", attributed=True, first=True)
+    assert mon.triggers == 0
+    mon.note_recompile(22, "train_step", attributed=False)  # the anomaly
+    assert mon.triggers == 1
+    anomalies = [e for e in rec.events if e["kind"] == "anomaly"]
+    assert anomalies[0]["trigger"] == "recompile"
+    assert anomalies[0]["fn"] == "train_step"
+
+
+def test_close_flushes_capture_cut_short(tmp_path):
+    mon, _, _ = _monitor(tmp_path, capture_steps=50)
+    _drive(mon, steps=12, spike_at=12)
+    assert mon.captures == 0               # capture still open
+    mon.close()
+    assert mon.captures == 1
+    assert (tmp_path / "postmortem_anomaly.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance loop: fault-injected checkpoint stall -> one capture
+# -> dla-doctor correlates it
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_stall_autocapture_and_doctor_correlation(
+        mesh8, tmp_path, monkeypatch):
+    """DLA_FAULT_PLAN injects an io_error into the async checkpoint at
+    step 5; the retry backoff stalls the step-10 save, the step-time
+    detector trips EXACTLY once, the capture leaves a loadable trace +
+    postmortem_anomaly.json referencing it, and dla-doctor ranks the
+    anomaly->checkpoint correlation first."""
+    from dla_tpu.resilience import ENV_VAR
+    from tests.test_telemetry import BatchIter, _make_trainer
+    out = tmp_path / "run"
+    monkeypatch.setenv(ENV_VAR, "step=5:io_error")
+    try:
+        with jax.sharding.set_mesh(mesh8):
+            tr = _make_trainer(
+                mesh8, out, max_steps=14, save_every=5,
+                telemetry={"trace": {"enabled": True},
+                           "anomaly": {"window": 16, "warmup_steps": 8,
+                                       "z_threshold": 6.0,
+                                       "capture_steps": 2,
+                                       "cooldown_steps": 50,
+                                       "max_captures": 1}},
+                resilience={"async_checkpointing": True,
+                            "save_retries": 3, "retry_backoff_s": 0.8})
+            it = BatchIter()
+            tr.fit(it, rng=jax.random.key(0), data_state=it.state_dict)
+            tr.checkpointer.wait()
+    finally:
+        install_tracer(None)
+
+    assert tr.checkpointer.retries_total == 1
+    assert tr.anomaly is not None
+    assert tr.anomaly.triggers == 1        # exactly one auto-capture
+    assert tr.anomaly.captures == 1
+    snap = tr.registry.snapshot()
+    assert snap["telemetry/anomaly/captures"] == 1.0
+
+    pm = out / "postmortem_anomaly.json"
+    assert pm.exists()
+    block = json.loads(pm.read_text())["anomaly"]
+    assert block["metric"] == "step_ms"
+    assert block["trigger_step"] == 10     # the stalled save's step
+    trace = out / f"anomaly_trace_step{block['trigger_step']}.json"
+    assert block["trace_path"] == str(trace)
+    parsed = json.loads(trace.read_text())  # loadable Chrome trace
+    assert len(parsed["traceEvents"]) > 0
+
+    # the offline correlator closes the loop: anomaly -> checkpoint
+    from tools.dla_doctor import diagnose, load_run
+    run = load_run(out)
+    findings = diagnose(run, out)
+    assert findings, "doctor produced no findings"
+    top = findings[0]
+    assert top["rule"] == "anomaly-correlated"
+    assert "checkpoint" in top["message"]
+    assert "loadable" in top["message"]
+    cause = top["data"]["cause"]
+    assert cause["kind"].startswith("ckpt_")

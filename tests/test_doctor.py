@@ -1,0 +1,231 @@
+"""dla-doctor (tools/dla_doctor.py): offline correlation of anomaly
+postmortems against ring events, Prometheus dumps, and bench snapshots
+— ranked most-likely-cause first, emitted as dla-report/1.
+
+The committed fixture under tests/fixtures/doctor_run/ is the same one
+``scripts/lint.sh`` self-checks at commit time; these tests pin its
+diagnosis in detail plus the scoring/correlation behaviour on synthetic
+runs, and the new telemetry/xla + telemetry/anomaly names through the
+metrics tooling (tools/check_metric_names.py, tools/metrics_diff.py).
+"""
+import json
+
+import pytest
+
+from dla_tpu.analysis.report import validate_report
+from tools.dla_doctor import (
+    SELF_CHECK_DIR,
+    correlate_anomaly,
+    diagnose,
+    load_run,
+    main,
+    self_check,
+)
+
+
+# ---------------------------------------------------------------------------
+# the committed fixture: known diagnosis, schema-valid report
+# ---------------------------------------------------------------------------
+
+def test_fixture_diagnosis_ranks_checkpoint_stall_first():
+    run = load_run(SELF_CHECK_DIR)
+    assert len(run["postmortems"]) == 1
+    assert run["metrics"]          # the .prom dump parsed
+    findings = diagnose(run, SELF_CHECK_DIR)
+    top = findings[0]
+    assert top["rule"] == "anomaly-correlated"
+    assert "checkpoint" in top["message"]
+    assert "loadable" in top["message"]      # trace verified, not assumed
+    rules = {f["rule"] for f in findings}
+    # the Prometheus checks fired on the fixture's dump
+    assert "metric-badput-checkpoint" in rules
+    assert "metric-recompiles" in rules
+
+
+def test_self_check_passes_on_committed_fixture(capsys):
+    assert self_check() == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_cli_json_output_is_valid_dla_report(capsys):
+    rc = main([str(SELF_CHECK_DIR), "--format", "json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    validate_report(doc)           # schema shared with dla-lint et al.
+    assert doc["tool"] == "dla-doctor"
+    assert doc["summary"]["anomalies"] == 1
+    assert doc["findings"][0]["rule"] == "anomaly-correlated"
+
+
+def test_cli_text_output_and_exit_codes(tmp_path, capsys):
+    rc = main([str(SELF_CHECK_DIR)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "most likely cause first" in out
+    # empty dir: clean diagnosis, still exit 0 (findings inform, not gate)
+    rc = main([str(tmp_path)])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+    # missing dir: usage error
+    assert main([str(tmp_path / "nope")]) == 2
+
+
+def test_self_check_fails_on_empty_dir(tmp_path, capsys):
+    assert self_check(tmp_path) == 1
+    assert "FAIL" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# correlation scoring on synthetic runs
+# ---------------------------------------------------------------------------
+
+def _pm(tmp_path, events, anomaly=None, name="postmortem_anomaly.json"):
+    doc = {"reason": "anomaly", "events": events}
+    if anomaly is not None:
+        doc["anomaly"] = anomaly
+    (tmp_path / name).write_text(json.dumps(doc))
+
+
+def test_nearer_cause_outranks_heavier_far_one():
+    events = [
+        {"t": 1.0, "kind": "ckpt_retry", "step": 4},       # w=3.5, d=6
+        {"t": 2.0, "kind": "ckpt_save_start", "step": 10},  # w=3.0, d=0
+    ]
+    causes = correlate_anomaly({"trigger_step": 10}, events, window=10)
+    assert causes[0]["kind"] == "ckpt_save_start"
+    assert causes[0]["score"] == pytest.approx(3.0)
+    assert causes[1]["score"] == pytest.approx(3.5 / 7.0)
+
+
+def test_first_compile_and_far_events_are_not_causes():
+    events = [
+        {"t": 1.0, "kind": "compile", "step": 10, "first": True},
+        {"t": 2.0, "kind": "ckpt_retry", "step": 50},       # outside window
+        {"t": 3.0, "kind": "step_end", "step": 10},         # not a cause kind
+    ]
+    assert correlate_anomaly({"trigger_step": 10}, events, window=10) == []
+
+
+def test_uncorrelated_anomaly_still_reported(tmp_path):
+    _pm(tmp_path, events=[], anomaly={"trigger": "metric",
+                                      "metric": "itl_ms",
+                                      "trigger_step": 30, "value": 900.0,
+                                      "median": 12.0, "z": 50.0})
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    assert findings[0]["rule"] == "anomaly-uncorrelated"
+    assert "no correlated ring event" in findings[0]["message"]
+
+
+def test_missing_capture_trace_is_called_out(tmp_path):
+    _pm(tmp_path, events=[{"t": 1.0, "kind": "ckpt_retry", "step": 30}],
+        anomaly={"trigger": "metric", "metric": "step_ms",
+                 "trigger_step": 30,
+                 "trace_path": str(tmp_path / "anomaly_trace_step30.json")})
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    assert "MISSING" in findings[0]["message"]
+
+
+def test_unattributed_recompile_outranks_attributed(tmp_path):
+    _pm(tmp_path, events=[
+        {"t": 1.0, "kind": "compile", "step": 3, "fn": "decode",
+         "attributed": True, "changed": "x: f32[2] -> f32[4]"},
+        {"t": 2.0, "kind": "compile", "step": 9, "fn": "decode",
+         "attributed": False},
+    ])
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    rules = [f["rule"] for f in findings]
+    assert rules.index("recompile-unattributed") \
+        < rules.index("recompile-attributed")
+    attributed = next(f for f in findings
+                      if f["rule"] == "recompile-attributed")
+    assert "f32[2] -> f32[4]" in attributed["message"]
+
+
+def test_flops_divergence_metric_check(tmp_path):
+    (tmp_path / "metrics.prom").write_text(
+        "dla_telemetry_xla_train_step_flops_within_tolerance 0.0\n")
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    assert any(f["rule"] == "metric-flops-divergence" for f in findings)
+
+
+def test_bench_overhead_rides_along(tmp_path):
+    (tmp_path / "bench_introspect.json").write_text(json.dumps(
+        {"metrics": {"introspect_overhead_ms_per_step": {
+            "vs_baseline_frac": 0.25}}}))
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    assert any(f["rule"] == "bench-overhead" for f in findings)
+
+
+def test_unreadable_artifacts_never_fatal(tmp_path):
+    (tmp_path / "postmortem_anomaly.json").write_text("{truncated")
+    (tmp_path / "anomaly_trace_step5.json").write_text("[oops")
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    assert sum(f["rule"] == "artifact-unreadable"
+               for f in findings) == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: the metrics tooling knows the new telemetry names
+# ---------------------------------------------------------------------------
+
+def test_new_telemetry_names_are_catalog_and_round_trip():
+    """telemetry/xla/* and telemetry/anomaly/* are declared (static
+    check passes over their emission sites — scripts/lint.sh enforces
+    it) and survive a Prometheus render/parse round trip."""
+    from dla_tpu.telemetry import (
+        MetricRegistry, is_catalog_name, parse_prometheus_text)
+    for name in ("telemetry/xla/recompiles", "telemetry/xla/live_bytes",
+                 "telemetry/xla/train_step/flops",
+                 "telemetry/xla/decode/roofline_intensity",
+                 "telemetry/anomaly/triggers",
+                 "telemetry/anomaly/captures"):
+        assert is_catalog_name(name), name
+
+    reg = MetricRegistry()
+    reg.counter("telemetry/xla/recompiles").inc()
+    reg.counter("telemetry/anomaly/triggers").inc()
+    reg.gauge("telemetry/xla/train_step/flops").set(1.5e9)
+    parsed = parse_prometheus_text(reg.prometheus_text())
+    flat = {name for name, _ in parsed}
+    assert "dla_telemetry_xla_recompiles_total" in flat
+    assert "dla_telemetry_anomaly_triggers_total" in flat
+    assert "dla_telemetry_xla_train_step_flops" in flat
+
+
+def test_metrics_diff_classifies_new_series(tmp_path, capsys):
+    """metrics_diff over two Prometheus dumps carrying the new series:
+    recompile counters are informational (direction unknown), the bench
+    overhead metric regresses when it grows."""
+    from tools.metrics_diff import direction, main as mdiff_main
+    assert direction("dla_telemetry_xla_recompiles_total") == 0
+    assert direction("introspect_overhead_ms_per_step") == -1
+    assert direction("telemetry/xla/live_bytes") == 0
+
+    base = tmp_path / "base.prom"
+    cand = tmp_path / "cand.prom"
+    base.write_text("dla_telemetry_xla_recompiles_total 0\n"
+                    "dla_telemetry_anomaly_captures_total 0\n")
+    cand.write_text("dla_telemetry_xla_recompiles_total 4\n"
+                    "dla_telemetry_anomaly_captures_total 1\n")
+    rc = mdiff_main([str(base), str(cand), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    validate_report(doc)
+    assert rc == 0                 # moved-but-informational: not a gate
+    moved = {f["data"]["metric"] for f in doc["findings"]
+             if f["rule"] == "metric-moved"}
+    assert "dla_telemetry_xla_recompiles_total" in moved
+
+    # the bench overhead target IS gated: growth = regression
+    b2, c2 = tmp_path / "b2.json", tmp_path / "c2.json"
+    b2.write_text('{"introspect_overhead_ms_per_step": 1.0}')
+    c2.write_text('{"introspect_overhead_ms_per_step": 2.0}')
+    assert mdiff_main([str(b2), str(c2), "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"][0]["rule"] == "metric-regression"
+
+
+def test_check_metric_names_accepts_new_emission_sites():
+    """The repo-wide static check stays green with the xla_introspect /
+    anomaly emission sites in tree (the names ride the CATALOG's
+    telemetry/xla/ and telemetry/anomaly/ dynamic prefixes)."""
+    from tools.check_metric_names import run
+    assert run() == 0

@@ -168,3 +168,65 @@ def test_export_longrope_round_trips_through_transformers(tmp_path):
             theirs = hf_model(torch.tensor(ids)).logits.numpy()
         np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=3e-4,
                                    err_msg=f"T={t}")
+
+
+def test_rope_scaling_export_strips_importer_injected_keys():
+    """hf_import._validated_rope_scaling folds top-level config.json
+    fallbacks INTO the rope_scaling dict (YaRN/longrope switch points,
+    dynamic's trained context) so ops/rotary needs no config
+    back-reference; export must strip them again so import -> export is
+    a fixed point and the exported config.json carries only what the
+    source HF config made explicit."""
+    def base(**extra):
+        d = {"model_type": "llama", "vocab_size": 128, "hidden_size": 64,
+             "intermediate_size": 128, "num_hidden_layers": 2,
+             "num_attention_heads": 4, "num_key_value_heads": 2,
+             "max_position_embeddings": 64}
+        d.update(extra)
+        return d
+
+    def roundtrip(hf_in):
+        cfg = hf_config_to_model_config(hf_in)
+        hf_out = model_config_to_hf(cfg)
+        cfg2 = hf_config_to_model_config(hf_out)
+        assert cfg2.rope_scaling == cfg.rope_scaling  # lossless
+        return hf_out
+
+    # yarn missing the switch point: the importer injects the top-level
+    # max_position_embeddings; export must NOT persist the injected copy
+    out = roundtrip(base(rope_scaling={"rope_type": "yarn",
+                                       "factor": 4.0}))
+    assert out["rope_scaling"] == {"rope_type": "yarn", "factor": 4.0}
+
+    # an EXPLICIT switch point differing from max_position_embeddings
+    # is real information and survives export
+    out = roundtrip(base(rope_scaling={
+        "rope_type": "yarn", "factor": 4.0,
+        "original_max_position_embeddings": 32}))
+    assert out["rope_scaling"]["original_max_position_embeddings"] == 32
+
+    # dynamic NTK: importer injects the trained context from the top
+    # level; export strips it back out
+    out = roundtrip(base(rope_scaling={"rope_type": "dynamic",
+                                       "factor": 2.0}))
+    assert out["rope_scaling"] == {"rope_type": "dynamic",
+                                   "factor": 2.0}
+
+    # longrope (phi-3 style): dict-level orig + derived factor are both
+    # importer artifacts; the switch point belongs at the TOP level only
+    short, long = [1.0] * 8, [2.0] * 8
+    out = roundtrip(base(
+        rope_scaling={"type": "longrope", "short_factor": short,
+                      "long_factor": long},
+        original_max_position_embeddings=16))
+    assert out["original_max_position_embeddings"] == 16
+    assert "original_max_position_embeddings" not in out["rope_scaling"]
+    assert "factor" not in out["rope_scaling"]
+
+    # longrope WITHOUT a top-level switch point: the importer's
+    # max_position_embeddings fallback must not materialize one
+    out = roundtrip(base(
+        rope_scaling={"type": "longrope", "short_factor": short,
+                      "long_factor": long}))
+    assert "original_max_position_embeddings" not in out
+    assert "original_max_position_embeddings" not in out["rope_scaling"]

@@ -224,3 +224,46 @@ def test_greedy_exact_on_gemma2_style_target(models):
                                   np.asarray(out["response_tokens"]))
     np.testing.assert_array_equal(np.asarray(ref["response_mask"]),
                                   np.asarray(out["response_mask"]))
+
+
+def test_done_rows_freeze_cache_lengths(models):
+    """Regression: a row that hits EOS in an early round must FREEZE its
+    target-cache length while stragglers keep running. Before the fix,
+    done rows kept `1 + garbage_k` columns every spin of the verify
+    loop, so their logical lengths grew with the batch-max round count —
+    dragging any length-derived switch (rope scaling's original-context
+    threshold) past what the row actually holds."""
+    target, tp, draft, dp = models
+    ids, mask = _prompts()
+    base = GenerationConfig(max_new_tokens=24, do_sample=False,
+                            eos_token_id=-1, pad_token_id=0)
+    probe = jax.jit(build_generate_fn(target, base))(
+        tp, ids, mask, jax.random.key(1))
+    # an EOS row 0 demonstrably emits early; row 1+ may run much longer
+    eos = int(np.asarray(probe["response_tokens"])[0, 2])
+    gen = GenerationConfig(max_new_tokens=24, do_sample=False,
+                           eos_token_id=eos, pad_token_id=0)
+    gamma = 3
+    out = jax.jit(build_speculative_generate_fn(
+        target, draft, gen, gamma=gamma, alloc_factor=4.0))(
+        tp, dp, ids, mask, jax.random.key(1))
+
+    emitted = np.asarray(out["response_mask"]).sum(axis=1)
+    cache_len = np.asarray(out["cache_lengths"])
+    prompt_len = np.asarray(mask).sum(axis=1)
+    rounds = int(out["verify_rounds"])
+    # the scenario is real: row 0 finished early, the loop kept going
+    assert emitted[0] < emitted.max()
+    assert rounds >= 3
+
+    # frozen: each row's cache length is bounded by what the row
+    # actually holds (prompt + emitted + at most gamma in-flight
+    # columns from its final live round), INDEPENDENT of how many
+    # rounds the stragglers added. The broken version grew done rows
+    # by >= 1 column per extra round.
+    for i in range(len(emitted)):
+        assert cache_len[i] <= prompt_len[i] + emitted[i] + gamma, \
+            (i, cache_len[i], prompt_len[i], emitted[i], rounds)
+    # and the early-finisher sits strictly below the straggler
+    live = int(np.argmax(emitted))
+    assert cache_len[0] < cache_len[live]

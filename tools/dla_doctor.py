@@ -1,0 +1,436 @@
+#!/usr/bin/env python
+"""Offline auto-triage over one run directory: correlate anomaly
+postmortems with the evidence the run left behind and print a ranked
+diagnosis.
+
+A run that died (or merely hiccuped) leaves artifacts scattered across
+its output directory: flight-recorder postmortems (``postmortem_*.json``),
+anomaly capture traces (``anomaly_trace_step*.json`` / ``trace.json``),
+a Prometheus dump (``*.prom`` / ``metrics*.txt``), and bench snapshots
+(``bench*.json``). ``dla-doctor`` reads them all and answers the on-call
+question — *what happened, and why?* — by matching each anomaly's
+trigger step against nearby ring events (checkpoint saves/retries,
+injected faults, XLA recompiles, load shedding, SLO burns, watchdog
+hangs), scoring candidates by kind weight over step distance, and
+emitting findings most-likely-cause first.
+
+Usage::
+
+    python tools/dla_doctor.py RUN_DIR                # ranked text
+    python tools/dla_doctor.py RUN_DIR --format json  # dla-report/1
+    python tools/dla_doctor.py --self-check           # committed fixture
+
+Exit codes: 0 diagnosis produced (findings are information, not a
+gate), 1 self-check failed, 2 usage/input error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dla_tpu.analysis.report import (  # noqa: E402
+    build_report, dump_report, finding_row)
+from dla_tpu.telemetry.registry import parse_prometheus_text  # noqa: E402
+
+SELF_CHECK_DIR = REPO / "tests" / "fixtures" / "doctor_run"
+
+#: ring-event kinds that plausibly CAUSE a step-time/ITL anomaly, with a
+#: human label and a base weight. Candidate score = weight / (1 + step
+#: distance), so a checkpoint retry AT the trigger step outranks a
+#: recompile three steps away.
+CAUSE_KINDS: Dict[str, Tuple[str, float]] = {
+    "ckpt_retry": ("checkpoint I/O retry", 3.5),
+    "fault_injected": ("injected fault", 3.5),
+    "ckpt_save_start": ("checkpoint save", 3.0),
+    "watchdog_hang": ("watchdog hang", 3.0),
+    "compile": ("XLA recompile", 2.5),
+    "preempt_requested": ("preemption request", 2.5),
+    "guard_bad_step": ("non-finite guard step", 2.5),
+    "ckpt_save_done": ("checkpoint save completion", 2.0),
+    "request_shed": ("load shedding", 2.0),
+    "degradation_cache_flush": ("degradation cache flush", 2.0),
+    "preemption_exit": ("preemption exit", 2.0),
+    "slo_burn": ("SLO burn alert", 1.5),
+}
+
+
+# ------------------------------------------------------------ run loading
+
+def load_run(run_dir: Path) -> Dict[str, Any]:
+    """Everything triage-relevant the directory holds. Unreadable files
+    are collected as errors, never fatal — a half-written artifact is
+    exactly what a crashed run leaves."""
+    run = {"postmortems": [], "metrics": {}, "bench": {},
+           "traces": {}, "errors": []}
+    for path in sorted(run_dir.glob("postmortem_*.json")):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError) as exc:
+            run["errors"].append(f"{path.name}: {exc}")
+            continue
+        doc["_path"] = path.name
+        run["postmortems"].append(doc)
+    for pattern in ("*.prom", "metrics*.txt"):
+        for path in sorted(run_dir.glob(pattern)):
+            try:
+                parsed = parse_prometheus_text(path.read_text())
+            except (OSError, ValueError) as exc:
+                run["errors"].append(f"{path.name}: {exc}")
+                continue
+            for (name, labels), value in parsed.items():
+                key = name
+                if labels:
+                    key += "{" + ",".join(
+                        f'{k}="{v}"' for k, v in labels) + "}"
+                run["metrics"][key] = value
+    for path in sorted(run_dir.glob("bench*.json")):
+        try:
+            run["bench"][path.name] = json.loads(path.read_text())
+        except (OSError, ValueError) as exc:
+            run["errors"].append(f"{path.name}: {exc}")
+    for pattern in ("trace*.json", "anomaly_trace_*.json"):
+        for path in sorted(run_dir.glob(pattern)):
+            if path.name in run["traces"]:
+                continue
+            run["traces"][path.name] = _load_trace(path, run["errors"])
+    return run
+
+
+def _load_trace(path: Path, errors: List[str]) -> int:
+    """-> number of Chrome-trace events, -1 when unloadable."""
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError) as exc:
+        errors.append(f"{path.name}: {exc}")
+        return -1
+    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    return len(events) if isinstance(events, list) else -1
+
+
+def _all_events(run: Dict[str, Any]) -> List[Dict]:
+    """Ring events across every postmortem, deduplicated — the dumps
+    overlap (each carries the whole ring at its moment of writing)."""
+    seen, out = set(), []
+    for pm in run["postmortems"]:
+        for evt in pm.get("events", ()):
+            if not isinstance(evt, dict):
+                continue
+            key = (evt.get("t"), evt.get("kind"), evt.get("step"),
+                   evt.get("fn"))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(evt)
+    return out
+
+
+# -------------------------------------------------------------- diagnosis
+
+def _anomaly_blocks(run: Dict[str, Any]) -> List[Dict]:
+    out = []
+    for pm in run["postmortems"]:
+        block = pm.get("anomaly")
+        if isinstance(block, dict):
+            out.append(dict(block, _path=pm["_path"]))
+    return out
+
+
+def correlate_anomaly(block: Dict, events: List[Dict],
+                      window: int) -> List[Dict]:
+    """Candidate causes for one anomaly, scored. ``window`` is the max
+    step distance considered (the ring also holds ancient events)."""
+    trigger_step = block.get("trigger_step")
+    if trigger_step is None:
+        return []
+    candidates = []
+    for evt in events:
+        kind = evt.get("kind")
+        spec = CAUSE_KINDS.get(kind)
+        if spec is None or evt.get("step") is None:
+            continue
+        if kind == "compile" and evt.get("first"):
+            continue               # warmup compile: expected, not a cause
+        dist = abs(int(evt["step"]) - int(trigger_step))
+        if dist > window:
+            continue
+        label, weight = spec
+        candidates.append({
+            "kind": kind, "label": label, "step": int(evt["step"]),
+            "distance": dist, "score": weight / (1.0 + dist),
+            "detail": {k: v for k, v in evt.items()
+                       if k not in ("t", "kind", "step")},
+        })
+    candidates.sort(key=lambda c: (-c["score"], c["distance"]))
+    return candidates
+
+
+def _describe_anomaly(block: Dict) -> str:
+    if block.get("trigger") == "recompile":
+        return (f"unattributed recompile of {block.get('fn', '?')} "
+                f"at step {block.get('trigger_step')}")
+    desc = (f"{block.get('metric', '?')} anomaly at step "
+            f"{block.get('trigger_step')}")
+    if block.get("z") is not None:
+        desc += (f" (value {block.get('value', 0):g} vs median "
+                 f"{block.get('median', 0):g}, z={block['z']:.1f})")
+    return desc
+
+
+def diagnose(run: Dict[str, Any], run_dir: Path,
+             window: int = 10) -> List[Dict]:
+    """-> dla-report finding rows, ranked most-likely-cause first."""
+    events = _all_events(run)
+    rows: List[Tuple[float, Dict]] = []
+
+    for block in _anomaly_blocks(run):
+        desc = _describe_anomaly(block)
+        causes = correlate_anomaly(block, events, window)
+        trace_note = _trace_note(block, run, run_dir)
+        if causes:
+            top = causes[0]
+            msg = (f"{desc} correlates with {top['label']} at step "
+                   f"{top['step']} (distance {top['distance']}, score "
+                   f"{top['score']:.2f})")
+            if trace_note:
+                msg += f"; {trace_note}"
+            rows.append((top["score"] + 10.0, finding_row(
+                "anomaly-correlated", block["_path"], 0, msg,
+                severity="warning",
+                data={"anomaly": _public(block), "cause": top,
+                      "runners_up": causes[1:3]})))
+        else:
+            msg = f"{desc}: no correlated ring event within {window} steps"
+            if trace_note:
+                msg += f"; {trace_note}"
+            rows.append((9.0, finding_row(
+                "anomaly-uncorrelated", block["_path"], 0, msg,
+                severity="warning", data={"anomaly": _public(block)})))
+
+    rows.extend(_recompile_rows(events))
+    rows.extend(_metric_rows(run))
+    rows.extend(_bench_rows(run))
+    for err in run["errors"]:
+        rows.append((0.5, finding_row(
+            "artifact-unreadable", err.split(":", 1)[0], 0,
+            f"unreadable artifact: {err}", severity="info")))
+
+    rows.sort(key=lambda r: -r[0])
+    return [row for _, row in rows]
+
+
+def _public(block: Dict) -> Dict:
+    return {k: v for k, v in block.items() if not k.startswith("_")}
+
+
+def _trace_note(block: Dict, run: Dict, run_dir: Path) -> str:
+    """The anomaly names its capture trace; check it is actually there
+    and loadable (the on-call's next click)."""
+    trace_path = block.get("trace_path")
+    if not trace_path:
+        return ""
+    name = Path(trace_path).name
+    n = run["traces"].get(name)
+    if n is None:
+        n = _load_trace(run_dir / name, []) \
+            if (run_dir / name).exists() else None
+    if n is None:
+        return f"capture trace {name} MISSING"
+    if n < 0:
+        return f"capture trace {name} unreadable"
+    return f"capture trace {name} loadable ({n} events)"
+
+
+def _recompile_rows(events: List[Dict]) -> List[Tuple[float, Dict]]:
+    """Recompiles outside any anomaly window still matter: attributed
+    ones name the argument that changed, unattributed ones are the
+    fingerprint-blind-spot signal."""
+    out = []
+    for evt in events:
+        if evt.get("kind") != "compile" or evt.get("first"):
+            continue
+        fn = evt.get("fn", "?")
+        if evt.get("attributed"):
+            out.append((2.0, finding_row(
+                "recompile-attributed", "flight-recorder", 0,
+                f"recompile of {fn} at step {evt.get('step')}: "
+                f"{evt.get('changed', '?')}", severity="info",
+                data=_public(evt))))
+        else:
+            out.append((4.0, finding_row(
+                "recompile-unattributed", "flight-recorder", 0,
+                f"unattributed recompile of {fn} at step "
+                f"{evt.get('step')} — no argument changed shape/dtype, "
+                "yet XLA compiled (jit cache thrash or fingerprint "
+                "blind spot)", severity="warning", data=_public(evt))))
+    return out
+
+
+#: Prometheus-dump checks: (metric, predicate, rule, message-template,
+#: severity, score).
+_METRIC_CHECKS = (
+    ("dla_telemetry_xla_recompiles_total", lambda v: v > 0,
+     "metric-recompiles", "{v:g} recompile(s) observed over the run",
+     "info", 1.5),
+    ("dla_telemetry_badput_checkpoint", lambda v: v > 0.10,
+     "metric-badput-checkpoint",
+     "{v:.0%} of wall clock lost to checkpoint stalls", "warning", 3.0),
+    ("dla_telemetry_badput_fault", lambda v: v > 0.10,
+     "metric-badput-fault",
+     "{v:.0%} of wall clock lost to failed step attempts", "warning",
+     3.0),
+    ("dla_telemetry_xla_train_step_flops_within_tolerance",
+     lambda v: v == 0.0, "metric-flops-divergence",
+     "XLA analytic FLOPs disagree with the 6N estimate beyond "
+     "tolerance — MFU or the cost model is wrong", "warning", 2.5),
+)
+
+
+def _metric_rows(run: Dict[str, Any]) -> List[Tuple[float, Dict]]:
+    out = []
+    metrics = run["metrics"]
+    for name, pred, rule, tmpl, severity, score in _METRIC_CHECKS:
+        v = metrics.get(name)
+        if v is not None and pred(v):
+            out.append((score, finding_row(
+                rule, "metrics-dump", 0, f"{name}: " + tmpl.format(v=v),
+                severity=severity, data={"metric": name, "value": v})))
+    return out
+
+
+def _bench_rows(run: Dict[str, Any]) -> List[Tuple[float, Dict]]:
+    """Bench snapshots ride along: any overhead fraction above 10% is
+    worth a line in the diagnosis."""
+    out = []
+    for fname, doc in run["bench"].items():
+        flat: Dict[str, float] = {}
+        _flatten(doc, "", flat)
+        for key, v in sorted(flat.items()):
+            if "overhead" in key and "frac" in key and v > 0.10:
+                out.append((1.0, finding_row(
+                    "bench-overhead", fname, 0,
+                    f"{key}: {v:.1%} overhead", severity="info",
+                    data={"metric": key, "value": v})))
+    return out
+
+
+def _flatten(obj: Any, prefix: str, out: Dict[str, float]) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(v, f"{prefix}{k}/", out)
+    elif isinstance(obj, (bool, int, float)):
+        out[prefix.rstrip("/")] = float(obj)
+
+
+# ----------------------------------------------------------------- output
+
+def _summary(run: Dict[str, Any], findings: List[Dict]) -> Dict:
+    return {
+        "postmortems": len(run["postmortems"]),
+        "anomalies": len(_anomaly_blocks(run)),
+        "metrics": len(run["metrics"]),
+        "traces": len(run["traces"]),
+        "bench_files": len(run["bench"]),
+    }
+
+
+def render_text(run_dir: Path, run: Dict[str, Any],
+                findings: List[Dict]) -> str:
+    lines = [f"dla-doctor: {run_dir}",
+             f"  artifacts: {len(run['postmortems'])} postmortem(s), "
+             f"{len(run['traces'])} trace(s), {len(run['metrics'])} "
+             f"metric(s), {len(run['bench'])} bench file(s)"]
+    if not findings:
+        lines.append("  diagnosis: clean — nothing to triage")
+        return "\n".join(lines) + "\n"
+    lines.append(f"  diagnosis ({len(findings)} finding(s), most likely "
+                 "cause first):")
+    for i, f in enumerate(findings, 1):
+        lines.append(f"  {i}. [{f['severity']}] [{f['rule']}] "
+                     f"{f['message']}")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------- self-check
+
+def self_check(run_dir: Path = SELF_CHECK_DIR) -> int:
+    """Run the doctor over the committed fixture and assert the known
+    diagnosis comes out: scripts/lint.sh runs this so a refactor that
+    breaks correlation fails at commit time."""
+    if not run_dir.is_dir():
+        print(f"dla-doctor --self-check: fixture missing: {run_dir}",
+              file=sys.stderr)
+        return 1
+    run = load_run(run_dir)
+    findings = diagnose(run, run_dir)
+    report = build_report("dla-doctor", findings,
+                          summary=_summary(run, findings))
+    dump_report(report)            # validates the schema round-trip
+    problems = []
+    if not findings:
+        problems.append("fixture produced no findings")
+    else:
+        top = findings[0]
+        if top["rule"] != "anomaly-correlated":
+            problems.append(
+                f"top finding is {top['rule']!r}, expected the "
+                "anomaly-checkpoint correlation to rank first")
+        elif "checkpoint" not in top["message"]:
+            problems.append(
+                f"top finding does not name the checkpoint stall: "
+                f"{top['message']!r}")
+        if not any("loadable" in f["message"] for f in findings
+                   if f["rule"].startswith("anomaly-")):
+            problems.append("capture trace was not verified loadable")
+    if problems:
+        for p in problems:
+            print(f"dla-doctor --self-check: FAIL: {p}", file=sys.stderr)
+        return 1
+    print(f"dla-doctor --self-check: OK ({len(findings)} finding(s) "
+          f"from {run_dir.relative_to(REPO)})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("run_dir", nargs="?", type=Path,
+                    help="run output directory to triage")
+    ap.add_argument("--window", type=int, default=10,
+                    help="max step distance for cause correlation "
+                         "(default 10)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json emits the shared dla-report/1 schema")
+    ap.add_argument("--self-check", action="store_true",
+                    help="diagnose the committed fixture run dir and "
+                         "verify the expected correlation ranks first")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if args.run_dir is None:
+        ap.error("run_dir is required (or pass --self-check)")
+    if not args.run_dir.is_dir():
+        print(f"dla-doctor: not a directory: {args.run_dir}",
+              file=sys.stderr)
+        return 2
+
+    run = load_run(args.run_dir)
+    findings = diagnose(run, args.run_dir, window=args.window)
+    if args.format == "json":
+        print(dump_report(build_report(
+            "dla-doctor", findings, summary=_summary(run, findings))),
+            end="")
+    else:
+        print(render_text(args.run_dir, run, findings), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
