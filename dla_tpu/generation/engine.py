@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from dla_tpu.models.transformer import Transformer
-from dla_tpu.ops.sampling import sample_token
+from dla_tpu.ops.sampling import sample_token, sample_token_per_row
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,12 +124,15 @@ def build_decode_step(model: Transformer, gen: GenerationConfig):
 
 
 def build_generate_fn(model: Transformer, gen: GenerationConfig,
-                      group_size: int = 1):
+                      group_size: int = 1,
+                      per_request_seeds: bool = False):
     """Returns a jittable ``fn(params, input_ids, attention_mask, rng)`` ->
     dict of device arrays:
 
       sequences/sequence_mask  [B, P+N]  prompt + response, left-aligned
       response_tokens/response_mask [B, N]
+      response_logps [B, N] chosen-token logprobs under the RAW model
+        distribution (zero where the mask is zero)
       lengths [B] total real tokens (prompt + generated, incl. eos)
 
     ``group_size`` G > 1 is the GRPO/best-of-N rollout shape: the caller
@@ -140,8 +143,18 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig,
     grouped ([p0 s0..sG-1, p1 s0..sG-1, ...]) and bit-identical to
     submitting each prompt G times in that same [B*G] batch order: the
     per-row decode math is batch-independent and the rng stream is keyed
-    by absolute step, so only the (deduplicated) prefill differs."""
+    by absolute step, so only the (deduplicated) prefill differs.
+
+    ``per_request_seeds=True`` swaps the final argument: ``fn(params,
+    input_ids, attention_mask, seeds)`` where ``seeds`` is a [B*G] uint32
+    array of per-row sampling seeds. Generated token k of row i is drawn
+    with ``fold_in(PRNGKey(seeds[i]), k)`` — the exact keying the serving
+    engine uses per request — so a serving-backed rollout with the same
+    seeds reproduces this path's tokens and logps bit-for-bit (the
+    sync-mode parity contract, pinned by test). The default mode keeps
+    the historical absolute-step rng stream byte-for-byte."""
     single_step = build_decode_step(model, gen)
+    eos = gen.eos_token_id if gen.eos_token_id is not None else -1
 
     def _expand(leaf):
         # cache leaves: pooled KV [L, B, S, KH, D] / int8 scales
@@ -167,11 +180,34 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig,
                                         axis=0)
             b = b * group_size
 
-        rngs = jax.random.split(rng, n)
         done0 = jnp.zeros((b,), bool)
+        if per_request_seeds:
+            seeds = rng.astype(jnp.uint32)           # [B*G] row seeds
+            temps = jnp.full(
+                (b,), gen.temperature if gen.do_sample else 0.0,
+                jnp.float32)
+            top_ps = jnp.full((b,), gen.top_p, jnp.float32)
+            top_ks = jnp.full((b,), gen.top_k, jnp.int32)
+        else:
+            rngs = jax.random.split(rng, n)
 
         def step_fn(step, logits, cache, done):
-            return single_step(rngs[step], params, logits, cache, done)
+            prev = logits.astype(jnp.float32)
+            if per_request_seeds:
+                tok, logp = sample_token_per_row(
+                    seeds, jnp.full((b,), step, jnp.int32), prev,
+                    temps, top_ps, top_ks)
+                tok = jnp.where(done, gen.pad_token_id, tok)
+                emit_mask = ~done
+                done = done | (tok == eos)
+                logits, cache = model.decode_step(params, cache, tok)
+            else:
+                tok, emit_mask, logits, cache, done = single_step(
+                    rngs[step], params, logits, cache, done)
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(prev, axis=-1),
+                    tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            return tok, logp, emit_mask, logits, cache, done
 
         if (gen.eos_token_id is not None and gen.eos_token_id >= 0
                 and gen.early_exit_chunk > 0 and n > 0):
@@ -185,13 +221,14 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig,
             nc = -(-n // c)
             toks0 = jnp.full((nc * c, b), gen.pad_token_id, jnp.int32)
             emits0 = jnp.zeros((nc * c, b), bool)
+            lps0 = jnp.zeros((nc * c, b), jnp.float32)
 
             def chunk_cond(state):
-                chunk, _, _, done, _, _ = state
+                chunk, _, _, done, _, _, _ = state
                 return (chunk < nc) & ~jnp.all(done)
 
             def chunk_body(state):
-                chunk, logits, cache, done, toks, emits = state
+                chunk, logits, cache, done, toks, emits, lps = state
 
                 def inner(carry, i):
                     logits, cache, done = carry
@@ -199,24 +236,26 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig,
                     # absolute step indexes the same pre-split keys;
                     # ragged-tail steps (>= n) reuse the last key (n-1)
                     # (their output is pad with a zero mask either way)
-                    tok, emit_mask, logits, cache, done = step_fn(
+                    tok, logp, emit_mask, logits, cache, done = step_fn(
                         jnp.minimum(step, n - 1), logits, cache, done)
                     emit_mask = emit_mask & (step < n)
                     tok = jnp.where(step < n, tok, gen.pad_token_id)
-                    return (logits, cache, done), (tok, emit_mask)
+                    return (logits, cache, done), (tok, emit_mask, logp)
 
-                (logits, cache, done), (ctoks, cemits) = jax.lax.scan(
+                (logits, cache, done), (ctoks, cemits, clps) = jax.lax.scan(
                     inner, (logits, cache, done), jnp.arange(c))
                 toks = jax.lax.dynamic_update_slice(
                     toks, ctoks, (chunk * c, 0))
                 emits = jax.lax.dynamic_update_slice(
                     emits, cemits, (chunk * c, 0))
-                return chunk + 1, logits, cache, done, toks, emits
+                lps = jax.lax.dynamic_update_slice(
+                    lps, clps, (chunk * c, 0))
+                return chunk + 1, logits, cache, done, toks, emits, lps
 
-            *_, toks, emits = jax.lax.while_loop(
+            *_, toks, emits, lps = jax.lax.while_loop(
                 chunk_cond, chunk_body,
-                (jnp.int32(0), logits, cache, done0, toks0, emits0))
-            toks, emits = toks[:n], emits[:n]
+                (jnp.int32(0), logits, cache, done0, toks0, emits0, lps0))
+            toks, emits, lps = toks[:n], emits[:n], lps[:n]
         elif gen.eos_token_id is not None and gen.eos_token_id >= 0:
             # early exit: a while_loop that stops once every row has hit
             # EOS — real savings for eval/teacher-gen/rollout batches
@@ -225,36 +264,41 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig,
             # indexed by step; unreached steps leave pad/0 rows).
             toks0 = jnp.full((n, b), gen.pad_token_id, jnp.int32)
             emits0 = jnp.zeros((n, b), bool)
+            lps0 = jnp.zeros((n, b), jnp.float32)
 
             def cond(state):
-                step, _, _, done, _, _ = state
+                step, _, _, done, _, _, _ = state
                 return (step < n) & ~jnp.all(done)
 
             def body(state):
-                step, logits, cache, done, toks, emits = state
-                tok, emit_mask, logits, cache, done = step_fn(
+                step, logits, cache, done, toks, emits, lps = state
+                tok, logp, emit_mask, logits, cache, done = step_fn(
                     step, logits, cache, done)
                 toks = jax.lax.dynamic_update_slice(
                     toks, tok[None, :], (step, 0))
                 emits = jax.lax.dynamic_update_slice(
                     emits, emit_mask[None, :], (step, 0))
-                return step + 1, logits, cache, done, toks, emits
+                lps = jax.lax.dynamic_update_slice(
+                    lps, logp[None, :], (step, 0))
+                return step + 1, logits, cache, done, toks, emits, lps
 
-            *_, toks, emits = jax.lax.while_loop(
+            *_, toks, emits, lps = jax.lax.while_loop(
                 cond, body,
-                (jnp.int32(0), logits, cache, done0, toks0, emits0))
+                (jnp.int32(0), logits, cache, done0, toks0, emits0, lps0))
         else:
             # no EOS (bench/fixed-length paths): plain scan over n steps
             def scan_body(carry, step):
                 logits, cache, done = carry
-                tok, emit_mask, logits, cache, done = step_fn(
+                tok, logp, emit_mask, logits, cache, done = step_fn(
                     step, logits, cache, done)
-                return (logits, cache, done), (tok, emit_mask)
+                return (logits, cache, done), (tok, emit_mask, logp)
 
-            (_, _, _), (toks, emits) = jax.lax.scan(
+            (_, _, _), (toks, emits, lps) = jax.lax.scan(
                 scan_body, (logits, cache, done0), jnp.arange(n))
         response_tokens = toks.T                      # [B, N]
         response_mask = emits.T.astype(jnp.int32)     # [B, N]
+        response_logps = jnp.where(                   # [B, N]
+            response_mask > 0, lps.T, 0.0)
 
         raw_ids = jnp.concatenate([input_ids, response_tokens], axis=1)
         raw_mask = jnp.concatenate(
@@ -265,6 +309,7 @@ def build_generate_fn(model: Transformer, gen: GenerationConfig,
             "sequence_mask": sequence_mask,
             "response_tokens": response_tokens,
             "response_mask": response_mask,
+            "response_logps": response_logps,
             "lengths": jnp.sum(raw_mask, axis=1),
         }
 

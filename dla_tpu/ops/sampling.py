@@ -7,10 +7,69 @@ eval_alignment.py:71-77). Here sampling is a pure jittable function of
 """
 from __future__ import annotations
 
+import dataclasses
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs carried through ``ServingEngine.submit``.
+
+    ``seed`` names the request's private PRNG stream: generated token k is
+    drawn with ``fold_in(PRNGKey(seed), k)``, so the stream depends only on
+    (seed, token index) — not on batch placement, slot assignment, or how
+    many other requests are in flight. Eviction/recompute and supervisor
+    replay therefore reproduce the identical continuation even for sampled
+    requests.
+
+    ``do_sample=False`` (or ``temperature == 0``) means greedy; both fold
+    into an effective temperature of 0.0, which is the in-graph greedy
+    switch in ``sample_token_per_row``.
+    """
+
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    seed: int = 0
+    do_sample: bool = True
+
+    @property
+    def effective_temperature(self) -> float:
+        if not self.do_sample:
+            return 0.0
+        return float(self.temperature)
+
+    @classmethod
+    def from_gen(cls, gen, seed: int) -> "SamplingParams":
+        """Engine defaults for a request with no explicit override."""
+        return cls(temperature=float(gen.temperature), top_p=float(gen.top_p),
+                   top_k=int(gen.top_k), seed=int(seed) & 0xFFFFFFFF,
+                   do_sample=bool(gen.do_sample))
+
+
+def derive_request_seed(base_seed: int, rid: int) -> int:
+    """Deterministic default seed for a request without an explicit
+    ``SamplingParams``. Depends only on (engine seed, rid); rids are
+    preserved across supervisor restarts (``restore(rid=...)``), so the
+    default stream also survives replay."""
+    return (int(base_seed) * 1000003 + int(rid) * 2654435761) & 0xFFFFFFFF
+
+
+def derive_rollout_seeds(rollout_seed: int, n: int) -> np.ndarray:
+    """Host-side per-row seeds for one rollout batch — shared by the
+    serving-backed RolloutEngine and the seeded ``build_generate_fn`` path
+    (identical inputs => identical streams => bit-identical rollouts)."""
+    idx = np.arange(n, dtype=np.uint64)
+    base = np.uint64(int(rollout_seed) & 0xFFFFFFFF)
+    vals = (base * np.uint64(0x9E3779B1) + idx * np.uint64(0x85EBCA6B)
+            ) & np.uint64(0xFFFFFFFF)
+    return vals.astype(np.uint32)
 
 
 def apply_temperature(logits: jnp.ndarray, temperature: float) -> jnp.ndarray:
@@ -87,3 +146,70 @@ def sample_token(
     logits = top_k_mask(logits, top_k)
     logits = top_p_mask(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def filter_logits_per_row(
+    logits: jnp.ndarray,   # [B, V]
+    temps: jnp.ndarray,    # [B] f32, <= 0 rows are greedy (filter unused)
+    top_ps: jnp.ndarray,   # [B] f32
+    top_ks: jnp.ndarray,   # [B] i32, <= 0 disables top-k for the row
+) -> jnp.ndarray:
+    """Temperature/top-k/top-p filtering with PER-ROW traced parameters.
+
+    One descending argsort serves both filters: top-k keeps sorted rank
+    < k, top-p then keeps the smallest prefix of the top-k-renormalized
+    distribution reaching p (the same ``(cum - probs) < p`` rule — and the
+    same k-then-p composition — as the static ``top_k_mask``/``top_p_mask``
+    pipeline). Traced k and p mean every request in a decode batch can
+    carry its own knobs without retracing — the decode compile count stays
+    pinned at 1.
+    """
+    x = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    v = x.shape[-1]
+    sort_idx = jnp.argsort(x, axis=-1)[..., ::-1]
+    sorted_x = jnp.take_along_axis(x, sort_idx, axis=-1)
+    ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
+    keep_k = (ranks < top_ks[:, None]) | (top_ks[:, None] <= 0)
+    sorted_probs = jax.nn.softmax(jnp.where(keep_k, sorted_x, NEG_INF),
+                                  axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep_p = (cum - sorted_probs) < top_ps[:, None]
+    keep_sorted = keep_p & keep_k
+    inv = jnp.argsort(sort_idx, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, x, NEG_INF)
+
+
+def sample_token_per_row(
+    seeds: jnp.ndarray,      # [B] uint32 per-request seeds
+    positions: jnp.ndarray,  # [B] i32 generated-token index (0 = first)
+    logits: jnp.ndarray,     # [B, V]
+    temps: jnp.ndarray,      # [B] f32 effective temperature (<= 0 = greedy)
+    top_ps: jnp.ndarray,     # [B] f32
+    top_ks: jnp.ndarray,     # [B] i32
+):
+    """Per-row sampled/greedy next token + chosen-token logprob.
+
+    Row i draws with ``fold_in(PRNGKey(seeds[i]), positions[i])`` where the
+    position is the generated-token index, so the stream is a pure function
+    of (seed, k): independent of batch placement, restarts and evictions.
+    The returned logprob is ``log_softmax`` of the RAW fp32 logits at the
+    chosen token — the model's actual distribution, not the
+    filtered/tempered one — so greedy logps match a recomputed forward
+    pass and the values are usable as behavior-policy logps downstream.
+
+    Returns ``(tokens [B] int32, logps [B] float32)``.
+    """
+    raw = logits.astype(jnp.float32)
+    logp_all = jax.nn.log_softmax(raw, axis=-1)
+    filt = filter_logits_per_row(raw, temps, top_ps, top_ks)
+
+    def draw(seed, position, row):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(draw)(seeds, positions, filt)
+    greedy = jnp.argmax(raw, axis=-1)
+    tok = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+    logp = jnp.take_along_axis(logp_all, tok[:, None], axis=-1)[:, 0]
+    return tok, logp

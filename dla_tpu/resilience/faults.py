@@ -52,10 +52,30 @@ burst           the Supervisor injects ``K`` synthetic requests at
                 overloading admission so shedding is exercised
 ==============  ===================================================
 
-The two scopes are disjoint: ``take(kind, step)`` only matches
-``step=`` entries and ``take(kind, step, site="engine_step")`` only
-matches ``engine_step=`` entries, so a co-located trainer and engine
-can share one plan string.
+Rollout scope: entries prefixed ``rollout_step=`` arm against the RLHF
+rollout counter (one rollout = one generated batch, spanning many
+engine steps). The RolloutEngine polls at each rollout's start and
+translates a fired entry into an ``engine_step=`` entry a few engine
+steps ahead on the live engine — so the failure lands MID-rollout, with
+requests partially generated, exercising supervisor
+restart-during-rollout::
+
+    DLA_FAULT_PLAN="rollout_step=1:device_error"
+
+==============  ===================================================
+device_error    a decode dispatch a few engine steps into the
+                rollout raises ``DeviceStepError`` (``arg`` = step
+                offset, default 2)
+nan_logits      same placement, raising ``NaNLogitsError``
+wedge           an engine step early in the rollout sleeps ``arg``
+                seconds (default 0.3), tripping the watchdog
+==============  ===================================================
+
+The three scopes are disjoint: ``take(kind, step)`` only matches
+``step=`` entries, ``take(kind, step, site="engine_step")`` only
+matches ``engine_step=`` entries, and likewise ``site="rollout_step"``
+— so a co-located trainer, engine, and rollout loop can share one plan
+string.
 """
 from __future__ import annotations
 
@@ -71,7 +91,13 @@ KNOWN_KINDS = ("io_error", "nan", "preempt", "hang")
 # serving-scoped kinds, legal only behind an ``engine_step=`` prefix
 SERVING_KINDS = ("wedge", "device_error", "nan_logits", "burst")
 
-_SITE_KINDS = {"step": KNOWN_KINDS, "engine_step": SERVING_KINDS}
+# rollout-scoped kinds, legal only behind a ``rollout_step=`` prefix:
+# polled by the RolloutEngine at rollout boundaries and re-armed as
+# engine_step entries so the failure fires mid-rollout
+ROLLOUT_KINDS = ("device_error", "nan_logits", "wedge")
+
+_SITE_KINDS = {"step": KNOWN_KINDS, "engine_step": SERVING_KINDS,
+               "rollout_step": ROLLOUT_KINDS}
 
 
 @dataclasses.dataclass
@@ -120,8 +146,8 @@ class FaultPlan:
             if len(fields) not in (2, 3) or site is None:
                 raise ValueError(
                     f"bad fault entry {part!r}; expected "
-                    f"'step=<N>:<kind>[:<arg>]' or "
-                    f"'engine_step=<N>:<kind>[:<arg>]'")
+                    f"'<site>=<N>:<kind>[:<arg>]' with site one of "
+                    f"{tuple(_SITE_KINDS)}")
             kind = fields[1].strip()
             arg: Optional[float] = None
             if "=" in kind:
@@ -146,6 +172,21 @@ class FaultPlan:
     @classmethod
     def from_env(cls) -> "FaultPlan":
         return cls.parse(os.environ.get(ENV_VAR, ""))
+
+    def add(self, fault: Fault) -> None:
+        """Append one entry to a live plan (thread-safe). The rollout
+        fault site uses this to translate a fired ``rollout_step`` entry
+        into an ``engine_step`` entry against the CURRENT engine's step
+        counter — the plan object is carried across supervisor rebuilds,
+        so the translated entry survives the restart it provokes (and,
+        being one-shot, never re-fires)."""
+        if fault.kind not in _SITE_KINDS.get(fault.site, ()):
+            raise ValueError(
+                f"unknown fault kind {fault.kind!r} for site "
+                f"{fault.site!r}")
+        with self._lock:
+            self.entries.append(fault)
+            self.entries.sort(key=lambda f: f.step)
 
     def take(self, kind: str, step: int,
              site: str = "step") -> Optional[Fault]:

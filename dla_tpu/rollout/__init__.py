@@ -1,0 +1,37 @@
+"""Disaggregated RLHF rollouts: the serving engine as rollout actor.
+
+``train_rlhf.py``'s batch path decodes through a fixed-shape
+``build_generate_fn`` where every row pays decode steps until the
+longest row finishes. This package routes rollout generation through
+the continuous-batching :class:`~dla_tpu.serving.server.ServingEngine`
+instead — paged KV, prefix-cache sharing of the G samples-per-prompt
+groups, chunked prefill, supervised restarts — and reassembles the
+results into the exact fixed-shape arrays the score/update path
+already consumes. See docs/RLHF.md.
+
+- :class:`RolloutEngine` — submit a rollout's prompt set, drain,
+  reassemble ``(sequences, response_tokens, response_logps, ...)``.
+- :class:`WeightRefitter` — publish updated policy params into the
+  live engine between rollouts, zero recompiles.
+- :class:`RolloutPipeline` — sync (bit-identical to the batch path)
+  or async (generate k+1 while the learner updates on k) pacing with
+  a staleness bound and truncated importance correction.
+"""
+from dla_tpu.rollout.engine import RolloutEngine, RolloutMetrics
+from dla_tpu.rollout.pipeline import (
+    RolloutPipeline,
+    apply_staleness_correction,
+    build_rollout_pipeline,
+    make_staleness_corrector,
+)
+from dla_tpu.rollout.refit import WeightRefitter
+
+__all__ = [
+    "RolloutEngine",
+    "RolloutMetrics",
+    "RolloutPipeline",
+    "WeightRefitter",
+    "apply_staleness_correction",
+    "build_rollout_pipeline",
+    "make_staleness_corrector",
+]

@@ -1,0 +1,300 @@
+"""RolloutEngine: drive the serving engine through one RLHF rollout.
+
+One rollout = one prompt batch generated to completion. Each unique
+prompt is submitted G = ``samples_per_prompt`` times with distinct
+per-request seeds; with the prefix cache on, the G-group's prompt pages
+alias (one prefill per unique prompt — the serving analog of
+``build_generate_fn``'s in-graph ``group_size`` expansion). The engine
+drains with continuous batching — short rows retire early and their
+slots immediately serve other rows, recovering the padding waste the
+fixed-shape batch path pays — and the results reassemble into the same
+right-padded arrays ``train_rlhf.py``'s scoring and PPO/GAE/reinforce
+updates already consume.
+
+Determinism: each row's token stream is a pure function of its
+(seed, token index) — see ops.sampling — so a rollout's outputs are
+independent of slot assignment, admission order, evictions, and
+supervisor restarts. Sync-mode rollouts are bit-identical to the
+seeded ``build_generate_fn`` path (pinned by test).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from dla_tpu.generation.engine import GenerationConfig
+from dla_tpu.ops.sampling import SamplingParams
+from dla_tpu.resilience.faults import Fault
+from dla_tpu.serving.resilience import Supervisor, SupervisorConfig
+from dla_tpu.serving.scheduler import RequestState
+from dla_tpu.serving.server import ServingConfig, ServingEngine
+from dla_tpu.telemetry.registry import MetricRegistry
+
+
+class RolloutMetrics:
+    """The ``rollout/*`` CATALOG panel (telemetry.registry): rollout
+    throughput, padding-waste recovery, refit cost, and async
+    staleness. Lives on the RolloutEngine (not the serving engine's
+    registry) so it survives supervisor rebuilds."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        r = self.registry = registry or MetricRegistry()
+        self.rollouts = r.counter("rollout/rollouts")
+        self.gen_tokens_per_s = r.gauge("rollout/gen_tokens_per_s")
+        self.slot_steps_per_token = r.gauge("rollout/slot_steps_per_token")
+        self.padding_waste_recovered = r.gauge(
+            "rollout/padding_waste_recovered")
+        self.refits = r.counter("rollout/refits")
+        self.refit_ms = r.gauge("rollout/refit_ms")
+        self.staleness = r.gauge("rollout/staleness_updates")
+        self.stale_rollouts = r.counter("rollout/stale_rollouts")
+        self.discarded_rollouts = r.counter("rollout/discarded_rollouts")
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "rollout/rollouts": self.rollouts.value,
+            "rollout/gen_tokens_per_s": self.gen_tokens_per_s.value,
+            "rollout/slot_steps_per_token":
+                self.slot_steps_per_token.value,
+            "rollout/padding_waste_recovered":
+                self.padding_waste_recovered.value,
+            "rollout/refits": self.refits.value,
+            "rollout/refit_ms": self.refit_ms.value,
+            "rollout/staleness_updates": self.staleness.value,
+            "rollout/stale_rollouts": self.stale_rollouts.value,
+            "rollout/discarded_rollouts": self.discarded_rollouts.value,
+        }
+
+
+class RolloutEngine:
+    """The ServingEngine as RLHF rollout actor.
+
+    ``generate(ids, mask, seeds)`` takes the batch path's inputs —
+    right-padded prompt ids/mask ``[B, P]`` and per-row seeds
+    ``[B * G]`` — and returns the batch path's outputs (sequences,
+    response tokens/mask/logps, lengths) plus ``prompt_lens``, all
+    fixed-shape ``[B*G, ...]`` device arrays.
+
+    ``supervisor`` (a dict of SupervisorConfig fields, or ``True`` for
+    defaults) wraps the engine in the serving Supervisor: engine
+    failures mid-rollout tear down, rebuild with the CURRENT published
+    params, and replay — the rollout completes with bit-identical
+    outputs. ``rollout_step=`` fault-plan entries are polled at each
+    rollout's start and re-armed as ``engine_step=`` entries a few
+    engine steps ahead, so injected failures land mid-rollout.
+    """
+
+    def __init__(self, model, params, gen: GenerationConfig,
+                 cfg: ServingConfig, *,
+                 samples_per_prompt: int = 1,
+                 supervisor=None,
+                 metrics: Optional[RolloutMetrics] = None):
+        self.model = model
+        self.gen = gen
+        self.cfg = cfg
+        self.G = int(samples_per_prompt)
+        if self.G < 1:
+            raise ValueError("samples_per_prompt must be >= 1")
+        self._params = params
+        # every engine generation ever built (supervisor rebuilds append):
+        # per-rollout decode-step deltas sum across generations
+        self._engines: List[ServingEngine] = []
+        self.metrics = metrics or RolloutMetrics()
+        self.rollouts_started = 0
+
+        def factory() -> ServingEngine:
+            eng = ServingEngine(model, self._params, gen, cfg)
+            self._engines.append(eng)
+            return eng
+
+        if supervisor:
+            sup_cfg = (SupervisorConfig()
+                       if supervisor is True
+                       else SupervisorConfig.from_config(dict(supervisor)))
+            self.supervisor: Optional[Supervisor] = Supervisor(
+                factory, sup_cfg)
+        else:
+            self.supervisor = None
+            factory()
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def engine(self) -> ServingEngine:
+        """The CURRENT engine generation (rebuilds swap it)."""
+        if self.supervisor is not None:
+            return self.supervisor.engine
+        return self._engines[-1]
+
+    def publish_params(self, params, donate: bool = False) -> None:
+        """Swap the live engine's param tree in place (structure/shape/
+        dtype-validated — zero recompiles) AND the factory's source, so
+        a supervisor rebuild mid-rollout comes back with the refitted
+        weights, not the originals."""
+        self.engine.publish_params(params, donate=donate)
+        self._params = params
+
+    def close(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.close()
+        else:
+            self.engine.close()
+
+    def _decode_steps_total(self) -> int:
+        return sum(int(e.metrics.decode_steps.value)
+                   for e in self._engines)
+
+    def _poll_rollout_faults(self) -> None:
+        """Translate due ``rollout_step=`` plan entries into
+        ``engine_step=`` entries a few engine steps ahead on the live
+        engine — the failure then fires MID-rollout (requests partially
+        generated), exercising restart-during-rollout. The plan object
+        is carried across supervisor rebuilds, so one-shot consumption
+        survives the restart the entry provokes."""
+        plan = getattr(self.engine, "faults", None)
+        if not plan:
+            return
+        idx = self.rollouts_started
+        eng = self.engine
+        for kind in ("device_error", "nan_logits", "wedge"):
+            f = plan.take(kind, idx, site="rollout_step")
+            if f is None:
+                continue
+            if kind == "wedge":
+                # arg keeps its engine_step meaning (sleep seconds)
+                at, arg = eng.engine_steps + 1, f.arg
+            else:
+                # arg = engine-step offset into the rollout (default 2:
+                # past the first prefill+decode, well before drain)
+                at = eng.engine_steps + (2 if f.arg is None
+                                         else max(1, int(f.arg)))
+                arg = None
+            eng.recorder.record("rollout_fault", rollout=idx,
+                                fault=kind, engine_step=at)
+            plan.add(Fault(step=at, kind=kind, arg=arg,
+                           site="engine_step"))
+
+    # ------------------------------------------------------------- rollouts
+
+    def generate(self, ids: np.ndarray, mask: np.ndarray,
+                 seeds: Sequence[int],
+                 max_new: Optional[Sequence[int]] = None
+                 ) -> Dict[str, jnp.ndarray]:
+        """Run one rollout: ``ids``/``mask`` are ``[B, P]`` right-padded
+        unique prompts, ``seeds`` is ``[B * G]`` per-row sampling seeds
+        laid out grouped (``[p0 s0..sG-1, p1 s0..sG-1, ...]`` — the
+        ``group_size`` layout). Submits all B*G requests (G seeded
+        copies per prompt share prefix-cache pages), drains the engine,
+        and reassembles fixed-shape right-padded arrays. ``max_new``
+        optionally overrides ``gen.max_new_tokens`` per row (bench's
+        long-tail mix)."""
+        ids = np.asarray(ids)
+        mask = np.asarray(mask)
+        b_unique, p_width = ids.shape
+        rows = b_unique * self.G
+        seeds = list(seeds)
+        if len(seeds) != rows:
+            raise ValueError(
+                f"need {rows} seeds ({b_unique} prompts x G={self.G}), "
+                f"got {len(seeds)}")
+        if max_new is not None and len(max_new) != rows:
+            raise ValueError(
+                f"max_new must have {rows} entries, got {len(max_new)}")
+        driver = self.supervisor if self.supervisor is not None \
+            else self.engine
+        idx = self.rollouts_started
+        self.rollouts_started += 1
+        self._poll_rollout_faults()
+        eng = self.engine
+        eng.recorder.record("rollout_begin", step=eng.engine_steps,
+                            rollout=idx, requests=rows)
+        steps0 = self._decode_steps_total()
+        t0 = eng.now()
+        order: List[int] = []
+        for i in range(b_unique):
+            toks = [int(t) for t, m in zip(ids[i], mask[i]) if m]
+            for g in range(self.G):
+                row = i * self.G + g
+                sp = SamplingParams(
+                    temperature=float(self.gen.temperature),
+                    top_p=float(self.gen.top_p),
+                    top_k=int(self.gen.top_k),
+                    seed=int(seeds[row]) & 0xFFFFFFFF,
+                    do_sample=bool(self.gen.do_sample))
+                n_new = (int(self.gen.max_new_tokens) if max_new is None
+                         else int(max_new[row]))
+                order.append(driver.submit(toks, n_new, sampling=sp))
+        self._drain(driver)
+        out = self._assemble(driver, order, p_width, max_new)
+        eng = self.engine          # may have been rebuilt mid-rollout
+        t1 = eng.now()
+        steps = self._decode_steps_total() - steps0
+        tokens = int(np.sum(np.asarray(out["response_mask"])))
+        m = self.metrics
+        m.rollouts.inc()
+        if t1 > t0:
+            m.gen_tokens_per_s.set(tokens / (t1 - t0))
+        if tokens:
+            m.slot_steps_per_token.set(
+                steps * self.cfg.num_slots / tokens)
+        eng.recorder.record("rollout_complete", step=eng.engine_steps,
+                            rollout=idx, tokens=tokens,
+                            decode_steps=steps)
+        return out
+
+    def _drain(self, driver, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            if not driver.has_work():
+                return
+            driver.step()
+        raise RuntimeError(
+            f"rollout did not drain in {max_steps} engine steps")
+
+    def _assemble(self, driver, order: List[int], p_width: int,
+                  max_new: Optional[Sequence[int]]
+                  ) -> Dict[str, jnp.ndarray]:
+        """Reassemble per-request results into the ``build_generate_fn``
+        output contract: right-padded ``[B, P+N]`` sequences (prompt
+        immediately followed by response — what left_align produces for
+        right-padded prompts) and ``[B, N]`` response arrays."""
+        n = int(self.gen.max_new_tokens) if max_new is None \
+            else max(int(x) for x in max_new)
+        pad = int(self.gen.pad_token_id)
+        rows = len(order)
+        seq = np.full((rows, p_width + n), pad, np.int32)
+        seq_mask = np.zeros((rows, p_width + n), np.int32)
+        resp = np.full((rows, n), pad, np.int32)
+        resp_mask = np.zeros((rows, n), np.int32)
+        lps = np.zeros((rows, n), np.float32)
+        prompt_lens = np.zeros((rows,), np.int32)
+        for row, rid in enumerate(order):
+            req = driver.result(rid)
+            if req.state is not RequestState.FINISHED:
+                raise RuntimeError(
+                    f"rollout request {rid} ended {req.state.value!r} "
+                    f"({req.finish_reason!r}); rollouts require every "
+                    "request to finish — disable deadlines/shedding on "
+                    "the rollout engine")
+            p = req.prompt_tokens
+            g = req.generated
+            gl = req.generated_logprobs
+            prompt_lens[row] = len(p)
+            seq[row, :len(p)] = p
+            seq_mask[row, :len(p)] = 1
+            seq[row, len(p):len(p) + len(g)] = g
+            seq_mask[row, len(p):len(p) + len(g)] = 1
+            resp[row, :len(g)] = g
+            resp_mask[row, :len(g)] = 1
+            lps[row, :len(g)] = gl
+        lengths = prompt_lens + resp_mask.sum(axis=1).astype(np.int32)
+        return {
+            "sequences": jnp.asarray(seq),
+            "sequence_mask": jnp.asarray(seq_mask),
+            "response_tokens": jnp.asarray(resp),
+            "response_mask": jnp.asarray(resp_mask),
+            "response_logps": jnp.asarray(lps),
+            "lengths": jnp.asarray(lengths),
+            "prompt_lens": jnp.asarray(prompt_lens),
+        }

@@ -1,0 +1,300 @@
+"""Rollout pacing: sync (bit-identical to the batch path) or async
+(staleness-bounded pipelining) between the learner and the rollout
+engine.
+
+Sync mode is the drop-in replacement: ``get(k, params)`` refits and
+generates rollout k inline, so the learner always updates on tokens
+sampled from its own latest policy — bit-identical to the seeded
+``build_generate_fn`` batch path (pinned by test).
+
+Async mode overlaps the two: a background thread generates rollout
+k+1 on the serving engine while the learner runs its update epochs on
+rollout k. The thread snapshots the learner's update counter when it
+(re)fits weights; at consumption the gap between that snapshot and the
+current counter is the rollout's *staleness* in optimizer updates.
+
+- staleness == 0: on-policy, used as-is.
+- 0 < staleness <= ``max_staleness_updates``: used with a truncated
+  importance correction (:func:`make_staleness_corrector`) — per-row
+  weights ``min(exp(mean_logp_current - mean_logp_behavior), clip)``
+  multiplied into the advantages, the standard truncated-IS estimator
+  for bounded-lag async RLHF.
+- staleness > bound: the rollout is DISCARDED; the consumer refits the
+  latest params and regenerates the same rollout index (same cached
+  prompts + seeds) inline, so what the learner sees is never more than
+  ``max_staleness_updates`` behind.
+
+One lock serializes all engine access (generator thread vs. the
+consumer's discard-regenerate path); the depth-1 queue is the
+backpressure that keeps the generator at most one rollout ahead.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dla_tpu.ops.fused_ce import fused_token_logprobs
+from dla_tpu.rollout.engine import RolloutEngine, RolloutMetrics
+from dla_tpu.rollout.refit import WeightRefitter
+from dla_tpu.serving.server import ServingConfig
+
+# sample_fn(rollout_idx) -> (ids [B, P], mask [B, P], seeds [B * G])
+SampleFn = Callable[[int], Tuple]
+
+
+def _ceil_to(mult: int, n: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+class RolloutPipeline:
+    """Paces a :class:`RolloutEngine` against a learner.
+
+    ``sample_fn(idx)`` must return ``(ids, mask, seeds)`` for rollout
+    ``idx``. It is always called in rollout order from a single thread
+    (the generator thread in async mode, the caller in sync mode), so a
+    sequential host RNG inside it is safe; a discarded rollout's
+    regeneration reuses the CACHED sample, never re-draws.
+    """
+
+    def __init__(self, rollout: RolloutEngine, sample_fn: SampleFn, *,
+                 mode: str = "sync",
+                 max_staleness_updates: int = 1,
+                 donate_refit: bool = False,
+                 metrics: Optional[RolloutMetrics] = None):
+        if mode not in ("sync", "async"):
+            raise ValueError(f"rollout mode must be sync|async, got {mode!r}")
+        self.rollout = rollout
+        self.sample_fn = sample_fn
+        self.mode = mode
+        self.max_staleness_updates = int(max_staleness_updates)
+        self.metrics = metrics or rollout.metrics
+        self._refitter = WeightRefitter(
+            rollout, lambda: None, donate=donate_refit,
+            metrics=self.metrics)
+        # one lock for ALL engine access: the generator thread's
+        # refit+generate vs. the consumer's discard-regenerate
+        self._lock = threading.Lock()
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._samples: Dict[int, Tuple] = {}
+        self._updates = 0            # learner optimizer updates so far
+        self._version = 0            # updates snapshot at last refit
+        self._pending: Optional[Tuple] = None   # (params, version)
+        self._next_idx = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # --------------------------------------------------------- learner side
+
+    def notify_updates(self, n: int = 1, params=None) -> None:
+        """Advance the learner's update counter by ``n`` (call once per
+        optimizer update, or once per epoch loop with the count). In
+        async mode optionally hand over the matching rollout params;
+        the generator thread refits them before its NEXT generation."""
+        self._updates += int(n)
+        if params is not None and self.mode == "async":
+            # sync mode refits inside get(); holding params here would
+            # just pin a dead tree
+            self._pending = (params, self._updates)
+        self.metrics.staleness.set(self._updates - self._version)
+
+    def get(self, idx: int, params=None
+            ) -> Tuple[Dict[str, jnp.ndarray], int]:
+        """Rollout ``idx``'s arrays and its staleness in updates.
+        Consume strictly in order (0, 1, 2, ...). ``params``: the
+        learner's CURRENT rollout params — sync mode refits them before
+        generating; async mode keeps them as the regeneration weights
+        should the queued rollout exceed the staleness bound."""
+        if self.mode == "sync":
+            sample = self._sample(idx)
+            if params is not None:
+                with self._lock:
+                    self._refitter.refit(params)
+                    self._version = self._updates
+            return self._generate(sample), 0
+
+        self._ensure_thread()
+        if params is not None:
+            self._pending = (params, self._updates)
+        got_idx, out, version = self._q.get()
+        if self._error is not None:
+            raise RuntimeError("rollout generator thread failed") \
+                from self._error
+        if got_idx != idx:
+            raise RuntimeError(
+                f"rollouts must be consumed in order: expected {idx}, "
+                f"generated {got_idx}")
+        staleness = self._updates - version
+        self.metrics.staleness.set(staleness)
+        if staleness > self.max_staleness_updates:
+            # too far behind any correction we trust: drop it, refit the
+            # freshest params and regenerate the SAME rollout inline
+            self.metrics.discarded_rollouts.inc()
+            with self._lock:
+                pend = self._take_pending()
+                if pend is not None:
+                    self._refitter.refit(pend[0])
+                    self._version = pend[1]
+                out = self._generate(self._samples[idx])
+            return out, 0
+        if staleness > 0:
+            self.metrics.stale_rollouts.inc()
+        return out, staleness
+
+    def close(self) -> None:
+        """Stop the generator thread and close the rollout engine."""
+        self._stop.set()
+        if self._thread is not None:
+            while self._thread.is_alive():
+                try:                 # unwedge a blocked put
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                self._thread.join(timeout=0.1)
+            self._thread = None
+        self.rollout.close()
+
+    # ------------------------------------------------------- generator side
+
+    def _sample(self, idx: int) -> Tuple:
+        if idx not in self._samples:
+            self._samples[idx] = self.sample_fn(idx)
+        return self._samples[idx]
+
+    def _generate(self, sample: Tuple) -> Dict[str, jnp.ndarray]:
+        ids, mask, seeds = sample[:3]
+        max_new = sample[3] if len(sample) > 3 else None
+        return self.rollout.generate(ids, mask, seeds, max_new=max_new)
+
+    def _take_pending(self) -> Optional[Tuple]:
+        pend, self._pending = self._pending, None
+        return pend
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="rollout-generator", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                idx = self._next_idx
+                with self._lock:
+                    pend = self._take_pending()
+                    if pend is not None:
+                        self._refitter.refit(pend[0])
+                        self._version = pend[1]
+                    version = self._version
+                    sample = self._sample(idx)
+                    out = self._generate(sample)
+                self._next_idx += 1
+                while not self._stop.is_set():
+                    try:             # depth-1 queue = the backpressure
+                        self._q.put((idx, out, version), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as exc:       # surfaced at the next get()
+            self._error = exc
+            try:
+                self._q.put_nowait((-1, None, 0))
+            except queue.Full:
+                pass
+
+
+# ------------------------------------------------------------- correction
+
+def make_staleness_corrector(model, is_clip: float = 2.0):
+    """Jitted ``corrector(params, out) -> weights [B] fp32``: truncated
+    per-sequence importance ratios between the CURRENT policy (a fused
+    teacher-forced re-score of the rollout sequences under ``params``)
+    and the BEHAVIOR policy (the per-token logps the engine streamed at
+    sampling time, ``out["response_logps"]``).
+
+    ``w = min(exp(mean_logp_cur - mean_logp_behavior), is_clip)`` over
+    response positions only — multiply into the advantages with
+    :func:`apply_staleness_correction`. Means (not sums) keep the ratio
+    length-invariant; the one-sided clip is the usual truncated-IS
+    variance bound. For an on-policy rollout the means agree and the
+    weights are ~1 (pinned by test)."""
+
+    @jax.jit
+    def corrector(params, out):
+        seqs = out["sequences"]
+        mask = out["sequence_mask"]
+        h, _ = model.hidden_states_with_aux(params, seqs,
+                                            attention_mask=mask)
+        w, bias = model.unembed_params(params)
+        lp = fused_token_logprobs(h[:, :-1, :], w, seqs[:, 1:], bias,
+                                  softcap=model.cfg.final_logit_softcap)
+        # shifted grid: column t scores token t+1, so response tokens
+        # (sequence positions >= prompt_len) live at t >= prompt_len - 1
+        pos = jnp.arange(seqs.shape[1] - 1)[None, :]
+        act = ((pos >= (out["prompt_lens"][:, None] - 1))
+               & (mask[:, 1:] > 0)).astype(jnp.float32)
+        n = jnp.maximum(act.sum(-1), 1.0)
+        cur = (lp * act).sum(-1) / n
+        rmask = out["response_mask"].astype(jnp.float32)
+        behav = ((out["response_logps"] * rmask).sum(-1)
+                 / jnp.maximum(rmask.sum(-1), 1.0))
+        return jnp.minimum(jnp.exp(cur - behav),
+                           jnp.float32(is_clip)).astype(jnp.float32)
+
+    return corrector
+
+
+def apply_staleness_correction(scores: jnp.ndarray,
+                               weights: jnp.ndarray) -> jnp.ndarray:
+    """Scale advantages/scores by per-row truncated-IS weights.
+    ``scores`` may be ``[B]`` or ``[B, T]`` (weights broadcast per
+    row)."""
+    if scores.ndim == 2:
+        return scores * weights[:, None]
+    return scores * weights
+
+
+# --------------------------------------------------------------- assembly
+
+def build_rollout_pipeline(model, params, gen, sample_fn, *,
+                           rows: int, prompt_width: int,
+                           samples_per_prompt: int = 1,
+                           mode: str = "sync",
+                           max_staleness_updates: int = 1,
+                           donate_refit: bool = False,
+                           supervisor=None,
+                           serving: Optional[Dict] = None,
+                           metrics: Optional[RolloutMetrics] = None
+                           ) -> RolloutPipeline:
+    """Wire a RolloutPipeline from trainer-level quantities, deriving a
+    serving geometry that always fits the rollout: every row gets a
+    ``prompt_width + max_new_tokens`` logical window (rounded up to
+    whole pages) and the page pool covers all slots plus the reserved
+    trash page. ``serving`` overrides any ServingConfig field; G > 1
+    defaults the prefix cache ON (chunked prefill at page granularity)
+    so the G seeded copies of each prompt alias their prompt pages."""
+    over = dict(serving or {})
+    page = int(over.pop("page_size", 16))
+    need = prompt_width + int(gen.max_new_tokens)
+    max_len = int(over.pop("max_model_len", 0)) or _ceil_to(page, need)
+    slots = int(over.pop("num_slots", 0)) or max(1, min(rows, 8))
+    pages_per_slot = -(-max_len // page)
+    num_pages = int(over.pop("num_pages", 0)) \
+        or slots * pages_per_slot + 1
+    if samples_per_prompt > 1 and "prefix_cache" not in over:
+        over.setdefault("prefill_chunk", page)
+        over["prefix_cache"] = True
+    cfg = ServingConfig(page_size=page, num_pages=num_pages,
+                        num_slots=slots, max_model_len=max_len, **over)
+    rollout = RolloutEngine(model, params, gen, cfg,
+                            samples_per_prompt=samples_per_prompt,
+                            supervisor=supervisor, metrics=metrics)
+    return RolloutPipeline(rollout, sample_fn, mode=mode,
+                           max_staleness_updates=max_staleness_updates,
+                           donate_refit=donate_refit,
+                           metrics=rollout.metrics)

@@ -1,0 +1,63 @@
+"""In-place weight refit: publish updated policy params into the live
+rollout engine between rollouts.
+
+The refit contract (docs/RLHF.md):
+
+- ``param_fn()`` produces the rollout-ready tree — the trainer's
+  ``rollout_params()`` path: LoRA adapters merged into the frozen base
+  and/or int8 rollout quantization. Same structure/shapes/dtypes every
+  time, so the engine's jit fingerprints never change: ZERO recompiles
+  across refits (pinned by test).
+- The swap is a host pointer update; the decode/prefill dispatches
+  simply read the new tree on their next call. No engine rebuild, no
+  KV-cache invalidation — in-flight paged KV was computed under the old
+  weights, which is exactly the staleness the pipeline's importance
+  correction accounts for (refits happen at rollout boundaries, when
+  the engine is drained, so in practice nothing is in flight).
+- ``donate=True`` frees the OLD tree's device buffers eagerly at
+  publish. Only safe when ``param_fn`` builds a FRESH tree each call
+  (merge/quantize do); a passthrough ``rollout_params`` that returns
+  the trainer's live tree must NOT donate — the learner still owns it.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+
+from dla_tpu.rollout.engine import RolloutEngine, RolloutMetrics
+
+
+class WeightRefitter:
+    """Publishes ``param_fn()`` into a :class:`RolloutEngine`.
+
+    >>> refitter = WeightRefitter(rollout, rollout_params, donate=True)
+    >>> refitter.refit()          # between rollouts
+    """
+
+    def __init__(self, rollout: RolloutEngine,
+                 param_fn: Callable[[], object], *,
+                 donate: bool = False,
+                 metrics: Optional[RolloutMetrics] = None):
+        self.rollout = rollout
+        self.param_fn = param_fn
+        self.donate = donate
+        self.metrics = metrics or rollout.metrics
+
+    def refit(self, params=None) -> float:
+        """Build (or take) the new tree and publish it. Returns the
+        refit wall time in ms (param build + validation + swap;
+        ``block_until_ready`` so queued merge/quantize work is charged
+        here, not to the first decode)."""
+        t0 = time.perf_counter()
+        new = self.param_fn() if params is None else params
+        new = jax.block_until_ready(new)
+        self.rollout.publish_params(new, donate=self.donate)
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.metrics.refits.inc()
+        self.metrics.refit_ms.set(ms)
+        eng = self.rollout.engine
+        eng.recorder.record("weight_refit", step=eng.engine_steps,
+                            ms=round(ms, 3), donate=self.donate)
+        return ms

@@ -43,8 +43,12 @@ from dla_tpu.serving.scheduler import (
     SchedulerConfig,
 )
 from dla_tpu.serving.server import ServingConfig, ServingEngine
+# per-request sampling contract lives in ops.sampling (shared with the
+# batch generate fn); re-exported here because submit() speaks it
+from dla_tpu.ops.sampling import SamplingParams
 
 __all__ = [
+    "SamplingParams",
     "AdmissionController",
     "CircuitBreaker",
     "DegradationLadder",

@@ -293,9 +293,11 @@ class CircuitBreaker:
 class JournalEntry:
     """Everything needed to replay one request deterministically on a
     rebuilt engine: the immutable submission plus the tokens the client
-    has already seen. Greedy sampling state is the prompt itself —
-    argmax is history-free — so prompt + streamed IS the sampling
-    state the replay resumes from."""
+    has already seen. Sampling state is (seed, token index) — the
+    engine's per-request PRNG keying is history-free like argmax — so
+    prompt + streamed + sampling IS the state the replay resumes from,
+    greedy and sampled alike. ``streamed_logps`` mirrors ``streamed``
+    so the replayed request's logprob surface is also seamless."""
     prompt_tokens: List[int]
     max_new_tokens: int
     priority: int
@@ -304,6 +306,8 @@ class JournalEntry:
     streamed: List[int]
     done: bool
     request: Request      # live request object on the CURRENT engine
+    sampling: Optional[object] = None          # SamplingParams override
+    streamed_logps: List[float] = dataclasses.field(default_factory=list)
 
 
 class Supervisor:
@@ -410,10 +414,10 @@ class Supervisor:
     def submit(self, prompt_tokens: List[int], max_new_tokens: int,
                arrival_time: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0) -> int:
+               priority: int = 0, sampling=None) -> int:
         rid = self.engine.submit(
             prompt_tokens, max_new_tokens, arrival_time=arrival_time,
-            deadline_s=deadline_s, priority=priority)
+            deadline_s=deadline_s, priority=priority, sampling=sampling)
         req = self.engine.result(rid)
         self.journal[rid] = JournalEntry(
             prompt_tokens=list(prompt_tokens),
@@ -423,7 +427,8 @@ class Supervisor:
             deadline=req.deadline,
             streamed=[],
             done=req.state in TERMINAL_STATES,   # shed at the gate
-            request=req)
+            request=req,
+            sampling=sampling)
         return rid
 
     def result(self, rid: int) -> Request:
@@ -499,6 +504,13 @@ class Supervisor:
             e = self.journal.get(rid)
             if e is not None and not e.done:
                 e.streamed.append(tok)
+                # the request's logprob list advances in lockstep with
+                # its generated tokens (failed steps never commit), so
+                # the committed token's logp is at the same index
+                lps = e.request.generated_logprobs
+                e.streamed_logps.append(
+                    float(lps[len(e.streamed) - 1])
+                    if len(lps) >= len(e.streamed) else 0.0)
         for e in self.journal.values():
             if not e.done and e.request.state in TERMINAL_STATES:
                 e.done = True
@@ -566,7 +578,8 @@ class Supervisor:
                 generated=list(e.streamed),
                 arrival_time=e.arrival_time,
                 deadline=e.deadline, priority=e.priority,
-                rid=e.request.rid)
+                rid=e.request.rid, sampling=e.sampling,
+                generated_logprobs=list(e.streamed_logps))
             e.request = req
             self.replayed += 1
             m.replayed_requests.inc()

@@ -91,6 +91,14 @@ class Request:
     # exact-full-prompt cache hit: the stored last-token prefill logits
     # (numpy [V]); decoding starts from these with no prefill at all
     cached_logits: Optional[object] = None
+    # per-request sampling override (ops.sampling.SamplingParams); None
+    # means the engine-global GenerationConfig with a seed derived from
+    # (engine seed, rid)
+    sampling: Optional[object] = None
+    # chosen-token logprobs under the raw model distribution, parallel
+    # to `generated` — the per-request logprob surface (rollout behavior
+    # logps, eval/debugging)
+    generated_logprobs: List[float] = dataclasses.field(default_factory=list)
     # wall-clock marks for TTFT / queue-wait / inter-token latency metrics
     admitted_time: Optional[float] = None  # first prefill admission
     first_token_time: Optional[float] = None

@@ -36,7 +36,8 @@ import numpy as np
 
 from dla_tpu.generation.engine import GenerationConfig
 from dla_tpu.models.transformer import Transformer
-from dla_tpu.ops.sampling import sample_token
+from dla_tpu.ops.sampling import (SamplingParams, derive_request_seed,
+                                  sample_token_per_row)
 from dla_tpu.resilience.faults import FaultPlan
 from dla_tpu.serving.kv_blocks import (
     PagedKVCache,
@@ -199,7 +200,20 @@ class ServingEngine:
         self._pc_mirrored = {"lookups": 0, "hit_tokens": 0,
                              "evictions": 0}
         self._results: Dict[int, Request] = {}
-        self._rng = jax.random.key(cfg.seed)
+        # per-slot sampling state shipped into the jitted decode each
+        # step ([num_slots] host mirrors, like the cache metadata): every
+        # request carries its own traced (temperature, top_p, top_k,
+        # seed), and gen_pos is the generated-token index keying the
+        # per-request PRNG stream — fold_in(PRNGKey(seed), gen_pos).
+        # There is NO sequential engine rng: sampling is a pure function
+        # of (request seed, token index), so sampled requests replay
+        # bit-identically after eviction or a supervisor restart.
+        ns = cfg.num_slots
+        self.samp_temp = np.zeros((ns,), np.float32)
+        self.samp_top_p = np.ones((ns,), np.float32)
+        self.samp_top_k = np.zeros((ns,), np.int32)
+        self.samp_seed = np.zeros((ns,), np.uint32)
+        self.gen_pos = np.zeros((ns,), np.int32)
         self._draining = False
         self._old_handlers: Optional[dict] = None
         # engine-step counter drives the profiling window (the serving
@@ -391,11 +405,17 @@ class ServingEngine:
         return k_pages, v_pages, logits
 
     def _decode_fn(self, params, k_pages, v_pages, block_tables, valid,
-                   pos, lengths, tokens, active, rng):
+                   pos, lengths, tokens, active, temps, top_ps, top_ks,
+                   seeds, gen_pos):
         """One static-shape decode step over every slot: gather each
         slot's pages into its [S] window, run the layout-agnostic decode
-        step, sample, scatter the fresh KV column back. Free slots
-        compute garbage routed to the trash page."""
+        step, sample PER-ROW (each slot's traced temperature/top_p/top_k/
+        seed, keyed by the slot's generated-token index), scatter the
+        fresh KV column back. Free slots compute garbage routed to the
+        trash page. Returns the fresh KV pools plus a packed [2, B] f32
+        array — row 0 the sampled tokens bitcast to f32, row 1 their
+        chosen-token logprobs — so the host still performs exactly ONE
+        D2H fetch per decode step (the execution-model invariant)."""
         self.decode_compiles += 1  # dla: disable=trace-side-effect -- deliberate trace-time compile counter, pinned by the serving compile-once tests
         geom = self.cache.geom
         ps = geom.page_size
@@ -410,11 +430,10 @@ class ServingEngine:
                 "lengths": lengths}
         logits, k_cols, v_cols = self.model.decode_step_paged(
             params, view, tokens)
-        new_tok = sample_token(
-            rng, logits, temperature=self.gen.temperature,
-            top_p=self.gen.top_p, top_k=self.gen.top_k,
-            do_sample=self.gen.do_sample)
+        new_tok, logp = sample_token_per_row(
+            seeds, gen_pos, logits, temps, top_ps, top_ks)
         new_tok = jnp.where(active, new_tok, 0)
+        logp = jnp.where(active, logp, 0.0)
         # scatter this step's KV column: physical (page, offset) of each
         # slot's write column; inactive slots write the trash page
         col = lengths
@@ -425,14 +444,17 @@ class ServingEngine:
         offs = jnp.where(active, offs, 0)
         k_pages = k_pages.at[:, page_ids, offs].set(k_cols[:, :, 0])
         v_pages = v_pages.at[:, page_ids, offs].set(v_cols[:, :, 0])
-        return k_pages, v_pages, new_tok
+        packed = jnp.stack(
+            [jax.lax.bitcast_convert_type(new_tok, jnp.float32), logp])
+        return k_pages, v_pages, packed
 
     # ------------------------------------------------------------- intake
 
     def submit(self, prompt_tokens: List[int], max_new_tokens: int,
                arrival_time: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0) -> int:
+               priority: int = 0,
+               sampling: Optional[SamplingParams] = None) -> int:
         """Queue a request; returns its id. Guards that the request can
         EVER fit: its worst-case page demand (re-admission prefix padded
         to a bucket, plus the decode reserve) within pool capacity.
@@ -441,6 +463,14 @@ class ServingEngine:
         arrival: past it the scheduler finishes the request with TIMEOUT
         status at the next engine step, whether it is still queued or
         mid-decode (generated-so-far tokens are kept).
+
+        ``sampling`` overrides the engine-global ``gen.*`` knobs for this
+        request (temperature/top_p/top_k/seed); None uses the engine
+        defaults with a seed derived from (engine seed, rid). Either way
+        the request's token stream is a pure function of its seed and
+        token index — deterministic under eviction and supervisor
+        replay. Per-token chosen-token logprobs accumulate on
+        ``result(rid).generated_logprobs``.
 
         With admission control on (cfg.shed) the request may come back
         already terminal: SHED at the gate (bucket empty, or it is the
@@ -455,7 +485,8 @@ class ServingEngine:
                       max_new_tokens=int(max_new_tokens),
                       arrival_time=(self.now() if arrival_time is None
                                     else arrival_time),
-                      priority=int(priority))
+                      priority=int(priority),
+                      sampling=sampling)
         if deadline_s is not None:
             req.deadline = req.arrival_time + float(deadline_s)
         worst = len(req.prompt_tokens) + req.max_new_tokens
@@ -490,27 +521,71 @@ class ServingEngine:
     def result(self, rid: int) -> Request:
         return self._results[rid]
 
+    def publish_params(self, new_params, donate: bool = False) -> None:
+        """In-place weight refit: swap the param tree the jitted steps
+        read. The new tree must match the old one's structure, shapes
+        and dtypes exactly — same jit fingerprint, so the decode/prefill
+        compile counters stay pinned (enforced here rather than
+        discovered as a silent retrace). With ``donate=True`` the OLD
+        tree's device buffers are freed eagerly (the rollout refitter's
+        donation contract) — only safe when the caller owns the old tree
+        exclusively; never donate params shared with a trainer."""
+        old = self.params
+        old_def = jax.tree_util.tree_structure(old)
+        new_def = jax.tree_util.tree_structure(new_params)
+        if old_def != new_def:
+            raise ValueError(
+                "refit params tree structure mismatch: "
+                f"{new_def} vs engine {old_def}")
+        for o, n_ in zip(jax.tree_util.tree_leaves(old),
+                         jax.tree_util.tree_leaves(new_params)):
+            if o.shape != n_.shape or o.dtype != n_.dtype:
+                raise ValueError(
+                    "refit params leaf mismatch (would retrace): "
+                    f"{n_.shape}/{n_.dtype} vs engine {o.shape}/{o.dtype}")
+        self.params = new_params
+        if donate and old is not new_params:
+            keep = {id(leaf) for leaf
+                    in jax.tree_util.tree_leaves(new_params)}
+            for leaf in jax.tree_util.tree_leaves(old):
+                if id(leaf) not in keep and hasattr(leaf, "delete"):
+                    try:
+                        leaf.delete()
+                    except Exception:
+                        pass  # already deleted / externally owned
+
     def restore(self, prompt_tokens: List[int], max_new_tokens: int, *,
                 generated: List[int], arrival_time: float,
                 deadline: Optional[float] = None, priority: int = 0,
-                rid: Optional[int] = None) -> Request:
+                rid: Optional[int] = None,
+                sampling: Optional[SamplingParams] = None,
+                generated_logprobs: Optional[List[float]] = None
+                ) -> Request:
         """Re-enter a journaled in-flight request after a supervisor
         rebuild: the eviction deterministic-recompute contract taken
         cross-engine. ``generated`` pre-seeds the tokens the client
         already streamed, so ``prefix_tokens`` is prompt + streamed —
         the engine re-prefills that prefix and continues from the next
-        token. Nothing is re-emitted, and a greedy continuation is
-        bit-identical to the fault-free run. Bypasses the admission
-        gate and the drain closure: replayed requests ARE the in-flight
-        work a drain exists to finish."""
+        token. Nothing is re-emitted, and the continuation is
+        bit-identical to the fault-free run — greedy AND sampled, since
+        the sampling stream is keyed by (seed, token index) and the
+        continuation resumes at index ``len(generated)``. ``rid`` (and
+        ``sampling``) must be preserved for that determinism when the
+        request used the rid-derived default seed. Bypasses the
+        admission gate and the drain closure: replayed requests ARE the
+        in-flight work a drain exists to finish."""
         req = Request(prompt_tokens=list(prompt_tokens),
                       max_new_tokens=int(max_new_tokens),
                       arrival_time=arrival_time,
-                      priority=int(priority))
+                      priority=int(priority),
+                      sampling=sampling)
         if rid is not None:
             req.rid = rid
         req.deadline = deadline
         req.generated = list(generated)
+        req.generated_logprobs = (
+            list(generated_logprobs) if generated_logprobs is not None
+            else [0.0] * len(req.generated))
         self.scheduler.submit(req)
         if req.remaining_new_tokens <= 0:
             # every token already streamed before the failure: nothing
@@ -758,9 +833,25 @@ class ServingEngine:
 
     # ------------------------------------------------------------ internals
 
-    def _next_rng(self):
-        self._rng, sub = jax.random.split(self._rng)
-        return sub
+    def _effective_sampling(self, req: Request) -> SamplingParams:
+        """The request's sampling knobs: its explicit override, or the
+        engine-global gen.* defaults with a (engine seed, rid)-derived
+        seed — deterministic across restarts since restore() preserves
+        rids."""
+        if req.sampling is not None:
+            return req.sampling
+        return SamplingParams.from_gen(
+            self.gen, derive_request_seed(self.cfg.seed, req.rid))
+
+    def _bind_slot_sampling(self, req: Request) -> None:
+        """Mirror the request's sampling knobs into its slot's row of the
+        per-slot arrays the decode step ships to device."""
+        sp = self._effective_sampling(req)
+        s = req.slot
+        self.samp_temp[s] = sp.effective_temperature
+        self.samp_top_p[s] = sp.top_p
+        self.samp_top_k[s] = sp.top_k
+        self.samp_seed[s] = np.uint32(sp.seed & 0xFFFFFFFF)
 
     def _admit(self, emitted: List[Tuple[int, int]]) -> None:
         """Drain as many bucketed prefill batches as slots/pages allow."""
@@ -794,7 +885,7 @@ class ServingEngine:
             logits_np = np.asarray(logits)
         t_done = self.now()
         self.metrics.prefill_batches.inc()
-        first = self._sample_host(logits_np[:len(batch)])
+        first, first_lps = self._sample_host(logits_np[:len(batch)], batch)
         for i, req in enumerate(batch):
             tok = int(first[i])
             if req.admitted_time is None:
@@ -811,7 +902,9 @@ class ServingEngine:
             self.cache.open_slot(req.slot, req.pages,
                                  len(req.prefix_tokens), width, tok)
             self.scheduler.activate(req)
-            self._emit(req, tok, t_done, emitted, first_of_prefill=True)
+            self._bind_slot_sampling(req)
+            self._emit(req, tok, t_done, emitted, first_of_prefill=True,
+                       logp=float(first_lps[i]))  # dla: disable=host-sync-in-hot-loop -- host numpy scalar; rode the prefill batch fetch above
 
     def _admit_chunked(self, emitted: List[Tuple[int, int]]) -> None:
         """Strict-FCFS chunked admission. Exact-full-prompt cache hits
@@ -838,11 +931,14 @@ class ServingEngine:
                 # logits served from the cache — zero prefill FLOPs
                 # dla: disable=host-sync-in-hot-loop -- cached_logits is already host numpy (stored by register); no device fetch happens
                 logits_row = np.asarray(req.cached_logits)[None, :]
-                tok = int(self._sample_host(logits_row)[0])
+                toks, lps = self._sample_host(logits_row, [req])
+                tok = int(toks[0])
                 req.cached_logits = None
                 self.cache.begin_decode(req.slot, n, tok)
                 self.scheduler.activate(req)
-                self._emit(req, tok, t, emitted, first_of_prefill=True)
+                self._bind_slot_sampling(req)
+                self._emit(req, tok, t, emitted, first_of_prefill=True,
+                           logp=float(lps[0]))  # dla: disable=host-sync-in-hot-loop -- host numpy scalar from the cached-logits sample
 
     def _chunk_step(self, emitted: List[Tuple[int, int]]) -> None:
         """Advance the (single) mid-prefill request by one fixed-shape
@@ -891,7 +987,8 @@ class ServingEngine:
         logits_np = np.asarray(logits)
         t_done = self.now()
         self.metrics.prefill_batches.inc()
-        tok = int(self._sample_host(logits_np)[0])
+        toks, lps = self._sample_host(logits_np, [req])
+        tok = int(toks[0])
         self.cache.begin_decode(slot, n, tok)
         if self.prefix_cache is not None:
             # first-writer-wins: later identical prompts alias these
@@ -899,7 +996,9 @@ class ServingEngine:
             # zero-prefill full hit
             self.prefix_cache.register(prefix, req.pages, logits_np[0])
         self.scheduler.activate(req)
-        self._emit(req, tok, t_done, emitted, first_of_prefill=True)
+        self._bind_slot_sampling(req)
+        self._emit(req, tok, t_done, emitted, first_of_prefill=True,
+                   logp=float(lps[0]))  # dla: disable=host-sync-in-hot-loop -- host numpy scalar; rode the final-chunk logits fetch
 
     def _mirror_cache_counters(self) -> None:
         """Mirror the PrefixCache's plain-int counters into the metrics
@@ -916,29 +1015,42 @@ class ServingEngine:
         seen.update(lookups=pc.lookups, hit_tokens=pc.hit_tokens,
                     evictions=pc.evictions)
 
-    def _sample_host(self, logits: np.ndarray) -> np.ndarray:
-        """Sample next tokens from prefill logits — same sampling rule as
-        the decode step (ops.sampling), eager jax (once per prefill
-        batch, off the hot loop)."""
+    def _sample_host(self, logits: np.ndarray, reqs: List[Request]):
+        """Sample each request's next token from its prefill logits row —
+        the EXACT per-row rule the decode step runs (same fold_in(seed,
+        token-index) keying, same filters), eager jax once per prefill
+        batch, off the hot loop. The token index is len(generated), so
+        an eviction/replay re-prefill resumes the same stream. Returns
+        (tokens, logps) host arrays."""
         if np.isnan(logits).any():
             # real detection on the only logits the host ever sees: the
             # serving analog of the trainer's NaN guard. The supervisor
             # turns this into a rebuild-and-replay.
             raise NaNLogitsError("non-finite prefill logits")
-        if not self.gen.do_sample or self.gen.temperature == 0.0:
-            return np.argmax(logits, axis=-1).astype(np.int32)
-        toks = sample_token(
-            self._next_rng(), jnp.asarray(logits),
-            temperature=self.gen.temperature, top_p=self.gen.top_p,
-            top_k=self.gen.top_k, do_sample=self.gen.do_sample)
+        sps = [self._effective_sampling(r) for r in reqs]
+        # python-list -> numpy marshalling of per-request sampling
+        # params (host-only, no device fetch on these lines)
+        seeds = np.array([sp.seed & 0xFFFFFFFF for sp in sps], np.uint32)  # dla: disable=host-sync-in-hot-loop -- host list->numpy marshalling, no device fetch
+        gpos = np.array([len(r.generated) for r in reqs], np.int32)  # dla: disable=host-sync-in-hot-loop -- host list->numpy marshalling, no device fetch
+        temps = np.array([sp.effective_temperature for sp in sps], np.float32)  # dla: disable=host-sync-in-hot-loop -- host list->numpy marshalling, no device fetch
+        top_ps = np.array([sp.top_p for sp in sps], np.float32)  # dla: disable=host-sync-in-hot-loop -- host list->numpy marshalling, no device fetch
+        top_ks = np.array([sp.top_k for sp in sps], np.int32)  # dla: disable=host-sync-in-hot-loop -- host list->numpy marshalling, no device fetch
+        toks, lps = sample_token_per_row(
+            jnp.asarray(seeds), jnp.asarray(gpos), jnp.asarray(logits),
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks))
         # dla: disable=host-sync-in-hot-loop -- prefill sample fetch: one D2H per admitted batch
-        return np.asarray(toks)
+        return np.asarray(toks), np.asarray(lps)
 
     def _decode_step(self) -> List[Tuple[int, int]]:
         c = self.cache
         active_slots = sorted(self.scheduler.running)
         active = np.zeros((c.geom.num_slots,), bool)
         active[active_slots] = True
+        for slot in active_slots:
+            # the PRNG position of the token this step samples: the
+            # request's generated-token index (re-binds every step so
+            # evicted/re-admitted requests resume their stream exactly)
+            self.gen_pos[slot] = len(self.scheduler.running[slot].generated)
         if self._fault_device_error:
             # injected BEFORE dispatch: no KV column was written, no
             # token sampled — exactly the state a real dispatch failure
@@ -947,13 +1059,18 @@ class ServingEngine:
             raise DeviceStepError(
                 "injected device error (fault plan engine_step)")
         with annotate("serve_decode"):
-            self.cache.k_pages, self.cache.v_pages, toks = self._decode(
+            self.cache.k_pages, self.cache.v_pages, packed = self._decode(
                 self.params, c.k_pages, c.v_pages,
                 self._dev(c.block_tables), self._dev(c.valid),
                 self._dev(c.pos), self._dev(c.lengths),
-                self._dev(c.tokens), jnp.asarray(active), self._next_rng())
+                self._dev(c.tokens), jnp.asarray(active),
+                self._dev(self.samp_temp), self._dev(self.samp_top_p),
+                self._dev(self.samp_top_k), self._dev(self.samp_seed),
+                self._dev(self.gen_pos))
             # dla: disable=host-sync-in-hot-loop -- the designed single D2H per decode step (execution-model invariant)
-            toks_np = np.asarray(toks)
+            packed_np = np.asarray(packed)
+        toks_np = packed_np[0].view(np.int32)
+        logps_np = packed_np[1]
         if self._fault_nan_logits:
             # injected AFTER the fetch, where the real NaN guard below
             # (_sample_host) and a device-side check would trip: the
@@ -968,15 +1085,20 @@ class ServingEngine:
             req = self.scheduler.running[slot]
             tok = int(toks_np[slot])
             c.advance_slot(slot, tok)
-            self._emit(req, tok, t_done, emitted)
+            self._emit(req, tok, t_done, emitted,
+                       logp=float(logps_np[slot]))  # dla: disable=host-sync-in-hot-loop -- host numpy scalar; rode the packed decode fetch
         return emitted
 
     def _emit(self, req: Request, tok: int, t: float,
               emitted: List[Tuple[int, int]],
-              first_of_prefill: bool = False) -> None:
+              first_of_prefill: bool = False,
+              logp: float = 0.0) -> None:
         """Record one generated token: stream it, time it, finish the
-        request on EOS or length."""
+        request on EOS or length. ``logp`` is the token's chosen-token
+        logprob (raw model distribution), kept parallel to
+        ``generated`` on the request's result surface."""
         req.generated.append(tok)
+        req.generated_logprobs.append(float(logp))  # dla: disable=host-sync-in-hot-loop -- float coercion of an already-host scalar
         emitted.append((req.rid, tok))
         self.metrics.tokens_generated.inc()
         traced = self.tracer.enabled

@@ -245,6 +245,30 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "in-flight requests replayed after an engine rebuild"),
     _s("serving/supervisor/breaker_open", "gauge", "bool",
        "1 while the restart circuit breaker is tripped (draining)"),
+    # -- RLHF rollout subsystem (dla_tpu/rollout): serving-backed
+    #    generation for train_rlhf (docs/RLHF.md)
+    _s("rollout/rollouts", "counter", "rollouts",
+       "completed serving-backed rollout batches"),
+    _s("rollout/gen_tokens_per_s", "gauge", "tok/s",
+       "generated tokens per wall-second over the last rollout"),
+    _s("rollout/slot_steps_per_token", "gauge", "slot-steps/token",
+       "decode slot-steps spent per generated token over the last "
+       "rollout (1.0 = zero padding waste)"),
+    _s("rollout/padding_waste_recovered", "gauge", "fraction",
+       "1 - continuous/batch slot-steps-per-token on the same request "
+       "mix (bench.py rollout A/B)"),
+    _s("rollout/refits", "counter", "refits",
+       "in-place weight publications into the live engine"),
+    _s("rollout/refit_ms", "gauge", "ms",
+       "wall time of the last weight refit (param build + publish)"),
+    _s("rollout/staleness_updates", "gauge", "updates",
+       "learner updates applied since the consumed rollout's weights "
+       "were published (async mode; 0 in sync mode)"),
+    _s("rollout/stale_rollouts", "counter", "rollouts",
+       "rollouts consumed with staleness > 0 (importance-corrected)"),
+    _s("rollout/discarded_rollouts", "counter", "rollouts",
+       "async rollouts discarded for exceeding max_staleness_updates "
+       "and regenerated fresh"),
     # -- XLA introspection (telemetry.xla_introspect); per-fn series
     #    (telemetry/xla/<fn>/flops, .../recompiles, ...) ride the
     #    telemetry/xla/ dynamic prefix below

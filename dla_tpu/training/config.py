@@ -340,6 +340,38 @@ class GenerationSchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class RolloutServingSchema:
+    """ppo.rollout.serving: ServingConfig overrides for the rollout
+    engine (anything omitted is derived from the rollout shape by
+    rollout.pipeline.build_rollout_pipeline)."""
+    page_size: Any = None
+    num_pages: Any = None
+    num_slots: Any = None
+    max_model_len: Any = None
+    max_prefill_batch: Any = None
+    prefill_chunk: Any = None
+    prefill_token_budget: Any = None
+    prefix_cache: Any = None
+    fault_plan: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutSchema:
+    """ppo.rollout: disaggregated rollouts through the serving engine
+    (dla_tpu.rollout; docs/RLHF.md). donate_refit frees the previous
+    rollout tree's device buffers at each refit — only enable with
+    LoRA-merge or rollout_quantize_weights (a fresh tree per refit),
+    never when rollout params ARE the live trainer params."""
+    backend: Any = None            # batch (default) | serving
+    mode: Any = None               # sync (default) | async
+    max_staleness_updates: Any = None
+    is_clip: Any = None
+    supervised: Any = None
+    donate_refit: Any = None
+    serving: Optional[RolloutServingSchema] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class PpoSchema:
     algo: Any = None
     steps: Any = None
@@ -358,6 +390,7 @@ class PpoSchema:
     samples_per_prompt: Any = None
     max_prompt_length: Any = None
     generation_params: Optional[GenerationSchema] = None
+    rollout: Optional[RolloutSchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

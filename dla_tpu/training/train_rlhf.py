@@ -40,7 +40,7 @@ crosses the boundary besides scalar logging).
 from __future__ import annotations
 
 import random
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -257,6 +257,28 @@ def compute_rollout_rows(batch_size: int, n_procs: int) -> int:
     return rows
 
 
+def compute_local_rollout_shape(batch_size: int, n_procs: int,
+                                samples_per_prompt: int = 1
+                                ) -> Tuple[int, int, int]:
+    """(global rows, per-host rows, per-host UNIQUE prompts) for one
+    rollout. Global rows come from :func:`compute_rollout_rows` (the
+    announced round-down), and G = ``samples_per_prompt`` must divide
+    the per-host share — the G-fold expansion happens inside the
+    generate fn / serving submission, so a non-dividing G has no
+    well-defined prompt count."""
+    if samples_per_prompt < 1:
+        raise ValueError(
+            f"ppo.samples_per_prompt ({samples_per_prompt}) must be >= 1")
+    rows = compute_rollout_rows(batch_size, n_procs)
+    local_rows = rows // n_procs
+    if local_rows % samples_per_prompt:
+        raise ValueError(
+            f"ppo.samples_per_prompt ({samples_per_prompt}) must "
+            f"divide the per-host rollout batch ({local_rows} = "
+            f"batch_size {batch_size} / {n_procs} hosts)")
+    return rows, local_rows, local_rows // samples_per_prompt
+
+
 def main(argv=None) -> None:
     args = make_arg_parser("dla_tpu PPO-RLHF trainer").parse_args(argv)
     config = config_from_args(args)
@@ -295,6 +317,20 @@ def main(argv=None) -> None:
     if samples_per_prompt < 1:
         raise ValueError(
             f"ppo.samples_per_prompt ({samples_per_prompt}) must be >= 1")
+    # ppo.rollout: disaggregated rollouts through the serving engine
+    # (dla_tpu.rollout) instead of the fixed-shape generate fn. See
+    # docs/RLHF.md.
+    rollout_cfg = dict(ppo_cfg.get("rollout") or {})
+    rollout_backend = str(rollout_cfg.get("backend", "batch")).lower()
+    if rollout_backend not in ("batch", "serving"):
+        raise ValueError(
+            f"ppo.rollout.backend must be batch|serving, "
+            f"got {rollout_backend!r}")
+    if rollout_backend == "serving" and jax.process_count() > 1:
+        raise ValueError(
+            "ppo.rollout.backend=serving is single-host for now (the "
+            "serving engine is per-host; multi-host needs a rollout "
+            "sharding story) — use backend=batch on pods")
 
     gen = GenerationConfig.from_dict(
         ppo_cfg.get("generation_params"), max_new_tokens=256,
@@ -324,7 +360,8 @@ def main(argv=None) -> None:
                "eos_token_id": policy.tokenizer.eos_token_id,
                "pad_token_id": policy.tokenizer.pad_token_id})
 
-        rollout_rows = compute_rollout_rows(batch_size, jax.process_count())
+        rollout_rows, local_bs, local_prompts = compute_local_rollout_shape(
+            batch_size, jax.process_count(), samples_per_prompt)
         mb_size = min(mini_batch, rollout_rows)
         n_minibatches = max(1, rollout_rows // mb_size)
         # one rollout = this many optimizer steps (sizes the LR horizon
@@ -415,8 +452,10 @@ def main(argv=None) -> None:
         rm_params = jax.device_put(
             rm.params, sharding_tree(rm.specs, mesh))
 
-        generate_fn = jax.jit(build_generate_fn(
-            policy.model, gen, group_size=samples_per_prompt))
+        generate_fn = None
+        if rollout_backend == "batch":
+            generate_fn = jax.jit(build_generate_fn(
+                policy.model, gen, group_size=samples_per_prompt))
         if algo == "gae":
             score_fn = make_gae_score_fn(policy.model, ref.model, rm.model,
                                          gamma, gae_lambda)
@@ -449,15 +488,60 @@ def main(argv=None) -> None:
                       f"batch {batch_size}, {n_steps} steps")
 
         host_rng = random.Random(int(config.get("seed", 0)) + jax.process_index())
-        local_bs = batch_size // jax.process_count()
-        if local_bs % samples_per_prompt:
-            raise ValueError(
-                f"ppo.samples_per_prompt ({samples_per_prompt}) must "
-                f"divide the per-host rollout batch ({local_bs} = "
-                f"batch_size {batch_size} / {jax.process_count()} hosts)")
-        # unique prompts per host: generate_fn expands each G-fold
-        local_prompts = local_bs // samples_per_prompt
+        # local_bs / local_prompts (the per-host rollout share and its
+        # unique-prompt count) came from compute_local_rollout_shape up
+        # top, where updates_per_rollout was sized
         tok = policy.tokenizer
+
+        def sample_prompt_batch():
+            """One host-side prompt draw for this rank: templated text
+            encoded to the fixed right-padded [local_prompts, P] grid.
+            Sequential host_rng — call exactly once per rollout index,
+            in order."""
+            batch_prompts = [
+                PROMPT_TEMPLATE.format(prompt=p)
+                for p in (host_rng.sample(prompts, local_prompts)
+                          if len(prompts) >= local_prompts
+                          else host_rng.choices(prompts, k=local_prompts))]
+            return encode_prompt_batch(tok, batch_prompts, prompt_width)
+
+        pipeline = None
+        staleness_corrector = None
+        if rollout_backend == "serving":
+            from dla_tpu.ops.sampling import derive_rollout_seeds
+            from dla_tpu.rollout import (
+                apply_staleness_correction,
+                build_rollout_pipeline,
+                make_staleness_corrector,
+            )
+            base_seed = int(config.get("seed", 0))
+
+            def sample_rollout(idx):
+                ids, mask = sample_prompt_batch()
+                # per-row sampling seeds, a pure function of (run seed,
+                # rollout index): the rollout replays bit-identically
+                # across engine restarts and regenerations
+                seeds = derive_rollout_seeds(
+                    base_seed * 100_003 + idx, local_bs)
+                return ids, mask, seeds
+
+            pipeline = build_rollout_pipeline(
+                policy.model, rollout_params(), gen, sample_rollout,
+                rows=local_bs, prompt_width=prompt_width,
+                samples_per_prompt=samples_per_prompt,
+                mode=str(rollout_cfg.get("mode", "sync")),
+                max_staleness_updates=int(
+                    rollout_cfg.get("max_staleness_updates", 1)),
+                donate_refit=bool(rollout_cfg.get("donate_refit", False)),
+                supervisor=bool(rollout_cfg.get("supervised", False))
+                or None,
+                serving=rollout_cfg.get("serving"))
+            staleness_corrector = make_staleness_corrector(
+                policy.model, is_clip=float(rollout_cfg.get("is_clip", 2.0)))
+            log_rank_zero(
+                f"[dla_tpu] rollout backend: serving "
+                f"(mode={pipeline.mode}, G={samples_per_prompt}, "
+                f"slots={pipeline.rollout.cfg.num_slots})")
 
         rollout_idx = 0
         if args.resume:
@@ -481,28 +565,31 @@ def main(argv=None) -> None:
                 # and exits cleanly for --resume
                 trainer.poll_preemption(extra_aux=model_aux(
                     policy, model_cfg.get("tokenizer")))
-                # 1. sample + encode prompts (host, this rank's share only)
-                batch_prompts = [
-                    PROMPT_TEMPLATE.format(prompt=p)
-                    for p in (host_rng.sample(prompts, local_prompts)
-                              if len(prompts) >= local_prompts
-                              else host_rng.choices(prompts,
-                                                    k=local_prompts))]
-                ids, mask = encode_prompt_batch(tok, batch_prompts, prompt_width)
-                gbatch = make_global_batch(
-                    {"ids": ids, "mask": mask}, mesh)
-
-                # 2. rollout (jitted scan decode) + 3. score (jitted SPMD)
-                roll_rng = jax.random.fold_in(rng, 10_000 + rollout_idx)
+                # 1+2. sample prompts + rollout; 3. score (jitted SPMD)
                 rp = rollout_params()
-                out = generate_fn(rp, gbatch["ids"], gbatch["mask"],
-                                  roll_rng)
-                if algo == "gae":
+                staleness = 0
+                if pipeline is not None:
+                    # serving backend: continuous-batching decode. sync
+                    # mode refits rp and generates inline (bit-identical
+                    # to the seeded batch path); async consumes the
+                    # rollout the generator thread pipelined while the
+                    # PREVIOUS update epochs ran, `staleness` updates
+                    # behind
+                    out, staleness = pipeline.get(rollout_idx, params=rp)
+                    prompt_lens = out["prompt_lens"]
+                else:
+                    ids, mask = sample_prompt_batch()
+                    gbatch = make_global_batch(
+                        {"ids": ids, "mask": mask}, mesh)
+                    roll_rng = jax.random.fold_in(rng, 10_000 + rollout_idx)
+                    out = generate_fn(rp, gbatch["ids"], gbatch["mask"],
+                                      roll_rng)
                     # gbatch holds the UNIQUE prompts; rollout rows are
                     # grouped G-per-prompt in the same order
                     prompt_lens = jnp.repeat(
                         jnp.sum(gbatch["mask"], axis=1),
                         samples_per_prompt, axis=0)
+                if algo == "gae":
                     if quant_fn is not None:
                         # behavior stats must come from the SAME int8
                         # tree that sampled (rp is already merged for
@@ -525,6 +612,16 @@ def main(argv=None) -> None:
                     scores = score_fn(rp, ref_params, rm_params,
                                       out["sequences"], out["sequence_mask"],
                                       jnp.float32(kl_coef))
+                if staleness > 0:
+                    # async rollout sampled `staleness` optimizer updates
+                    # behind the current policy: truncated importance
+                    # ratios (current vs. behavior mean response logp,
+                    # clipped at ppo.rollout.is_clip) reweight the
+                    # advantages — the standard bounded-lag correction
+                    w = staleness_corrector(rp, out)
+                    scores = {**scores,
+                              "advantages": apply_staleness_correction(
+                                  scores["advantages"], w)}
 
                 # 4. update(s) — entirely on device (round-2 verdict weak
                 # -item 4: the update path previously bounced rollout
@@ -567,6 +664,12 @@ def main(argv=None) -> None:
                     loss, _ = trainer.step_on_device_batch(
                         up, jax.random.fold_in(rng, trainer.step))
                     losses.append(loss)
+                if pipeline is not None:
+                    # advance the staleness clock; async mode also hands
+                    # the post-update rollout tree to the generator
+                    # thread, which refits it before its next rollout
+                    pipeline.notify_updates(len(losses),
+                                            params=rollout_params())
 
                 kl_now = float(scores["kl"])
                 if algo in ("ppo", "gae") and target_kl:
@@ -609,6 +712,8 @@ def main(argv=None) -> None:
             # the rollout loop drives step_on_batch directly (no
             # fit()), so it owns closing an in-flight
             # logging.profile trace window on exit or error
+            if pipeline is not None:
+                pipeline.close()
             trainer.profile.close()
             if trainer.watchdog is not None:
                 trainer.watchdog.stop()

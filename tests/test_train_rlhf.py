@@ -223,3 +223,39 @@ def test_quantized_rollout_reinforce_scores_from_quantized_tree(
     tr.main(["--config", str(cfgp)])
     assert seen.get("int8") is True
     assert np.isfinite(_metrics(tmp_path)[-1]["train/loss"])
+
+
+def test_local_rollout_shape_host_and_group_edge_cases(capsys):
+    """The per-host / per-group factoring behind the serving rollout
+    backend: rows round down per host exactly like compute_rollout_rows,
+    and G must divide the per-host rollout batch."""
+    from dla_tpu.training.train_rlhf import compute_local_rollout_shape
+    # single host, G=1: identity
+    assert compute_local_rollout_shape(64, 1, 1) == (64, 64, 64)
+    # 4 hosts: 16 rows each, G=8 -> 2 unique prompts per host
+    assert compute_local_rollout_shape(64, 4, 8) == (64, 16, 2)
+    capsys.readouterr()
+    # 65 rounds down to 64 (announced, same as compute_rollout_rows)
+    assert compute_local_rollout_shape(65, 4, 1) == (64, 16, 16)
+    assert "dropped" in capsys.readouterr().out
+    # G that doesn't divide the local batch is a config error
+    with pytest.raises(ValueError, match="samples_per_prompt"):
+        compute_local_rollout_shape(64, 4, 3)
+
+
+def test_rlhf_serving_rollout_backend_e2e(tmp_path):
+    """End-to-end smoke: the full RLHF loop with ppo.rollout.backend:
+    serving — rollouts come from the serving engine (sync mode, refit
+    each step) and the metrics surface stays intact."""
+    import yaml as _yaml
+
+    from dla_tpu.training.train_rlhf import main
+    cfgp = _rlhf_cfg(tmp_path, "reinforce", steps=2)
+    cfg = _yaml.safe_load(cfgp.read_text())
+    cfg["ppo"]["rollout"] = {"backend": "serving", "mode": "sync",
+                             "serving": {"page_size": 4}}
+    cfgp.write_text(_yaml.safe_dump(cfg))
+    main(["--config", str(cfgp)])
+    recs = _metrics(tmp_path)
+    assert recs and np.isfinite(recs[-1]["train/loss"])
+    assert recs[-1]["train/response_len"] > 0

@@ -44,10 +44,10 @@ from dla_tpu.analysis.report import (  # noqa: E402
 from dla_tpu.telemetry.registry import parse_prometheus_text  # noqa: E402
 
 LOWER_IS_BETTER = ("_ms", "latency", "stall", "badput", "overhead",
-                   "wait")
+                   "wait", "steps_per_token")
 HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
                     "samples_per_sec", "_per_second", "saved_frac",
-                    "hit_rate")
+                    "hit_rate", "tokens_per_s", "padding_waste_recovered")
 
 
 def direction(name: str) -> int:
