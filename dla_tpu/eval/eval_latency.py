@@ -47,6 +47,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "families x N requests each, prefix cache on vs "
                         "off on the SAME trace (equivalent to "
                         "latency.serving.shared_prefix.enabled: true)")
+    p.add_argument("--speculative", action="store_true",
+                   help="also run the speculative-decoding serving A/B: "
+                        "the SAME Poisson trace through two engines, "
+                        "draft/verify speculation on vs off (equivalent "
+                        "to latency.serving.speculative.enabled: true)")
     return p.parse_args(argv)
 
 
@@ -157,6 +162,7 @@ def _serving_config(srv: Dict, **overrides):
         prefill_token_budget=int(cp.get("token_budget", 0)),
         prefix_cache=bool(pc.get("enabled", False)),
         cached_logits_capacity=int(pc.get("cached_logits_capacity", 128)),
+        speculative=srv.get("speculative"),
         # pass through the trainer-style profiling window ({trace_dir,
         # start_step, num_steps}) — an xplane trace of the measured
         # serving run is one config key away
@@ -332,6 +338,84 @@ def measure_shared_prefix(model, params, srv: Dict) -> Dict[str, object]:
     }
 
 
+def measure_speculative(model, params, srv: Dict) -> Dict[str, object]:
+    """Speculative-decoding A/B: the serving Poisson trace driven
+    through two engines — blockwise draft/verify speculation ON vs OFF —
+    on the SAME prompts and arrival schedule (both greedy). Reports ITL
+    and TTFT p50/p95 for both arms, the measured draft acceptance rate,
+    decode rounds vs tokens, and whether the generated tokens are
+    bit-identical (speculation must not change greedy output)."""
+    from dla_tpu.serving import ServingEngine
+    from dla_tpu.serving.metrics import ServingMetrics
+
+    sp = dict(srv.get("speculative") or {})
+    sp.pop("enabled", None)
+    sp.setdefault("k", 4)
+    sp.setdefault("draft", "int8")
+    n = int(srv.get("num_requests", 16))
+    rate = float(srv.get("arrival_rate", 16.0))
+    new_tokens = int(srv.get("new_tokens", 32))
+    pmin = int(srv.get("prompt_len_min", 8))
+    pmax = int(srv.get("prompt_len_max", 64))
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)          # greedy, run to length
+    rs = np.random.RandomState(int(srv.get("seed", 0)))
+    vocab = model.cfg.vocab_size
+    prompts = [list(rs.randint(3, vocab - 1,
+                               (rs.randint(pmin, pmax + 1),)))
+               for _ in range(n)]
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n))
+
+    def run_arm(spec_on: bool):
+        eng = ServingEngine(model, params, gen, _serving_config(
+            srv, speculative=dict(sp, enabled=True) if spec_on else None))
+        # compile warmup off the clock: every prefill bucket the trace
+        # hits at BOTH prefill batch shapes (the eager sampling ops
+        # compile per batch shape, and the process-wide op cache would
+        # otherwise bill them all to the first arm), plus one decode
+        # round — a 2-token budget is what forces the (draft, verify)
+        # pair (or the plain decode step) to trace
+        slot_w = eng.cache.geom.slot_window
+        for width in sorted({eng.scheduler.bucket_width(len(p))
+                             for p in prompts}):
+            plen = min(width, slot_w - 2)
+            for _ in range(3):
+                eng.submit([3 + (i % 251) for i in range(plen)], 2)
+        eng.run_until_drained()
+        eng.metrics = ServingMetrics()
+        dt, outs = _drive_open_loop(eng, prompts, arrivals, new_tokens)
+        return dt, outs, eng.metrics.snapshot()
+
+    dt_on, outs_on, snap_on = run_arm(True)
+    dt_off, outs_off, snap_off = run_arm(False)
+    return {
+        "num_requests": n,
+        "arrival_rate": rate,
+        "new_tokens": new_tokens,
+        "k": int(sp["k"]),
+        "draft": str(sp["draft"]),
+        "outputs_identical": outs_on == outs_off,
+        "acceptance_rate": snap_on["serving/spec/acceptance_rate"],
+        "spec_rounds": snap_on["serving/spec/rounds"],
+        "spec_rollbacks": snap_on["serving/spec/rollbacks"],
+        "tokens_generated": snap_on["serving/tokens_generated"],
+        "serve_tokens_per_second_spec_on":
+            snap_on["serving/tokens_generated"] / dt_on,
+        "serve_tokens_per_second_spec_off":
+            snap_off["serving/tokens_generated"] / dt_off,
+        "itl_ms_p50_spec_on": snap_on["serving/itl_ms_p50"],
+        "itl_ms_p95_spec_on": snap_on["serving/itl_ms_p95"],
+        "itl_ms_p50_spec_off": snap_off["serving/itl_ms_p50"],
+        "itl_ms_p95_spec_off": snap_off["serving/itl_ms_p95"],
+        "ttft_ms_p50_spec_on": snap_on["serving/ttft_ms_p50"],
+        "ttft_ms_p95_spec_on": snap_on["serving/ttft_ms_p95"],
+        "ttft_ms_p50_spec_off": snap_off["serving/ttft_ms_p50"],
+        "ttft_ms_p95_spec_off": snap_off["serving/ttft_ms_p95"],
+        "duration_s_spec_on": dt_on,
+        "duration_s_spec_off": dt_off,
+    }
+
+
 def measure_overload(model, params, srv: Dict) -> Dict[str, object]:
     """Overload A/B: the serving Poisson trace with a K-request burst
     injected at the mid-trace instant, driven through two engines —
@@ -488,6 +572,19 @@ def main(argv=None) -> None:
                     f"ttft p95 {spr['ttft_ms_p95_cache_on']:.1f} ms (on) "
                     f"vs {spr['ttft_ms_p95_cache_off']:.1f} ms (off), "
                     f"outputs identical: {spr['outputs_identical']}")
+            if args.speculative or \
+                    (srv.get("speculative") or {}).get("enabled", False):
+                entry["speculative"] = measure_speculative(
+                    bundle.model, bundle.params, srv)
+                spc = entry["speculative"]
+                log_rank_zero(
+                    f"[dla_tpu][latency] speculative: acceptance "
+                    f"{spc['acceptance_rate']:.2f}, itl p50 "
+                    f"{spc['itl_ms_p50_spec_on']:.2f} ms (on) vs "
+                    f"{spc['itl_ms_p50_spec_off']:.2f} ms (off), "
+                    f"p95 {spc['itl_ms_p95_spec_on']:.2f} vs "
+                    f"{spc['itl_ms_p95_spec_off']:.2f} ms, "
+                    f"outputs identical: {spc['outputs_identical']}")
         finally:
             # a mid-grid failure must not lose the already-captured trace
             if trace_dir:

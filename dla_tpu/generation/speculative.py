@@ -41,6 +41,17 @@ from dla_tpu.models.transformer import Transformer
 from dla_tpu.ops.sampling import filtered_probs
 
 
+def accept_prefix_len(accept: jnp.ndarray) -> jnp.ndarray:
+    """[B, K] bool accept flags -> [B] length of the all-accepted prefix
+    (0..K). The accept kernel shared by both speculative consumers: the
+    fixed-shape engine below (stochastic p/q acceptance) and the paged
+    serving engine (token-matching acceptance — accept draft token i+1
+    iff it equals the target's own seeded sample at position i, which
+    makes the emitted stream bit-identical to non-speculative decoding
+    for greedy AND sampled requests; see serving/server.py)."""
+    return jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+
 def build_speculative_generate_fn(
     target: Transformer,
     draft: Transformer,
@@ -132,8 +143,7 @@ def build_speculative_generate_fn(
             q_at = gather(q_d, d_toks[..., None], axis=-1)[..., 0]
             u = jax.random.uniform(u_keys[rnd], (b, gamma - 1))
             accept = u * q_at < p_at          # u < p/q, q > 0 by sampling
-            k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
-                        axis=1)                               # [B] 0..g-1
+            k = accept_prefix_len(accept)                     # [B] 0..g-1
 
             # ---- next pending: bonus sample (all accepted) or the
             # residual distribution at the reject position

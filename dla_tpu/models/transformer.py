@@ -1812,6 +1812,54 @@ class Transformer:
         logits = self.unembed(params, last)
         return logits, k_cols, v_cols
 
+    def decode_block_paged(self, params: Params, view: Params,
+                           tokens: jnp.ndarray,  # [B, G] token block
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Verify a G-token block against an externally-gathered KV view
+        — the speculative-verify sibling of ``decode_step_paged``. Row
+        b's block occupies absolute positions lengths[b]..lengths[b]+G-1;
+        query g attends over (a) the committed prefix in the view
+        (``valid`` marks exactly the columns BEFORE the block — draft
+        columns must NOT be valid, the in-block keys supply them fresh)
+        and (b) the block's own keys, causally by position. Returns
+        (logits [B, G, V] — one next-token distribution per block
+        position — and k_cols/v_cols [L, B, G, KH, D] for the caller to
+        scatter; rejected columns are the caller's rollback problem)."""
+        cfg = self.cfg
+        if self._kv_int8:
+            raise NotImplementedError(
+                "decode_block_paged serves activation-dtype pages; "
+                "kv_cache_dtype=int8 is only wired into the contiguous "
+                "path")
+        b, g = tokens.shape
+        positions = view["lengths"][:, None] + \
+            jnp.arange(g, dtype=jnp.int32)[None, :]          # [B, G]
+        x = self._embed(params, tokens)
+        cos, sin = rotary_angles(positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
+        from dla_tpu.ops.attention import block_decode_attention
+
+        def body(carry, xs):
+            layer, k_cache, v_cache = xs
+
+            def attend(q, k, v):
+                return block_decode_attention(
+                    q, k_cache, v_cache, k, v,
+                    kv_valid=view["valid"],
+                    q_positions=positions, kv_positions=view["pos"],
+                    window=self._layer_window(layer),
+                    softmax_scale=self._softmax_scale,
+                    logit_softcap=cfg.attn_logit_softcap)
+
+            return self._decode_layer(layer, carry, cos, sin, attend)
+
+        xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
+              view["k"], view["v"])
+        x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
+        h = self._final_norm(params, x)                      # [B, G, H]
+        logits = self.unembed(params, h)                     # [B, G, V]
+        return logits, k_cols, v_cols
+
     def start_decode(self, params: Params, input_ids: jnp.ndarray,
                      attention_mask: jnp.ndarray, max_new_tokens: int,
                      ) -> Tuple[jnp.ndarray, Params]:

@@ -213,3 +213,31 @@ def sample_token_per_row(
     tok = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
     logp = jnp.take_along_axis(logp_all, tok[:, None], axis=-1)[:, 0]
     return tok, logp
+
+
+def sample_token_block(
+    seeds: jnp.ndarray,       # [B] uint32 per-request seeds
+    positions0: jnp.ndarray,  # [B] i32 generated-token index of column 0
+    logits: jnp.ndarray,      # [B, G, V] one distribution per block column
+    temps: jnp.ndarray,       # [B] f32 effective temperature (<= 0 = greedy)
+    top_ps: jnp.ndarray,      # [B] f32
+    top_ks: jnp.ndarray,      # [B] i32
+):
+    """Block form of ``sample_token_per_row``: column g of row i draws at
+    generated-token index ``positions0[i] + g`` with row i's seed and
+    filter knobs. Every op in the per-row sampler is row-wise, so
+    flattening [B, G] -> [B*G] and delegating produces bit-identical
+    draws to G successive single-token calls — the property that lets a
+    speculative verify step emit the exact tokens the non-speculative
+    engine would have, regardless of how many tokens each round accepts.
+
+    Returns ``(tokens [B, G] int32, logps [B, G] float32)``.
+    """
+    b, g, v = logits.shape
+    offs = jnp.arange(g, dtype=jnp.int32)[None, :]
+    flat_pos = (positions0[:, None] + offs).reshape(b * g)
+    rep = lambda x: jnp.repeat(x, g, axis=0)  # noqa: E731 — row broadcast
+    tok, logp = sample_token_per_row(
+        rep(seeds), flat_pos, logits.reshape(b * g, v),
+        rep(temps), rep(top_ps), rep(top_ks))
+    return tok.reshape(b, g), logp.reshape(b, g)

@@ -132,7 +132,9 @@ class RolloutEngine:
         """Swap the live engine's param tree in place (structure/shape/
         dtype-validated — zero recompiles) AND the factory's source, so
         a supervisor rebuild mid-rollout comes back with the refitted
-        weights, not the originals."""
+        weights, not the originals. With speculative decoding on, the
+        engine re-quantizes the int8 self-draft from the published tree
+        in the same call — the draft never serves stale weights."""
         self.engine.publish_params(params, donate=donate)
         self._params = params
 

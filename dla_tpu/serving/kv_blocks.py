@@ -487,7 +487,14 @@ class PagedKVCache:
         """Apply one decode step's deterministic metadata update: the
         step wrote this slot's KV at column ``lengths`` with logical
         position ``lengths``; ``token`` was sampled and becomes the next
-        step's input."""
+        step's input.
+
+        Speculative rounds commit per accepted token through this same
+        method — the host mirrors only ever advance by the ACCEPTED
+        prefix, so a rejected draft tail needs no rollback: its columns
+        were written on device but never marked valid here, and the next
+        round's scatter overwrites them (the write-cursor "rewind" is
+        that the cursor simply never moved)."""
         col = int(self.lengths[slot])
         self.valid[slot, col] = True
         self.pos[slot, col] = col

@@ -63,6 +63,11 @@ class ServingMetrics:
         self.replayed_requests = r.counter(
             "serving/supervisor/replayed_requests")
         self.breaker_open = r.gauge("serving/supervisor/breaker_open")
+        self.spec_rounds = r.counter("serving/spec/rounds")
+        self.spec_proposed = r.counter("serving/spec/proposed_tokens")
+        self.spec_accepted = r.counter("serving/spec/accepted_tokens")
+        self.spec_rollbacks = r.counter("serving/spec/rollbacks")
+        self.spec_acceptance_rate = r.gauge("serving/spec/acceptance_rate")
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {
@@ -99,6 +104,14 @@ class ServingMetrics:
             "serving/supervisor/replayed_requests": float(
                 self.replayed_requests.value),
             "serving/supervisor/breaker_open": self.breaker_open.value,
+            "serving/spec/rounds": float(self.spec_rounds.value),
+            "serving/spec/proposed_tokens": float(
+                self.spec_proposed.value),
+            "serving/spec/accepted_tokens": float(
+                self.spec_accepted.value),
+            "serving/spec/rollbacks": float(self.spec_rollbacks.value),
+            "serving/spec/acceptance_rate":
+                self.spec_acceptance_rate.value,
         }
         out.update(self.ttft_ms.summary("serving/ttft_ms_"))
         out.update(self.itl_ms.summary("serving/itl_ms_"))

@@ -356,6 +356,11 @@ class Supervisor:
         self.journal: Dict[int, JournalEntry] = {}
         self.restarts = 0
         self.replayed = 0
+        # cumulative speculative-round totals captured from each dying
+        # engine and re-seeded into its replacement, so serving/spec/*
+        # stay monotonic across rebuilds like the supervisor counters
+        self._spec_totals = {"rounds": 0, "proposed": 0, "accepted": 0,
+                             "rollbacks": 0}
         self.failures: List[str] = []     # restart kinds, in order
         self.tripped = False
         self.breaker = CircuitBreaker(
@@ -385,6 +390,14 @@ class Supervisor:
         m.supervisor_restarts.inc(self.restarts)
         m.replayed_requests.inc(self.replayed)
         m.breaker_open.set(1.0 if self.tripped else 0.0)
+        t = self._spec_totals
+        if any(t.values()):
+            m.spec_rounds.inc(t["rounds"])
+            m.spec_proposed.inc(t["proposed"])
+            m.spec_accepted.inc(t["accepted"])
+            m.spec_rollbacks.inc(t["rollbacks"])
+            if t["proposed"]:
+                m.spec_acceptance_rate.set(t["accepted"] / t["proposed"])
         self._arm_watchdog()
         if self.tripped:
             self.engine.begin_drain()
@@ -455,7 +468,8 @@ class Supervisor:
         self._poll_burst()
         eng = self.engine
         compile_mark = (eng.decode_compiles, eng.prefill_compiles,
-                        eng.prefill_chunk_compiles)
+                        eng.prefill_chunk_compiles,
+                        eng.spec_draft_compiles, eng.spec_verify_compiles)
         wd = self._watchdog
         wd.resume()
         try:
@@ -470,7 +484,8 @@ class Supervisor:
         self._commit(emitted)
         if self._hang.is_set():
             if (eng.decode_compiles, eng.prefill_compiles,
-                    eng.prefill_chunk_compiles) != compile_mark:
+                    eng.prefill_chunk_compiles, eng.spec_draft_compiles,
+                    eng.spec_verify_compiles) != compile_mark:
                 # an XLA compile landed in this step: tracing/lowering
                 # legitimately blows any serving latency budget (and
                 # recurs on every rebuilt engine), so it is a known
@@ -544,6 +559,12 @@ class Supervisor:
             rec.dump(f"engine_restart_{kind}")
         self.restarts += 1
         self.failures.append(kind)
+        stats = getattr(eng, "_spec_stats", None)
+        if stats:
+            # fold the dying engine's speculative totals into the carry
+            # before teardown; _build_engine re-seeds them
+            for key in self._spec_totals:
+                self._spec_totals[key] += int(stats.get(key, 0))
         self.breaker.record(self.now())
         out_of_budget = self.tripped   # tripped BEFORE this failure
         self.tripped = self.tripped or self.breaker.tripped

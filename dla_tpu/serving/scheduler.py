@@ -295,7 +295,7 @@ class Scheduler:
 
     # --------------------------------------------------- page-pool safety
 
-    def ensure_decode_pages(self) -> List[Request]:
+    def ensure_decode_pages(self, span: int = 1) -> List[Request]:
         """Before a decode step: every running request whose next write
         column crosses into an unallocated page gets one, and a next
         write landing on a SHARED or cache-indexed page is copy-on-
@@ -303,14 +303,23 @@ class Scheduler:
         pristine for its other readers). On exhaustion, preempt the
         youngest running request (drop its slot AND its page references)
         and retry; the preempted requests are returned (already
-        re-queued at the head, FIFO among themselves)."""
+        re-queued at the head, FIFO among themselves).
+
+        ``span`` is the number of columns the coming step may COMMIT per
+        slot (K+1 for a speculative round, 1 otherwise): headroom and
+        COW cover the whole write range ``[lengths, lengths+need)`` where
+        ``need = min(span, remaining_new_tokens)`` — a request near its
+        token budget never reserves pages it cannot fill. Speculative
+        scatters beyond the allocated range hit the trash page by the
+        block-table-zero convention and are rolled back for free (their
+        columns are never marked valid)."""
         evicted: List[Request] = []
         for slot in sorted(self.running):
             req = self.running.get(slot)
             if req is None:
                 continue   # evicted while growing an earlier slot
             while True:
-                if self._needs_page(req):
+                if self._needs_page(req, span):
                     page = self.cache.allocator.alloc(1)
                     if page is not None:
                         # table entry i holds req.pages[i]; the new page
@@ -319,7 +328,7 @@ class Scheduler:
                         self.cache.block_tables[
                             slot, len(req.pages) - 1] = page[0]
                         continue
-                elif self._ensure_writable(req):
+                elif self._ensure_writable(req, span):
                     break
                 victim = self._youngest_running(exclude_rid=None)
                 if victim is None or victim.rid == req.rid:
@@ -332,33 +341,47 @@ class Scheduler:
                     break  # this request is gone; stop growing it
         return evicted
 
-    def _needs_page(self, req: Request) -> bool:
+    def _write_need(self, req: Request, span: int) -> int:
+        """Columns the next step may commit for this request: the span,
+        clamped to its remaining token budget (always >= 1 — a running
+        request has at least one token left to emit)."""
+        return max(1, min(int(span), req.remaining_new_tokens))
+
+    def _needs_page(self, req: Request, span: int = 1) -> bool:
         geom = self.cache.geom
         next_col = int(self.cache.lengths[req.slot])
-        return next_col // geom.page_size >= len(req.pages)
+        last_col = next_col + self._write_need(req, span) - 1
+        return last_col // geom.page_size >= len(req.pages)
 
-    def _ensure_writable(self, req: Request) -> bool:
-        """Copy-on-write guard: the page under this request's next
-        decode write must be exclusively owned and unindexed, or the
-        write would corrupt a page other readers / the prefix cache
-        still rely on. Returns False only when the COW copy can't get a
-        destination page (caller preempts and retries)."""
+    def _ensure_writable(self, req: Request, span: int = 1) -> bool:
+        """Copy-on-write guard: every page under this request's write
+        range (``span`` columns from the next decode write) must be
+        exclusively owned and unindexed, or the writes would corrupt
+        pages other readers / the prefix cache still rely on. Returns
+        False only when a COW copy can't get a destination page (caller
+        preempts and retries)."""
         if self.prefix_cache is None:
             return True
-        idx = self.cache.slot_page_index(req.slot)
-        page = int(self.cache.block_tables[req.slot, idx])
-        if page == 0:
-            return True
+        geom = self.cache.geom
+        next_col = int(self.cache.lengths[req.slot])
+        last_col = next_col + self._write_need(req, span) - 1
         alloc = self.cache.allocator
-        if alloc.refcount(page) <= 1 and \
-                not self.prefix_cache.is_indexed(page):
-            return True
-        fresh = alloc.alloc(1)
-        if fresh is None:
-            return False
-        self.cache.cow_page(req.slot, idx, fresh[0])
-        req.pages[idx] = fresh[0]
-        alloc.decref(page)
+        for idx in range(next_col // geom.page_size,
+                         last_col // geom.page_size + 1):
+            if idx >= len(req.pages):
+                break      # beyond allocation: trash-page writes only
+            page = int(self.cache.block_tables[req.slot, idx])
+            if page == 0:
+                continue
+            if alloc.refcount(page) <= 1 and \
+                    not self.prefix_cache.is_indexed(page):
+                continue
+            fresh = alloc.alloc(1)
+            if fresh is None:
+                return False
+            self.cache.cow_page(req.slot, idx, fresh[0])
+            req.pages[idx] = fresh[0]
+            alloc.decref(page)
         return True
 
     def _youngest_running(self, exclude_rid=None) -> Optional[Request]:

@@ -35,8 +35,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from dla_tpu.generation.engine import GenerationConfig
+from dla_tpu.generation.speculative import accept_prefix_len
 from dla_tpu.models.transformer import Transformer
 from dla_tpu.ops.sampling import (SamplingParams, derive_request_seed,
+                                  sample_token_block,
                                   sample_token_per_row)
 from dla_tpu.resilience.faults import FaultPlan
 from dla_tpu.serving.kv_blocks import (
@@ -133,6 +135,17 @@ class ServingConfig:
     # dict) over inter-token latency and unattributed recompiles; the
     # capture dumps land in postmortem_dir. None = off.
     anomaly: Optional[Dict] = None
+    # blockwise speculative decoding over the paged pool:
+    # {enabled: bool (default true when the block is present),
+    #  k: int draft tokens per round (default 4),
+    #  draft: "int8" (weight-only int8 self-draft via quantize_weights)
+    #         | "self" (full-precision self-draft — a correctness/bench
+    #           reference with ~100% acceptance)}.
+    # Greedy AND per-request-seeded sampled outputs stay bit-identical
+    # to the non-speculative engine: the verify step samples the target
+    # tokens itself at the request's fold_in(seed, k) stream positions
+    # and accepts a draft token only when it EQUALS the target's sample.
+    speculative: Optional[Dict] = None
 
     @property
     def pages_per_slot(self) -> int:
@@ -173,6 +186,24 @@ class ServingEngine:
                 "prefix_cache requires prefill_chunk > 0: cache hits "
                 "are chunk-granular, so the monolithic prefill path "
                 "cannot consume them")
+        spec = dict(cfg.speculative or {})
+        if spec and not spec.get("enabled", True):
+            spec = {}
+        if spec:
+            unknown = set(spec) - {"enabled", "k", "draft"}
+            if unknown:
+                raise ValueError(
+                    f"unknown speculative config keys: {sorted(unknown)}")
+        self._spec_k = int(spec.get("k", 4)) if spec else 0
+        self._spec_draft_kind = str(spec.get("draft", "int8"))
+        if spec:
+            if self._spec_k < 1:
+                raise ValueError(
+                    f"speculative.k must be >= 1, got {self._spec_k}")
+            if self._spec_draft_kind not in ("int8", "self"):
+                raise ValueError(
+                    "speculative.draft must be 'int8' or 'self', got "
+                    f"{self._spec_draft_kind!r}")
         self.model = model
         self.params = params
         self.gen = gen
@@ -199,6 +230,20 @@ class ServingEngine:
         self.metrics = ServingMetrics()
         self._pc_mirrored = {"lookups": 0, "hit_tokens": 0,
                              "evictions": 0}
+        # speculative round accounting lives in plain engine ints and is
+        # delta-mirrored into the registry each step (same idiom as the
+        # prefix-cache counters): a harness swapping in a fresh
+        # ServingMetrics sees only post-swap activity, and the
+        # Supervisor re-seeds cumulative totals across rebuilds
+        self._spec_stats = {"rounds": 0, "proposed": 0, "accepted": 0,
+                            "rollbacks": 0}
+        self._spec_mirrored = dict(self._spec_stats)
+        # the draft tree: int8 weight-only self-draft (quantize_weights
+        # adds _wscale leaves, so this is a DIFFERENT treedef from the
+        # target and rides the spec fns as its own jit argument) or the
+        # target tree itself ("self")
+        self.draft_params = (self._derive_draft(params)
+                             if self._spec_k else None)
         self._results: Dict[int, Request] = {}
         # per-slot sampling state shipped into the jitted decode each
         # step ([num_slots] host mirrors, like the cache metadata): every
@@ -272,9 +317,15 @@ class ServingEngine:
         self.decode_compiles = 0
         self.prefill_compiles = 0
         self.prefill_chunk_compiles = 0
+        self.spec_draft_compiles = 0
+        self.spec_verify_compiles = 0
         self._decode = jax.jit(self._decode_fn)
         self._prefill = jax.jit(self._prefill_fn)
         self._prefill_chunk = jax.jit(self._prefill_chunk_fn)
+        self._spec_draft = (jax.jit(self._spec_draft_fn)
+                            if self._spec_k else None)
+        self._spec_verify = (jax.jit(self._spec_verify_fn)
+                             if self._spec_k else None)
         # anomaly auto-triage over inter-token latency + unattributed
         # recompiles; captures land next to the other postmortems
         anomaly_cfg = AnomalyConfig.from_config(cfg.anomaly)
@@ -299,17 +350,33 @@ class ServingEngine:
                 platform=dev.platform, training=False)
             register_live_bytes_gauge(self.metrics.registry)
             max_entries = int(xi_cfg.get("max_entries", 16))
-            self._decode, self._prefill, self._prefill_chunk = (
+            named = [("decode", self._decode),
+                     ("prefill", self._prefill),
+                     ("prefill_chunk", self._prefill_chunk)]
+            if self._spec_k:
+                named += [("spec_draft", self._spec_draft),
+                          ("spec_verify", self._spec_verify)]
+            wrapped = [
                 IntrospectedFunction(
                     name, fn, registry=self.metrics.registry,
                     recorder=self.recorder, mfu_calc=self.mfu_calc,
                     on_compile=self._on_recompile,
                     max_entries=max_entries)
-                for name, fn in (("decode", self._decode),
-                                 ("prefill", self._prefill),
-                                 ("prefill_chunk", self._prefill_chunk)))
+                for name, fn in named]
+            self._decode, self._prefill, self._prefill_chunk = wrapped[:3]
+            if self._spec_k:
+                self._spec_draft, self._spec_verify = wrapped[3:]
         else:
             self.mfu_calc = None
+
+    def _derive_draft(self, params):
+        """Build the draft tree from the (current) target tree. ``int8``
+        re-quantizes (cheap relative to a refit's weight transfer);
+        ``self`` aliases the target — zero extra memory, ~100%
+        acceptance, the bench/correctness reference arm."""
+        if self._spec_draft_kind == "self":
+            return params
+        return self.model.quantize_weights(params)
 
     def _on_recompile(self, event: Dict) -> None:
         """Recompile-event feed from the introspection wrappers: an
@@ -448,6 +515,125 @@ class ServingEngine:
             [jax.lax.bitcast_convert_type(new_tok, jnp.float32), logp])
         return k_pages, v_pages, packed
 
+    def _spec_draft_fn(self, draft_params, k_pages, v_pages, block_tables,
+                       valid, pos, lengths, tokens, active, temps,
+                       top_ps, top_ks, seeds, gen_pos):
+        """The speculative DRAFT phase: K sequential fixed-shape decode
+        steps with the draft tree over the shared paged pool. Step i
+        feeds the previous proposal (the pending token at i=0), writes
+        its KV column at ``lengths + i``, marks it valid in the TRACED
+        metadata copy only (the host mirrors are authoritative and never
+        see draft columns — that asymmetry is the free rollback), and
+        samples proposal d_{i+1} on the request's own seeded stream at
+        generated-token index ``gen_pos + i`` — so a perfect draft
+        proposes exactly the tokens the target will sample, and the
+        token-matching verify accepts the whole block. Columns beyond
+        the slot window or the allocated pages route to the trash page.
+        Returns (k_pages, v_pages, proposals [B, K]); the proposals stay
+        on device and flow straight into the verify dispatch — no D2H.
+        """
+        self.spec_draft_compiles += 1  # dla: disable=trace-side-effect -- deliberate trace-time compile counter, pinned by the speculative compile-once tests
+        geom = self.cache.geom
+        ps = geom.page_size
+        l = self.model.cfg.num_layers
+        b = geom.num_slots
+        sw = geom.slot_window
+        col_ids = jnp.arange(sw, dtype=jnp.int32)[None, :]
+
+        def draft_step(carry, i):
+            cur, valid_c, pos_c, kp, vp = carry
+            k_view = kp[:, block_tables].reshape(l, b, sw, *kp.shape[3:])
+            v_view = vp[:, block_tables].reshape(l, b, sw, *vp.shape[3:])
+            lens_i = lengths + i
+            view = {"k": k_view, "v": v_view, "valid": valid_c,
+                    "pos": pos_c, "lengths": lens_i}
+            logits, k_cols, v_cols = self.model.decode_step_paged(
+                draft_params, view, cur)
+            nxt, _ = sample_token_per_row(
+                seeds, gen_pos + i, logits, temps, top_ps, top_ks)
+            nxt = jnp.where(active, nxt, 0)
+            col = lens_i
+            in_win = (col < sw) & active
+            page_ids = jnp.take_along_axis(
+                block_tables,
+                jnp.minimum(col // ps, geom.pages_per_slot - 1)[:, None],
+                axis=1)[:, 0]
+            offs = col % ps
+            page_ids = jnp.where(in_win, page_ids, 0)
+            offs = jnp.where(in_win, offs, 0)
+            kp = kp.at[:, page_ids, offs].set(k_cols[:, :, 0])
+            vp = vp.at[:, page_ids, offs].set(v_cols[:, :, 0])
+            written = (col_ids == col[:, None]) & in_win[:, None]
+            valid_c = valid_c | written
+            pos_c = jnp.where(written, col[:, None], pos_c)
+            return (nxt, valid_c, pos_c, kp, vp), nxt
+
+        (_, _, _, k_pages, v_pages), props = jax.lax.scan(
+            draft_step, (tokens, valid, pos, k_pages, v_pages),
+            jnp.arange(self._spec_k, dtype=jnp.int32))
+        return k_pages, v_pages, jnp.moveaxis(props, 0, 1)
+
+    def _spec_verify_fn(self, params, k_pages, v_pages, block_tables,
+                        valid, pos, lengths, tokens, proposals, active,
+                        temps, top_ps, top_ks, seeds, gen_pos):
+        """The speculative VERIFY phase: one multi-token target forward
+        over the block [pending, d_1 .. d_K] at columns
+        ``lengths .. lengths + K``. ``valid`` is the COMMITTED-ONLY host
+        mirror — the draft's columns must not be valid here, or the
+        block attention would double-count keys its in-block causal term
+        already supplies. The target then samples its OWN next token at
+        every block position on the request's fold_in(seed, gen_pos + i)
+        stream — these samples ARE the emitted tokens, which is why
+        greedy and sampled outputs are bit-identical to the
+        non-speculative engine — and draft token d_{i+1} is accepted iff
+        it equals target sample s_i (so position i+1's KV was computed
+        from the right input). All K+1 target KV columns scatter over
+        the draft's (same pages, CO-written/private by
+        ensure_decode_pages' span guard); the host commits only the
+        accepted prefix, so rejected columns are never marked valid —
+        rollback costs nothing and rejected tokens can never reach the
+        PrefixCache index (only prefill registers pages). Returns a
+        packed [3, B, K+1] f32 array — tokens bitcast / chosen-token
+        logps / accept-count bitcast broadcast — ONE D2H per round."""
+        self.spec_verify_compiles += 1  # dla: disable=trace-side-effect -- deliberate trace-time compile counter, pinned by the speculative compile-once tests
+        geom = self.cache.geom
+        ps = geom.page_size
+        l = self.model.cfg.num_layers
+        b = geom.num_slots
+        sw = geom.slot_window
+        g = self._spec_k + 1
+        k_view = k_pages[:, block_tables].reshape(
+            l, b, sw, *k_pages.shape[3:])
+        v_view = v_pages[:, block_tables].reshape(
+            l, b, sw, *v_pages.shape[3:])
+        view = {"k": k_view, "v": v_view, "valid": valid, "pos": pos,
+                "lengths": lengths}
+        block = jnp.concatenate([tokens[:, None], proposals], axis=1)
+        logits, k_cols, v_cols = self.model.decode_block_paged(
+            params, view, block)
+        toks, logps = sample_token_block(
+            seeds, gen_pos, logits, temps, top_ps, top_ks)
+        toks = jnp.where(active[:, None], toks, 0)
+        logps = jnp.where(active[:, None], logps, 0.0)
+        accept = toks[:, :self._spec_k] == proposals
+        acc = accept_prefix_len(accept)                    # [B] 0..K
+        cols = lengths[:, None] + jnp.arange(g, dtype=jnp.int32)[None, :]
+        in_win = (cols < sw) & active[:, None]
+        page_ids = jnp.take_along_axis(
+            block_tables,
+            jnp.minimum(cols // ps, geom.pages_per_slot - 1), axis=1)
+        offs = cols % ps
+        page_ids = jnp.where(in_win, page_ids, 0)
+        offs = jnp.where(in_win, offs, 0)
+        k_pages = k_pages.at[:, page_ids, offs].set(k_cols)
+        v_pages = v_pages.at[:, page_ids, offs].set(v_cols)
+        packed = jnp.stack([
+            jax.lax.bitcast_convert_type(toks, jnp.float32),
+            logps,
+            jax.lax.bitcast_convert_type(
+                jnp.broadcast_to(acc[:, None], (b, g)), jnp.float32)])
+        return k_pages, v_pages, packed
+
     # ------------------------------------------------------------- intake
 
     def submit(self, prompt_tokens: List[int], max_new_tokens: int,
@@ -544,6 +730,13 @@ class ServingEngine:
                     "refit params leaf mismatch (would retrace): "
                     f"{n_.shape}/{n_.dtype} vs engine {o.shape}/{o.dtype}")
         self.params = new_params
+        if self._spec_k:
+            # draft refit rides the target refit: re-derive BEFORE any
+            # donation frees the old leaves ("self" would otherwise
+            # alias deleted buffers). Same structure in -> same
+            # structure out, so the spec-fn jit fingerprints hold and
+            # the draft/verify compile counters stay pinned.
+            self.draft_params = self._derive_draft(new_params)
         if donate and old is not new_params:
             keep = {id(leaf) for leaf
                     in jax.tree_util.tree_leaves(new_params)}
@@ -614,12 +807,18 @@ class ServingEngine:
             self._decode.step = self.engine_steps
             self._prefill.step = self.engine_steps
             self._prefill_chunk.step = self.engine_steps
+            if self._spec_k:
+                self._spec_draft.step = self.engine_steps
+                self._spec_verify.step = self.engine_steps
         emitted: List[Tuple[int, int]] = []
+        # a speculative round may COMMIT up to K+1 columns per slot, so
+        # page headroom / copy-on-write cover the whole write span
+        span = self._spec_k + 1
         with step_annotation(self.engine_steps, name="serve"):
             self._poll_faults()
             self._expire(self.now())
             self._resilience_pass()
-            for req in self.scheduler.ensure_decode_pages():
+            for req in self.scheduler.ensure_decode_pages(span=span):
                 self.metrics.preemptions.inc()
             if self.cfg.prefill_chunk:
                 self._admit_chunked(emitted)
@@ -628,17 +827,28 @@ class ServingEngine:
                 # cache hit or final chunk) decode THIS step, and their
                 # first write may land in a shared/indexed tail page —
                 # copy-on-write must run before the decode, not next step
-                for req in self.scheduler.ensure_decode_pages():
+                for req in self.scheduler.ensure_decode_pages(span=span):
                     self.metrics.preemptions.inc()
             else:
                 self._admit(emitted)
+                # same second pass for the one-shot prefill path: an
+                # admission's decode reserve guarantees ONE column, but
+                # a speculative round commits up to span columns in the
+                # admission step itself — grow (or preempt) before the
+                # round, or commits could advance past allocated pages
+                if self._spec_k:
+                    for req in self.scheduler.ensure_decode_pages(
+                            span=span):
+                        self.metrics.preemptions.inc()
             if self.scheduler.running:
-                emitted.extend(self._decode_step())
+                emitted.extend(self._spec_decode_step() if self._spec_k
+                               else self._decode_step())
         self.engine_steps += 1
         self.readiness.beat()
         if self.anomaly is not None:
             self.anomaly.on_step(self.engine_steps)
         self._mirror_cache_counters()
+        self._mirror_spec_counters()
         m = self.metrics
         m.queue_depth.set(self.scheduler.queue_depth)
         m.active_requests.set(self.scheduler.active_count)
@@ -1088,6 +1298,109 @@ class ServingEngine:
             self._emit(req, tok, t_done, emitted,
                        logp=float(logps_np[slot]))  # dla: disable=host-sync-in-hot-loop -- host numpy scalar; rode the packed decode fetch
         return emitted
+
+    def _spec_decode_step(self) -> List[Tuple[int, int]]:
+        """One speculative ROUND for the whole decode batch: draft
+        dispatch -> verify dispatch -> one packed D2H -> per-slot
+        variable commit. The host metadata (valid/pos/lengths/tokens
+        mirrors) is authoritative and only ever advances by the ACCEPTED
+        prefix — rejected draft columns exist solely in device pages
+        that the next round's verify overwrites, so rollback is a no-op
+        and an eviction/replay re-prefill never sees speculative
+        residue. Both dispatches read the same host-metadata snapshot;
+        the draft extends its own traced copy of ``valid``/``pos`` while
+        the verify attends committed-only (draft keys arrive via the
+        in-block causal term instead)."""
+        c = self.cache
+        k = self._spec_k
+        active_slots = sorted(self.scheduler.running)
+        active = np.zeros((c.geom.num_slots,), bool)
+        active[active_slots] = True
+        for slot in active_slots:
+            # the PRNG position of the FIRST token this round samples:
+            # the request's generated-token index (re-binds every round
+            # so evicted/re-admitted requests resume their stream, and
+            # in-round positions advance as gen_pos + i in-graph)
+            self.gen_pos[slot] = len(self.scheduler.running[slot].generated)
+        if self._fault_device_error:
+            # injected BEFORE dispatch: no KV column written, no token
+            # sampled — the state a real dispatch failure leaves behind
+            self._fault_device_error = False
+            raise DeviceStepError(
+                "injected device error (fault plan engine_step)")
+        with annotate("serve_spec_decode"):
+            btab = self._dev(c.block_tables)
+            valid = self._dev(c.valid)
+            pos = self._dev(c.pos)
+            lengths = self._dev(c.lengths)
+            tokens = self._dev(c.tokens)
+            active_d = jnp.asarray(active)
+            temps = self._dev(self.samp_temp)
+            top_ps = self._dev(self.samp_top_p)
+            top_ks = self._dev(self.samp_top_k)
+            seeds = self._dev(self.samp_seed)
+            gpos = self._dev(self.gen_pos)
+            c.k_pages, c.v_pages, proposals = self._spec_draft(
+                self.draft_params, c.k_pages, c.v_pages, btab, valid,
+                pos, lengths, tokens, active_d, temps, top_ps, top_ks,
+                seeds, gpos)
+            c.k_pages, c.v_pages, packed = self._spec_verify(
+                self.params, c.k_pages, c.v_pages, btab, valid, pos,
+                lengths, tokens, proposals, active_d, temps, top_ps,
+                top_ks, seeds, gpos)
+            # dla: disable=host-sync-in-hot-loop -- the designed single D2H per speculative round (proposals never leave the device)
+            packed_np = np.asarray(packed)
+        toks_np = packed_np[0].view(np.int32)         # [B, K+1]
+        logps_np = packed_np[1]
+        acc_np = packed_np[2].view(np.int32)[:, 0]    # [B] accepts 0..K
+        if self._fault_nan_logits:
+            # injected AFTER the fetch, where a real device-side NaN
+            # would surface: nothing was committed, replay is clean
+            self._fault_nan_logits = False
+            raise NaNLogitsError(
+                "injected non-finite logits (fault plan engine_step)")
+        t_done = self.now()
+        self.metrics.decode_steps.inc()
+        emitted: List[Tuple[int, int]] = []
+        for slot in active_slots:
+            req = self.scheduler.running[slot]
+            a = int(acc_np[slot])
+            self._spec_stats["rounds"] += 1
+            self._spec_stats["proposed"] += k
+            self._spec_stats["accepted"] += a
+            if a < k:
+                self._spec_stats["rollbacks"] += 1
+            # commit the accepted prefix: a+1 target samples (column
+            # lengths+j holds block token j's target KV; the emitted
+            # token becomes the next pending). EOS/length may finish
+            # the request mid-block — the tail accepts are dropped,
+            # exactly as the non-speculative engine would never have
+            # sampled past the terminal token.
+            for j in range(a + 1):
+                tok = int(toks_np[slot, j])
+                c.advance_slot(slot, tok)
+                self._emit(req, tok, t_done, emitted,
+                           logp=float(logps_np[slot, j]))  # dla: disable=host-sync-in-hot-loop -- host numpy scalar; rode the packed round fetch
+                if self.scheduler.running.get(slot) is not req:
+                    break
+        return emitted
+
+    def _mirror_spec_counters(self) -> None:
+        """Delta-mirror the speculative round stats into the registry
+        (same contract as the prefix-cache mirror: a fresh
+        ServingMetrics swap sees only post-swap activity; the Supervisor
+        re-seeds cumulative totals into rebuilt engines)."""
+        if not self._spec_k:
+            return
+        m, s, seen = self.metrics, self._spec_stats, self._spec_mirrored
+        m.spec_rounds.inc(s["rounds"] - seen["rounds"])
+        m.spec_proposed.inc(s["proposed"] - seen["proposed"])
+        m.spec_accepted.inc(s["accepted"] - seen["accepted"])
+        m.spec_rollbacks.inc(s["rollbacks"] - seen["rollbacks"])
+        seen.update(s)
+        if m.spec_proposed.value > 0:
+            m.spec_acceptance_rate.set(
+                m.spec_accepted.value / m.spec_proposed.value)
 
     def _emit(self, req: Request, tok: int, t: float,
               emitted: List[Tuple[int, int]],

@@ -245,6 +245,22 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "in-flight requests replayed after an engine rebuild"),
     _s("serving/supervisor/breaker_open", "gauge", "bool",
        "1 while the restart circuit breaker is tripped (draining)"),
+    # -- speculative decoding on the paged engine (serving.server):
+    #    draft-propose / target-verify rounds, delta-mirrored from
+    #    engine-side counters so totals survive supervisor rebuilds
+    _s("serving/spec/rounds", "counter", "rounds",
+       "speculative draft/verify rounds (one per active slot per "
+       "engine step)", "step"),
+    _s("serving/spec/proposed_tokens", "counter", "tokens",
+       "draft tokens proposed for verification (K per slot-round)",
+       "step"),
+    _s("serving/spec/accepted_tokens", "counter", "tokens",
+       "draft tokens accepted by target verification", "step"),
+    _s("serving/spec/acceptance_rate", "gauge", "fraction",
+       "accepted / proposed draft tokens, cumulative", "step"),
+    _s("serving/spec/rollbacks", "counter", "rounds",
+       "rounds that rejected at least one draft token (rolled-back "
+       "columns are never marked valid)", "step"),
     # -- RLHF rollout subsystem (dla_tpu/rollout): serving-backed
     #    generation for train_rlhf (docs/RLHF.md)
     _s("rollout/rollouts", "counter", "rollouts",

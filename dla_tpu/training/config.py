@@ -353,6 +353,7 @@ class RolloutServingSchema:
     prefill_token_budget: Any = None
     prefix_cache: Any = None
     fault_plan: Any = None
+    speculative: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -491,6 +492,17 @@ class OverloadSchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpeculativeSchema:
+    """ServingConfig.speculative: blockwise draft/verify speculative
+    decoding on the paged engine (k draft tokens per round; draft is
+    'int8' weight-only self-draft or 'self' full precision). Also the
+    eval_latency --speculative A/B switch."""
+    enabled: Any = None
+    k: Any = None
+    draft: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ServingLatencySchema:
     enabled: Any = None
     arrival_rate: Any = None
@@ -511,6 +523,7 @@ class ServingLatencySchema:
     shed: Optional[ShedSchema] = None
     supervisor: Optional[SupervisorSchema] = None
     overload: Optional[OverloadSchema] = None
+    speculative: Optional[SpeculativeSchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -356,6 +356,44 @@ def test_supervisor_chaos_with_chunked_prefill_cache(serve_setup):
         [1] * len(engines)
 
 
+def test_supervisor_chaos_with_speculative_decode(serve_setup):
+    """Same chaos through the speculative (draft/verify) engine: a wedge
+    and a device error land mid-round, replay stays bit-identical to a
+    fault-free speculative run, draft/verify compiles pin at 1 per
+    build, and the page-pool partition invariant holds after the
+    restarts — rolled-back draft tails never leak pages."""
+    prompts = _prompts(4, seed=9)
+    spec = {"enabled": True, "k": 3, "draft": "self"}
+    eng = _engine(serve_setup, speculative=spec)
+    base_rids = [eng.submit(p, 12) for p in prompts]
+    base = eng.run_until_drained(max_steps=500)
+    baseline = [list(base[r].generated) for r in base_rids]
+    eng.close()
+
+    engines = []
+    # speculative decode finishes in few engine steps (K+1 commits per
+    # round), so the faults sit early and the 12-token budget keeps
+    # every build mid-round long enough for its fault to land
+    plan = "engine_step=1:wedge:0.3;engine_step=2:device_error"
+    sup = _supervised(serve_setup, plan, engines, speculative=spec)
+    rids = [sup.submit(p, 12) for p in prompts]
+    results = sup.run(max_steps=500)
+    sup.close()
+
+    assert sup.failures == ["wedge", "device_error"]
+    assert sup.restarts == 2 and not sup.tripped
+    for i, rid in enumerate(rids):
+        req = results[rid]
+        assert req.state is RequestState.FINISHED
+        assert list(req.generated) == baseline[i]   # bit-identical
+    assert [e.spec_draft_compiles for e in engines] == [1] * len(engines)
+    assert [e.spec_verify_compiles for e in engines] == [1] * len(engines)
+    final = engines[-1]
+    final.scheduler.assert_consistent()     # no page leaks after restart
+    assert final.cache.allocator.used_count == 0
+    assert final.metrics.supervisor_restarts.value == 2
+
+
 def test_supervisor_burst_fault_invokes_hook(serve_setup):
     engines = []
     bursts = []

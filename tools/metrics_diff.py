@@ -47,7 +47,8 @@ LOWER_IS_BETTER = ("_ms", "latency", "stall", "badput", "overhead",
                    "wait", "steps_per_token")
 HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
                     "samples_per_sec", "_per_second", "saved_frac",
-                    "hit_rate", "tokens_per_s", "padding_waste_recovered")
+                    "hit_rate", "tokens_per_s", "padding_waste_recovered",
+                    "acceptance_rate", "speedup")
 
 
 def direction(name: str) -> int:
