@@ -768,6 +768,155 @@ def run_serving_spec_bench() -> dict:
     }
 
 
+def run_serving_fleet_bench() -> dict:
+    """Fleet-routing A/B/C on a shared-prefix request mix: the SAME
+    prompts through (1) a single engine, (2) an N=4 fleet with random
+    placement, and (3) an N=4 fleet with cache-aware routing (peek +
+    load + sticky-prefix affinity). The headline is the routed fleet's
+    decode-throughput speedup over random placement (higher is better —
+    random scatters each prompt family across members and destroys
+    cross-request prefix reuse); detail carries per-arm decode tokens/s
+    (N=1 vs N=4 scaling), per-arm prefix-cache hit rates and the
+    routed fleet's hit-rate retention vs the single engine, the greedy
+    bit-identity check across all three arms, and a scale-down drain
+    exercise (queued work rebalanced to peers, zero lost requests).
+    Deterministic placement and outputs, CPU-sized, in-process."""
+    import time
+    import jax
+    import numpy as np
+    from dla_tpu.generation.engine import GenerationConfig
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.serving import (
+        TERMINAL_STATES,
+        FleetConfig,
+        FleetRouter,
+        ServingConfig,
+        ServingEngine,
+        ServingMetrics,
+    )
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=128, remat="none", dtype="float32",
+        param_dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    new_tokens, chunk = 8, 8
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1, pad_token_id=0)
+    families, per_family = 4, 8
+    rs = np.random.RandomState(7)
+    prompts = []
+    for _ in range(families):
+        head = [int(t) for t in rs.randint(3, 500, (16,))]
+        for _ in range(per_family):
+            prompts.append(head + [int(t)
+                                   for t in rs.randint(3, 500, (4,))])
+    tokens = len(prompts) * new_tokens
+    prompt_tokens = sum(len(p) for p in prompts)
+    engines, reps = 4, 3
+
+    def build_engine(slot=0):
+        # two slots per engine: the single-engine arm is deliberately
+        # slot-bound, so fleet scaling measures real added capacity;
+        # fault_plan="" pins members fault-free under $DLA_FAULT_PLAN
+        return ServingEngine(model, params, gen, ServingConfig(
+            page_size=4, num_pages=96, num_slots=2, max_model_len=48,
+            max_prefill_batch=2, prefill_chunk=chunk, prefix_cache=True,
+            fault_plan=""))
+
+    def warm(eng):
+        # compile warmup (chunk fn + decode) off the clock; random
+        # tokens can't collide with a family prefix
+        eng.submit([int(t) for t in rs.randint(3, 500, (chunk + 1,))], 1)
+        eng.run_until_drained()
+        eng.metrics = ServingMetrics()
+
+    def drive(eng):
+        # burst-submit the whole mix and take the fastest of `reps`
+        # identical passes — scheduling and placement are
+        # deterministic, so the min is the least-perturbed timing
+        dts, outs = [], None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            rids = [eng.submit(p, new_tokens) for p in prompts]
+            results = eng.run_until_drained(max_steps=20000)
+            dts.append(time.perf_counter() - t0)
+            outs = [list(results[r].generated) for r in rids]
+        return min(dts), outs
+
+    def run_single():
+        eng = build_engine()
+        warm(eng)
+        dt, outs = drive(eng)
+        hit = eng.metrics.snapshot()["serving/prefix_cache/hit_tokens"]
+        eng.close()
+        return dt, outs, hit / (reps * prompt_tokens)
+
+    def run_fleet(placement):
+        router = FleetRouter(
+            lambda slot: build_engine(slot),
+            FleetConfig(engines=engines, min_engines=1,
+                        max_engines=engines, placement=placement))
+        for m in router.members():
+            warm(m.engine)
+        dt, outs = drive(router)
+        hit = sum(s["serving/prefix_cache/hit_tokens"]
+                  for s in router.engine_snapshots())
+        return router, dt, outs, hit / (reps * prompt_tokens)
+
+    dt_single, outs_single, hit_single = run_single()
+    r_rand, dt_rand, outs_rand, hit_rand = run_fleet("random")
+    r_rand.close()
+    r_routed, dt_routed, outs_routed, hit_routed = run_fleet("cache_aware")
+
+    # scale-down drain on the routed fleet: queued work must move to
+    # peers and every request must still reach a terminal state
+    rids = [r_routed.submit(p, new_tokens) for p in prompts]
+    r_routed.scale_down()
+    results = r_routed.run_until_drained(max_steps=20000)
+    lost = sum(1 for r in rids
+               if results[r].state not in TERMINAL_STATES)
+    fleet_snap = r_routed.fleet_snapshot()
+    r_routed.close()
+
+    tps_routed = tokens / dt_routed
+    tps_rand = tokens / dt_rand
+    tps_single = tokens / dt_single
+    return {
+        "metric": "serving_fleet_routed_speedup",
+        "value": round(tps_routed / tps_rand, 4),
+        "unit": "x",
+        "detail": {
+            "decode_tokens_per_s_routed": round(tps_routed, 1),
+            "decode_tokens_per_s_random": round(tps_rand, 1),
+            "decode_tokens_per_s_single": round(tps_single, 1),
+            "fleet_n4_tokens_per_s_scaling":
+                round(tps_routed / tps_single, 4),
+            "hit_rate_routed": round(hit_routed, 4),
+            "hit_rate_random": round(hit_rand, 4),
+            "hit_rate_single": round(hit_single, 4),
+            "hit_rate_retention":
+                round(hit_routed / max(hit_single, 1e-9), 4),
+            "outputs_identical":
+                bool(outs_single == outs_rand == outs_routed),
+            "requests_lost_scale_down": lost,
+            "rebalanced_requests":
+                int(fleet_snap["serving/fleet/rebalanced_requests"]),
+            "routed_by_prefix":
+                int(fleet_snap["serving/fleet/routed_by_prefix"]),
+            "routed_by_load":
+                int(fleet_snap["serving/fleet/routed_by_load"]),
+            "engines": engines,
+            "reps": reps,
+            "requests": len(prompts),
+            "families": families,
+            "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def run_serving_resilience_bench() -> dict:
     """Serving-resilience chaos bench: a supervised engine
     (dla_tpu/serving/resilience) driven through the full serving fault
@@ -1247,7 +1396,8 @@ def _emit_and_maybe_extra() -> None:
         return
     extra = [headline]
     for fn in (run_ppo_bench, run_decode_bench, run_serving_bench,
-               run_serving_prefix_bench, run_serving_spec_bench):
+               run_serving_prefix_bench, run_serving_spec_bench,
+               run_serving_fleet_bench):
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — extras must not kill the line
@@ -1300,6 +1450,13 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_serving_spec_bench()))
+        return 0
+    if "serving-fleet" in sys.argv[1:]:
+        # fleet-routing A/B/C target: same in-process forced-CPU
+        # pattern; headline is routed-vs-random decode speedup
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_serving_fleet_bench()))
         return 0
     if "serving-resilience" in sys.argv[1:]:
         # supervised-serving chaos target: same in-process forced-CPU

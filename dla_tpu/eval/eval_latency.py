@@ -28,7 +28,7 @@ from dla_tpu.generation.engine import GenerationConfig, build_generate_fn
 from dla_tpu.training.config import load_config
 from dla_tpu.training.model_io import load_causal_lm
 from dla_tpu.training.utils import seed_everything
-from dla_tpu.utils.logging import log_rank_zero
+from dla_tpu.utils.logging import log_rank_zero, percentile
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -52,6 +52,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "the SAME Poisson trace through two engines, "
                         "draft/verify speculation on vs off (equivalent "
                         "to latency.serving.speculative.enabled: true)")
+    p.add_argument("--fleet", action="store_true",
+                   help="also run the fleet routing A/B/C: the SAME "
+                        "shared-prefix Poisson trace through a single "
+                        "engine, an N-engine fleet with random "
+                        "placement, and an N-engine fleet with "
+                        "cache-aware routing (equivalent to "
+                        "latency.serving.fleet.enabled: true)")
     return p.parse_args(argv)
 
 
@@ -338,6 +345,121 @@ def measure_shared_prefix(model, params, srv: Dict) -> Dict[str, object]:
     }
 
 
+def measure_fleet(model, params, srv: Dict) -> Dict[str, object]:
+    """Fleet routing A/B/C: the SAME shared-prefix Poisson trace driven
+    through (1) a single engine, (2) an N-engine fleet with random
+    placement, and (3) an N-engine fleet with cache-aware routing — all
+    greedy, all prefix-cache + chunked-prefill on. Reports TTFT/ITL
+    p50/p95/p99 per arm, per-engine prefix-cache hit rates, the fleet
+    hit-rate retention vs the single engine (random placement destroys
+    cross-request prefix locality; routing must recover it), and the
+    bit-identity assertion across all three arms (the per-request
+    ``fold_in(seed, k)`` sampling contract makes outputs
+    placement-independent)."""
+    from dla_tpu.serving import (
+        FleetConfig, FleetRouter, ServingEngine)
+    from dla_tpu.serving.metrics import ServingMetrics
+
+    fl = srv.get("fleet") or {}
+    engines = int(fl.get("engines", 4))
+    sp = srv.get("shared_prefix") or {}
+    families = int(sp.get("families", 8))
+    per_family = int(sp.get("requests_per_family", 16))
+    prefix_len = int(sp.get("prefix_len", 48))
+    suffix_len = int(sp.get("suffix_len", 16))
+    new_tokens = int(srv.get("new_tokens", 32))
+    rate = float(srv.get("arrival_rate", 16.0))
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)          # greedy, run to length
+    rs = np.random.RandomState(int(srv.get("seed", 0)))
+    vocab = model.cfg.vocab_size
+    prompts: List[List[int]] = []
+    for _ in range(families):
+        head = [int(t) for t in rs.randint(3, vocab - 1, (prefix_len,))]
+        for _ in range(per_family):
+            prompts.append(head + [int(t) for t in
+                                   rs.randint(3, vocab - 1, (suffix_len,))])
+    n = len(prompts)
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n))
+    prompt_tokens = sum(len(p) for p in prompts)
+    cp = srv.get("chunked_prefill") or {}
+    chunk = int(cp.get("chunk", 0)) or 2 * int(srv.get("page_size", 16))
+
+    def build_engine(slot=0):
+        # fault_plan="" pins every fleet member fault-free even when
+        # $DLA_FAULT_PLAN is set in the environment
+        return ServingEngine(model, params, gen, _serving_config(
+            srv, prefill_chunk=chunk, prefix_cache=True, fault_plan=""))
+
+    def warm(eng):
+        # compile warmup (chunk fn + decode) off the clock; random
+        # tokens can't collide with a family prefix, so the cache stays
+        # cold for the measured trace
+        eng.submit([int(t) for t in
+                    rs.randint(3, vocab - 1, (chunk + 1,))], 1)
+        eng.run_until_drained()
+        eng.metrics = ServingMetrics()
+
+    def arm_stats(member_engines, dt, outs):
+        ttft = [s for e in member_engines
+                for s in e.metrics.ttft_ms.samples]
+        itl = [s for e in member_engines
+               for s in e.metrics.itl_ms.samples]
+        hits = [e.metrics.snapshot()["serving/prefix_cache/hit_tokens"]
+                for e in member_engines]
+        gen_tokens = sum(len(o) for o in outs)
+        return {
+            "duration_s": dt,
+            "decode_tokens_per_s": gen_tokens / max(dt, 1e-9),
+            "hit_rate": sum(hits) / max(prompt_tokens, 1),
+            "per_engine_hit_tokens": hits,
+            **{f"ttft_ms_p{q}": percentile(ttft, float(q))
+               for q in (50, 95, 99)},
+            **{f"itl_ms_p{q}": percentile(itl, float(q))
+               for q in (50, 95, 99)},
+        }
+
+    def run_single():
+        eng = build_engine()
+        warm(eng)
+        dt, outs = _drive_open_loop(eng, prompts, arrivals, new_tokens)
+        return outs, arm_stats([eng], dt, outs)
+
+    def run_fleet(placement: str):
+        router = FleetRouter(
+            lambda slot: build_engine(slot),
+            FleetConfig(engines=engines, min_engines=1,
+                        max_engines=engines, placement=placement))
+        for m in router.members():
+            warm(m.engine)
+        dt, outs = _drive_open_loop(router, prompts, arrivals, new_tokens)
+        stats = arm_stats([m.engine for m in router.members()], dt, outs)
+        stats["fleet"] = {k: v for k, v in router.fleet_snapshot().items()
+                          if not k.endswith("_peak")}
+        router.close()
+        return outs, stats
+
+    outs_single, single = run_single()
+    outs_random, random_ = run_fleet("random")
+    outs_routed, routed = run_fleet("cache_aware")
+    return {
+        "engines": engines,
+        "families": families,
+        "requests_per_family": per_family,
+        "prefix_len": prefix_len,
+        "suffix_len": suffix_len,
+        "new_tokens": new_tokens,
+        "prefill_chunk": chunk,
+        "prompt_tokens": prompt_tokens,
+        "outputs_identical": outs_single == outs_random == outs_routed,
+        "hit_rate_retention": (routed["hit_rate"]
+                               / max(single["hit_rate"], 1e-9)),
+        "single": single,
+        "fleet_random": random_,
+        "fleet_routed": routed,
+    }
+
+
 def measure_speculative(model, params, srv: Dict) -> Dict[str, object]:
     """Speculative-decoding A/B: the serving Poisson trace driven
     through two engines — blockwise draft/verify speculation ON vs OFF —
@@ -572,6 +694,23 @@ def main(argv=None) -> None:
                     f"ttft p95 {spr['ttft_ms_p95_cache_on']:.1f} ms (on) "
                     f"vs {spr['ttft_ms_p95_cache_off']:.1f} ms (off), "
                     f"outputs identical: {spr['outputs_identical']}")
+            if args.fleet or \
+                    (srv.get("fleet") or {}).get("enabled", False):
+                entry["fleet"] = measure_fleet(
+                    bundle.model, bundle.params, srv)
+                flt = entry["fleet"]
+                log_rank_zero(
+                    f"[dla_tpu][latency] fleet (N="
+                    f"{flt['engines']}): hit rate "
+                    f"{flt['fleet_routed']['hit_rate']:.2f} routed vs "
+                    f"{flt['fleet_random']['hit_rate']:.2f} random vs "
+                    f"{flt['single']['hit_rate']:.2f} single "
+                    f"(retention {flt['hit_rate_retention']:.2f}), "
+                    f"ttft p95 {flt['fleet_routed']['ttft_ms_p95']:.1f}"
+                    f" ms routed vs "
+                    f"{flt['fleet_random']['ttft_ms_p95']:.1f} ms "
+                    f"random, outputs identical: "
+                    f"{flt['outputs_identical']}")
             if args.speculative or \
                     (srv.get("speculative") or {}).get("enabled", False):
                 entry["speculative"] = measure_speculative(

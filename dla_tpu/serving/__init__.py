@@ -17,7 +17,17 @@ Modules:
   resilience admission control + load shedding, degradation ladder,
              engine Supervisor (watchdog/rebuild/deterministic replay),
              circuit breaker
+  fleet      multi-engine FleetRouter (cache-aware placement via
+             PrefixCache.peek, sticky-prefix affinity, per-member
+             supervisors) + SLO-driven Autoscaler with zero-loss
+             scale-down (docs/SERVING.md "Fleet")
 """
+from dla_tpu.serving.fleet import (
+    Autoscaler,
+    FleetConfig,
+    FleetMetrics,
+    FleetRouter,
+)
 from dla_tpu.serving.kv_blocks import (
     PageAllocator,
     PagedKVCache,
@@ -50,9 +60,13 @@ from dla_tpu.ops.sampling import SamplingParams
 __all__ = [
     "SamplingParams",
     "AdmissionController",
+    "Autoscaler",
     "CircuitBreaker",
     "DegradationLadder",
     "DeviceStepError",
+    "FleetConfig",
+    "FleetMetrics",
+    "FleetRouter",
     "NaNLogitsError",
     "PageAllocator",
     "PagedKVCache",

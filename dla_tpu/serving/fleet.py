@@ -1,0 +1,506 @@
+"""Serving fleet: a multi-engine router with cache-aware placement,
+SLO-driven autoscaling, and fleet-wide draining.
+
+One ``ServingEngine`` is a hard throughput ceiling; the ``FleetRouter``
+fronts N of them — each member on its own worker thread with its own
+scheduler, page pool, prefix cache, metrics registry, and per-member
+``Supervisor`` (a wedged member rebuilds and replays while the router
+keeps steering new arrivals elsewhere). The router exposes the same
+``submit / step / has_work / result / drain`` surface as a single
+engine, so ``eval_latency``'s open-loop driver, ``RolloutEngine``, and
+the Supervisor factory pattern work unchanged on top of a fleet.
+
+Placement scores every live member by:
+
+- **prefix affinity** — the longest-prefix-cache match length via the
+  read-only ``PrefixCache.peek()`` (no increfs, no LRU touch: the N-1
+  losing candidates must be left exactly as found), plus a sticky
+  family map that keeps a request family on the member that owns its
+  pages even before the first member's prefix registers;
+- **load** — page-pool occupancy plus normalized queue depth (and the
+  admission controller's configured bound when shedding is on);
+- **draining state** — members answering ``/healthz`` 503 ``draining``
+  (supervisor breaker trip, scale-down, or fleet drain) take no new
+  placements.
+
+The ``Autoscaler`` consumes the SLO burn-rate signal ``telemetry/slo``
+already computes plus fleet pressure, spawns members through the same
+engine factory the supervisors rebuild with, and retires members
+through the existing draining contract: queued requests are
+redistributed to peers FIRST (rid, sampling params, and streamed
+tokens preserved through ``engine.restore`` — the supervisor-replay
+idiom), in-flight decodes run to completion, and the member is
+reclaimed only after its last request resolves. Zero lost requests,
+ever.
+
+Outputs are placement-independent by construction: generated token k
+of a request is sampled with ``fold_in(PRNGKey(seed), k)`` where the
+seed depends only on (engine config seed, rid) or on explicit
+``SamplingParams`` — never on slot, batch, or member — so a routed
+fleet reproduces a single engine's tokens bit-for-bit on the same
+trace. Fleet metrics live in the ROUTER's registry, not a member's,
+so ``serving/fleet/*`` totals are monotone across member rebuilds by
+construction.
+"""
+from __future__ import annotations
+
+import functools
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, fields
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dla_tpu.serving.scheduler import TERMINAL_STATES, Request
+from dla_tpu.serving.resilience import Supervisor, SupervisorConfig
+from dla_tpu.telemetry.registry import MetricRegistry
+
+PLACEMENTS = ("cache_aware", "random", "round_robin")
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Router + autoscaler knobs (``latency.serving.fleet`` in config).
+
+    ``placement`` picks the routing policy: ``cache_aware`` (peek +
+    load + affinity, the default), ``random`` (seeded — the A/B
+    baseline that destroys cross-request prefix locality), or
+    ``round_robin``. Autoscaling is off unless ``autoscale`` is set;
+    scale decisions need ``patience`` consecutive over/under-threshold
+    checks, one check every ``check_every`` router steps."""
+
+    engines: int = 2                   # members at startup
+    min_engines: int = 1
+    max_engines: int = 4
+    placement: str = "cache_aware"
+    prefix_weight: float = 2.0         # score weight of peek hit frac
+    load_weight: float = 1.0           # score weight of member pressure
+    sticky_bonus: float = 0.5          # hit-frac stand-in for a sticky
+                                       # family whose pages are not yet
+                                       # registered (in-flight prefill)
+    autoscale: bool = False
+    scale_up_burn: float = 1.0         # max member SLO burn rate >= this
+    scale_up_pressure: float = 0.85    # mean member pressure >= this
+    scale_down_pressure: float = 0.25  # mean member pressure <= this
+    patience: int = 3                  # consecutive checks before acting
+    check_every: int = 10              # router steps between checks
+    seed: int = 0                      # random-placement stream
+
+    def __post_init__(self):
+        if self.placement not in PLACEMENTS:
+            raise ValueError(
+                f"fleet placement must be one of {PLACEMENTS}, "
+                f"got {self.placement!r}")
+        if self.engines < 1:
+            raise ValueError("fleet needs engines >= 1")
+        if not (1 <= self.min_engines <= self.max_engines):
+            raise ValueError("fleet wants 1 <= min_engines <= max_engines")
+        if not (self.min_engines <= self.engines <= self.max_engines):
+            raise ValueError(
+                "fleet wants min_engines <= engines <= max_engines")
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict]) -> Optional["FleetConfig"]:
+        """None/falsy or ``enabled: false`` -> None (no fleet); unknown
+        keys raise — config drift surfaces at startup, not at 3am."""
+        if not cfg:
+            return None
+        cfg = dict(cfg)
+        if not cfg.pop("enabled", True):
+            return None
+        known = {f.name for f in fields(cls)}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(f"unknown fleet config keys: {sorted(unknown)}")
+        return cls(**cfg)
+
+
+class FleetMetrics:
+    """The ``serving/fleet/*`` panel. Instruments are owned by the
+    router's registry, which outlives every member engine (and its
+    per-rebuild registries) — monotonicity across rebuilds needs no
+    re-seeding here, unlike the supervisor counters."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        self.registry = registry or MetricRegistry()
+        r = self.registry
+        self.engines_active = r.gauge("serving/fleet/engines_active")
+        self.routed_by_prefix = r.counter("serving/fleet/routed_by_prefix")
+        self.routed_by_load = r.counter("serving/fleet/routed_by_load")
+        self.scale_ups = r.counter("serving/fleet/scale_ups")
+        self.scale_downs = r.counter("serving/fleet/scale_downs")
+        self.rebalanced_requests = r.counter(
+            "serving/fleet/rebalanced_requests")
+        self._slot_gauges: set = set()
+
+    def ensure_slot_gauge(self, slot: int,
+                          fn: Callable[[], float]) -> None:
+        """Per-member occupancy FuncGauge, registered once per slot
+        (slots are reused across scale cycles; the read-through closure
+        resolves the CURRENT occupant, 0.0 when the slot is empty)."""
+        if slot in self._slot_gauges:
+            return
+        self._slot_gauges.add(slot)
+        self.registry.func_gauge(
+            f"serving/fleet/engine/{slot}/page_occupancy", fn)
+
+    def snapshot(self) -> Dict[str, float]:
+        return self.registry.snapshot()
+
+
+class _Member:
+    """One fleet slot: a supervised engine pinned to its own worker
+    thread (a single-thread executor keeps the thread persistent and
+    the member's JAX dispatch serialized)."""
+
+    def __init__(self, slot: int, sup: Supervisor):
+        self.slot = slot
+        self.sup = sup
+        self.pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"fleet-engine-{slot}")
+        self.retiring = False          # scale-down in progress
+
+    @property
+    def engine(self):
+        return self.sup.engine
+
+    def accepting(self) -> bool:
+        return not self.retiring and not self.sup.draining
+
+    def close(self) -> None:
+        self.sup.close()
+        self.pool.shutdown(wait=True)
+
+
+class Autoscaler:
+    """SLO-burn + pressure driven member count. Pure decision logic —
+    the router owns spawn/retire mechanics; this just watches the
+    signals ``_resilience_pass`` already trusts (max member burn rate,
+    mean of max(occupancy, queue fraction)) and debounces with
+    ``patience`` so one hot check never flaps the fleet."""
+
+    def __init__(self, router: "FleetRouter", cfg: FleetConfig):
+        self.router = router
+        self.cfg = cfg
+        self._up_streak = 0
+        self._down_streak = 0
+
+    def evaluate(self) -> None:
+        r, cfg = self.router, self.cfg
+        active = [m for m in r.members() if not m.retiring]
+        if not active:
+            return
+        pressure = float(np.mean([r.member_pressure(m) for m in active]))
+        burn = max(r.member_burn(m) for m in active)
+        want_up = (pressure >= cfg.scale_up_pressure
+                   or burn >= cfg.scale_up_burn)
+        want_down = (pressure <= cfg.scale_down_pressure
+                     and burn < cfg.scale_up_burn)
+        self._up_streak = self._up_streak + 1 if want_up else 0
+        self._down_streak = self._down_streak + 1 if want_down else 0
+        if self._up_streak >= cfg.patience and len(active) < cfg.max_engines:
+            self._up_streak = 0
+            r.scale_up()
+        elif (self._down_streak >= cfg.patience
+              and len(active) > cfg.min_engines):
+            self._down_streak = 0
+            r.scale_down()
+
+
+class FleetRouter:
+    """N supervised ``ServingEngine`` members behind one engine-shaped
+    front end (see the module docstring for the architecture).
+
+    ``factory(slot)`` builds a fresh engine for fleet slot ``slot`` —
+    the same callable serves initial spawn, supervisor rebuild after a
+    fault, and autoscaler scale-up, so every generation of a slot's
+    engine shares its config (including ``cfg.seed``, which is what
+    keeps default-seeded sampling placement-independent)."""
+
+    def __init__(self, factory: Callable[[int], object],
+                 cfg: Optional[FleetConfig] = None,
+                 supervisor: Optional[SupervisorConfig] = None,
+                 registry: Optional[MetricRegistry] = None):
+        self.factory = factory
+        self.cfg = cfg or FleetConfig()
+        self.sup_cfg = supervisor
+        self.metrics = FleetMetrics(registry)
+        self._slots: Dict[int, _Member] = {}
+        self._placement: Dict[int, _Member] = {}       # rid -> member
+        self._affinity: Dict[Tuple[int, ...], int] = {}  # family -> slot
+        self._archive: Dict[int, Request] = {}  # results of retired slots
+        self._rs = np.random.RandomState(self.cfg.seed)
+        self._rr = 0                   # round-robin cursor
+        self._steps = 0
+        self._draining = False
+        self.autoscaler = Autoscaler(self, self.cfg)
+        for _ in range(self.cfg.engines):
+            self._spawn()
+
+    # ------------------------------------------------------------ members
+
+    def members(self) -> List[_Member]:
+        return [self._slots[s] for s in sorted(self._slots)]
+
+    @property
+    def num_engines(self) -> int:
+        return len([m for m in self._slots.values() if not m.retiring])
+
+    def member_pressure(self, member: _Member) -> float:
+        """The scalar ``_resilience_pass`` steers by: max of page-pool
+        occupancy and queue depth over its bound."""
+        eng = member.engine
+        occ = eng.cache.allocator.occupancy
+        qcap = (eng.admission.cfg.max_queue_depth
+                if eng.admission is not None
+                else max(8, 2 * eng.cfg.num_slots))
+        return max(occ, eng.scheduler.queue_depth / max(1, qcap))
+
+    def member_burn(self, member: _Member) -> float:
+        slo = member.engine.slo
+        if slo is None or not slo.slos:
+            return 0.0
+        return max(slo.burn_rate(obj) for obj in slo.slos)
+
+    def _spawn(self) -> _Member:
+        slot = next(i for i in range(len(self._slots) + 1)
+                    if i not in self._slots)
+        sup = Supervisor(functools.partial(self.factory, slot),
+                         self.sup_cfg)
+        member = _Member(slot, sup)
+        self._slots[slot] = member
+        self.metrics.ensure_slot_gauge(slot, functools.partial(
+            self._slot_occupancy, slot))
+        self.metrics.engines_active.set(self.num_engines)
+        return member
+
+    def _slot_occupancy(self, slot: int) -> float:
+        member = self._slots.get(slot)
+        if member is None:
+            return 0.0
+        return float(member.engine.cache.allocator.occupancy)
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, prompt_tokens: List[int], max_new_tokens: int,
+               arrival_time: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               priority: int = 0, sampling=None) -> int:
+        candidates = [m for m in self.members() if m.accepting()]
+        if self._draining or not candidates:
+            raise RuntimeError(
+                "fleet is draining: no member accepts admissions")
+        member, by_prefix = self._choose(prompt_tokens, candidates)
+        rid = member.sup.submit(
+            prompt_tokens, max_new_tokens, arrival_time=arrival_time,
+            deadline_s=deadline_s, priority=priority, sampling=sampling)
+        self._placement[rid] = member
+        self._affinity[self._family(prompt_tokens)] = member.slot
+        if by_prefix:
+            self.metrics.routed_by_prefix.inc()
+        else:
+            self.metrics.routed_by_load.inc()
+        return rid
+
+    def _family(self, prompt_tokens: List[int]) -> Tuple[int, ...]:
+        ps = self.members()[0].engine.cfg.page_size if self._slots else 16
+        return tuple(prompt_tokens[:ps])
+
+    def _peek(self, member: _Member, prompt_tokens: List[int]) -> int:
+        eng = member.engine
+        if eng.prefix_cache is None:
+            return 0
+        return eng.prefix_cache.peek(prompt_tokens, eng.cfg.prefill_chunk)
+
+    def _choose(self, prompt_tokens: List[int],
+                candidates: List[_Member]) -> Tuple[_Member, bool]:
+        """-> (member, routed_by_prefix). Deterministic: score ties
+        break toward the sticky-affinity slot, then the lowest slot."""
+        if self.cfg.placement == "random":
+            return candidates[self._rs.randint(len(candidates))], False
+        if self.cfg.placement == "round_robin":
+            member = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            return member, False
+        n = max(1, len(prompt_tokens))
+        sticky = self._affinity.get(self._family(prompt_tokens))
+        best, best_key, best_hit = None, None, 0.0
+        for m in candidates:
+            # affinity covers the registration gap: the family owner's
+            # first prefill may still be in flight, so peek reads 0
+            # there — score it as if the expected shared prefix were
+            # already cached, or placement scatters a family submitted
+            # in one burst across the whole fleet
+            hit = self._peek(m, prompt_tokens) / n
+            if m.slot == sticky:
+                hit = max(hit, self.cfg.sticky_bonus)
+            score = (self.cfg.prefix_weight * hit
+                     - self.cfg.load_weight * self.member_pressure(m))
+            key = (score, -m.slot)
+            if best is None or key > best_key:
+                best, best_key, best_hit = m, key, hit
+        return best, best_hit > 0
+
+    # ----------------------------------------------------------- stepping
+
+    def step(self) -> List[Tuple[int, int]]:
+        """One fleet step: every member advances one supervised engine
+        step on its own thread; emitted (rid, token) streams merge in
+        slot order (deterministic — member states are independent, so
+        thread completion order cannot change any token)."""
+        members = self.members()
+        futures = [(m, m.pool.submit(m.sup.step)) for m in members
+                   if m.sup.has_work() or not m.retiring]
+        emitted: List[Tuple[int, int]] = []
+        for _, fut in futures:
+            emitted.extend(fut.result())
+        self._steps += 1
+        self._finalize_retired()
+        if self.cfg.autoscale and not self._draining \
+                and self._steps % self.cfg.check_every == 0:
+            self.autoscaler.evaluate()
+        return emitted
+
+    # ``poll`` is the streaming-consumer name for the same operation
+    poll = step
+
+    def has_work(self) -> bool:
+        return any(m.sup.has_work() for m in self.members())
+
+    def result(self, rid: int) -> Request:
+        member = self._placement.get(rid)
+        if member is not None and rid in member.sup.journal:
+            return member.sup.result(rid)
+        for m in self.members():       # burst-synthetic intake
+            if rid in m.sup.journal:
+                return m.sup.result(rid)
+        return self._archive[rid]
+
+    def results(self) -> Dict[int, Request]:
+        out = dict(self._archive)
+        for m in self.members():
+            out.update(m.sup.results())
+        return out
+
+    def run_until_drained(self, max_steps: int = 100000,
+                          on_cap: str = "raise") -> Dict[int, Request]:
+        for _ in range(max_steps):
+            if not self.has_work():
+                return self.results()
+            self.step()
+        if on_cap == "shed":
+            for m in self.members():
+                if m.sup.has_work():
+                    m.engine._shed_stragglers()
+            return self.results()
+        raise RuntimeError(
+            f"fleet did not drain in {max_steps} steps")
+
+    # ------------------------------------------------------------ scaling
+
+    def scale_up(self) -> _Member:
+        member = self._spawn()
+        self.metrics.scale_ups.inc()
+        return member
+
+    def scale_down(self, member: Optional[_Member] = None) -> None:
+        """Retire one member through the draining contract: queued work
+        moves to peers first (rid/sampling/streamed preserved), the
+        member stops admitting, in-flight decodes run to completion
+        under ``step()``, and the slot is reclaimed by
+        ``_finalize_retired`` after the last request resolves."""
+        active = [m for m in self.members() if not m.retiring]
+        if len(active) <= 1:
+            raise RuntimeError("cannot scale down the last fleet member")
+        if member is None:
+            # least sunk work: emptiest queue, fewest active slots
+            member = min(active, key=lambda m: (
+                m.engine.scheduler.queue_depth,
+                m.engine.scheduler.active_count, m.slot))
+        moved = self._rebalance_queued(member)
+        member.retiring = True
+        member.engine.begin_drain()
+        self.metrics.scale_downs.inc()
+        self.metrics.rebalanced_requests.inc(moved)
+        self.metrics.engines_active.set(self.num_engines)
+
+    def _rebalance_queued(self, member: _Member) -> int:
+        """Move every queued request off ``member`` onto a scoring peer
+        via ``engine.restore`` — the supervisor-replay idiom, so rid,
+        sampling params, streamed tokens, and journal entry all carry
+        over and a later peer rebuild still replays the moved work."""
+        peers = [m for m in self.members()
+                 if m is not member and m.accepting()]
+        if not peers:
+            return 0
+        src = member.sup
+        moved = 0
+        for req in list(member.engine.scheduler.queue):
+            entry = src.journal.get(req.rid)
+            member.engine.scheduler.cancel(req, "rebalanced")
+            if entry is None or entry.done:
+                continue
+            dst, _ = self._choose(entry.prompt_tokens, peers)
+            restored = dst.engine.restore(
+                entry.prompt_tokens, entry.max_new_tokens,
+                generated=list(entry.streamed),
+                arrival_time=entry.arrival_time,
+                deadline=entry.deadline, priority=entry.priority,
+                rid=req.rid, sampling=entry.sampling,
+                generated_logprobs=list(entry.streamed_logps))
+            entry.request = restored
+            entry.done = restored.state in TERMINAL_STATES
+            del src.journal[req.rid]
+            dst.sup.journal[req.rid] = entry
+            self._placement[req.rid] = dst
+            self._affinity[self._family(entry.prompt_tokens)] = dst.slot
+            moved += 1
+        return moved
+
+    def _finalize_retired(self) -> None:
+        """Reclaim retired members whose last in-flight request has
+        resolved: archive their terminal results, drop their affinity
+        entries, close the supervised engine, release the thread."""
+        for member in [m for m in self.members()
+                       if m.retiring and not m.sup.has_work()]:
+            for rid, req in member.sup.results().items():
+                self._archive[rid] = req
+                self._placement.pop(rid, None)
+            for fam in [k for k, s in self._affinity.items()
+                        if s == member.slot]:
+                del self._affinity[fam]
+            del self._slots[member.slot]
+            member.close()
+        self.metrics.engines_active.set(self.num_engines)
+
+    # ------------------------------------------------------------- drain
+
+    def begin_drain(self) -> None:
+        """Fleet-wide drain: every member enters the single-engine
+        draining contract (healthz 503, queued-never-started cancelled,
+        in-flight runs out); admission closes at the router."""
+        self._draining = True
+        for m in self.members():
+            m.engine.begin_drain()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, logger=None, max_steps: int = 100000,
+              on_cap: str = "raise") -> Dict[int, Request]:
+        self.begin_drain()
+        return self.run_until_drained(max_steps, on_cap=on_cap)
+
+    def close(self) -> None:
+        for m in self.members():
+            m.close()
+        self._slots.clear()
+
+    # ------------------------------------------------------ observability
+
+    def fleet_snapshot(self) -> Dict[str, float]:
+        return self.metrics.snapshot()
+
+    def engine_snapshots(self) -> List[Dict[str, float]]:
+        return [m.engine.metrics.snapshot() for m in self.members()]

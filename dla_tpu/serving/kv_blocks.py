@@ -261,6 +261,7 @@ class PrefixCache:
         self.lookups = 0
         self.hit_tokens = 0
         self.evictions = 0
+        self.peeks = 0
         allocator.retain_hook = self._retain
         allocator.evict_hook = self._on_evict
 
@@ -302,17 +303,44 @@ class PrefixCache:
         bit-identical to cache-off."""
         self.lookups += 1
         key = tuple(tokens)
+        pages, hit, entry = self._walk(key, chunk)
+        if entry is not None:
+            self._full.move_to_end(key)
+            for p in pages:
+                self.allocator.incref(p)
+            self.hit_tokens += hit
+            return pages, hit, entry.logits
+        for p in pages:
+            self.allocator.incref(p)
+        self.hit_tokens += hit
+        return pages, hit, None
+
+    def peek(self, tokens: Sequence[int], chunk: int) -> int:
+        """Read-only hit-length estimate: the ``hit_len`` a ``lookup``
+        of ``tokens`` would return right now, WITHOUT taking page
+        references, touching the full-prompt LRU order, or advancing the
+        lookup/hit-token counters. The fleet router calls this on every
+        candidate engine per placement decision, so a peek must be
+        side-effect-free — a peek that increfed would leak references on
+        the N-1 engines that lose the placement."""
+        self.peeks += 1
+        _, hit, _ = self._walk(tuple(tokens), chunk)
+        return hit
+
+    def _walk(self, key: Tuple[int, ...], chunk: int
+              ) -> Tuple[List[int], int, Optional["_FullEntry"]]:
+        """Shared read-only index walk behind ``lookup`` and ``peek``:
+        ``(pages, hit_len, full_entry)`` with NO side effects — the
+        caller applies increfs, LRU touches and counters (or, for peek,
+        nothing at all). ``full_entry`` is non-None only on an
+        exact-full-prompt hit (``hit_len == len(key)``)."""
         n = len(key)
         ps = self.page_size
         entry = self._full.get(key)
         if entry is not None:
             pages = self._assemble_full(key, entry)
             if pages is not None:
-                self._full.move_to_end(key)
-                for p in pages:
-                    self.allocator.incref(p)
-                self.hit_tokens += n
-                return pages, n, entry.logits
+                return pages, n, entry
         # chunk-granular: the last token's logits must be recomputed, so
         # the hit stays < n; chunk alignment keeps the restart boundary
         # on the fixed absolute schedule
@@ -326,11 +354,7 @@ class PrefixCache:
             pages.append(p)
             k += 1
         hit = (len(pages) * ps // chunk) * chunk if chunk > 0 else 0
-        pages = pages[:hit // ps]
-        for p in pages:
-            self.allocator.incref(p)
-        self.hit_tokens += hit
-        return pages, hit, None
+        return pages[:hit // ps], hit, None
 
     def _assemble_full(self, key: Tuple[int, ...], entry: _FullEntry
                        ) -> Optional[List[int]]:

@@ -858,8 +858,19 @@ class ServingEngine:
             self.slo.observe(m.snapshot(), step=self.engine_steps)
         return emitted
 
-    def run_until_drained(self, max_steps: int = 100000
-                          ) -> Dict[int, Request]:
+    def run_until_drained(self, max_steps: int = 100000,
+                          on_cap: str = "raise") -> Dict[int, Request]:
+        """Step until ``has_work()`` is false. Hitting ``max_steps`` with
+        work still in flight is a wedge, and the two dispositions are
+        both terminal — a drain NEVER silently returns live requests:
+
+        - ``on_cap="raise"`` (default): RuntimeError, matching the
+          Supervisor's run cap.
+        - ``on_cap="shed"``: resolve every straggler as SHED with a
+          ``drain_cap`` flight-recorder event and return normally — the
+          fleet scale-down path, where the caller must reclaim the
+          engine but may not leak a request without a terminal status.
+        """
         try:
             for _ in range(max_steps):
                 if not self.has_work():
@@ -868,7 +879,31 @@ class ServingEngine:
         finally:
             # an open trace window must flush even on an early exit
             self.profile.close()
+        if on_cap == "shed":
+            self._shed_stragglers()
+            return dict(self._results)
         raise RuntimeError(f"serving loop did not drain in {max_steps} steps")
+
+    def _shed_stragglers(self) -> None:
+        """Terminal SHED for every request still queued or in flight —
+        the drain-cap escape hatch. Running/prefilling work gives its
+        slot and pages back through the scheduler's cancel path, so the
+        engine is fully reclaimable afterwards."""
+        stragglers = (list(self.scheduler.queue)
+                      + list(self.scheduler.running.values())
+                      + list(self.scheduler.prefilling.values()))
+        self.recorder.record("drain_cap", step=self.engine_steps,
+                             stragglers=len(stragglers))
+        for req in stragglers:
+            self.scheduler.cancel(req, "shed", RequestState.SHED)
+            self.metrics.requests_shed.inc()
+            self.recorder.record("request_shed", step=self.engine_steps,
+                                 rid=req.rid, priority=req.priority,
+                                 at="drain_cap")
+            if self.tracer.enabled:
+                self.tracer.async_end("request", "request", req.rid,
+                                      status="shed",
+                                      tokens=len(req.generated))
 
     # -------------------------------------------------------- observability
 
@@ -932,11 +967,14 @@ class ServingEngine:
         from dla_tpu.resilience.preemption import install_sigterm_flag
         self._old_handlers = install_sigterm_flag(self.begin_drain)
 
-    def drain(self, logger=None, max_steps: int = 100000
-              ) -> Dict[int, Request]:
-        """Begin (or continue) a drain, run it to empty, flush metrics."""
+    def drain(self, logger=None, max_steps: int = 100000,
+              on_cap: str = "raise") -> Dict[int, Request]:
+        """Begin (or continue) a drain, run it to empty, flush metrics.
+        ``on_cap`` picks the straggler disposition at the step cap (see
+        ``run_until_drained``); either way no request is left without a
+        terminal status."""
         self.begin_drain()
-        results = self.run_until_drained(max_steps)
+        results = self.run_until_drained(max_steps, on_cap=on_cap)
         self.metrics.report(logger, self.metrics.decode_steps.value)
         return results
 

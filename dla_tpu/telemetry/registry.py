@@ -261,6 +261,29 @@ CATALOG: Tuple[MetricSpec, ...] = (
     _s("serving/spec/rollbacks", "counter", "rounds",
        "rounds that rejected at least one draft token (rolled-back "
        "columns are never marked valid)", "step"),
+    # -- serving fleet (serving.fleet): router + autoscaler panel; lives
+    #    in the ROUTER's own registry (not a member engine's), so totals
+    #    are monotone across member rebuilds by construction. Per-member
+    #    occupancy FuncGauges ride the serving/fleet/engine/ dynamic
+    #    prefix below.
+    _s("serving/fleet/engines_active", "gauge", "engines",
+       "fleet members currently accepting placements (draining and "
+       "reclaimed members excluded)", "step"),
+    _s("serving/fleet/routed_by_prefix", "counter", "requests",
+       "placements won on prefix-cache affinity (peek hit or sticky "
+       "family match)", "step"),
+    _s("serving/fleet/routed_by_load", "counter", "requests",
+       "placements decided by load alone (no member held cached "
+       "prefix state for the prompt)", "step"),
+    _s("serving/fleet/scale_ups", "counter", "engines",
+       "autoscaler member spawns (SLO burn or occupancy over the "
+       "scale-up threshold)", "step"),
+    _s("serving/fleet/scale_downs", "counter", "engines",
+       "autoscaler member reclaims (drained via the draining contract; "
+       "queued work redistributed first)", "step"),
+    _s("serving/fleet/rebalanced_requests", "counter", "requests",
+       "queued requests moved to a peer member during scale-down "
+       "(rid/sampling/streamed state preserved)", "step"),
     # -- RLHF rollout subsystem (dla_tpu/rollout): serving-backed
     #    generation for train_rlhf (docs/RLHF.md)
     _s("rollout/rollouts", "counter", "rollouts",
@@ -317,7 +340,8 @@ CATALOG: Tuple[MetricSpec, ...] = (
 #: ``train/rms/<param path>``).
 DYNAMIC_PREFIXES: Tuple[str, ...] = ("train/rms/", "train/aux/", "eval/",
                                      "slo/", "telemetry/xla/",
-                                     "telemetry/anomaly/")
+                                     "telemetry/anomaly/",
+                                     "serving/fleet/engine/")
 
 #: Derived suffixes ``latency_summary`` appends to histogram base names.
 HISTOGRAM_SUFFIXES: Tuple[str, ...] = ("p50", "p95", "p99", "mean",

@@ -503,6 +503,28 @@ class SpeculativeSchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetSchema:
+    """serving.fleet.FleetConfig: multi-engine router (cache-aware /
+    random / round_robin placement) + SLO-driven autoscaler bounds.
+    Also the eval_latency --fleet A/B/C switch."""
+    enabled: Any = None
+    engines: Any = None
+    min_engines: Any = None
+    max_engines: Any = None
+    placement: Any = None
+    prefix_weight: Any = None
+    load_weight: Any = None
+    sticky_bonus: Any = None
+    autoscale: Any = None
+    scale_up_burn: Any = None
+    scale_up_pressure: Any = None
+    scale_down_pressure: Any = None
+    patience: Any = None
+    check_every: Any = None
+    seed: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ServingLatencySchema:
     enabled: Any = None
     arrival_rate: Any = None
@@ -524,6 +546,7 @@ class ServingLatencySchema:
     supervisor: Optional[SupervisorSchema] = None
     overload: Optional[OverloadSchema] = None
     speculative: Optional[SpeculativeSchema] = None
+    fleet: Optional[FleetSchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -166,6 +166,57 @@ def test_prefix_full_prompt_hit_returns_logits():
         a.decref(p)
 
 
+def test_prefix_peek_matches_lookup_without_side_effects():
+    """peek() is the fleet router's placement probe: it must predict
+    exactly what lookup() would hit while leaving refcounts, the cached
+    LRU, and the lookup/hit counters untouched — probing N engines per
+    admission must not distort cache behavior on any of them."""
+    pc, a = _cache()
+    toks = list(range(100, 112))            # 12 tokens = 3 full pages
+    pages = a.alloc(3)
+    pc.register(toks, pages)
+    for p in pages:
+        a.decref(p)                         # park all three on the LRU
+    lru_before = list(a.cached_pages)
+    lookups_before, hit_tokens_before = pc.lookups, pc.hit_tokens
+
+    assert pc.peek(toks, CHUNK) == 8        # strict-below-n truncation
+    assert pc.peek(toks[:6] + [7, 8], CHUNK) == 4
+    assert pc.peek([1, 2, 3], CHUNK) == 0   # cold prompt
+
+    # no refcounts taken, no LRU touch, no stats drift, peeks counted
+    assert [a.refcount(p) for p in pages] == [0, 0, 0]
+    assert list(a.cached_pages) == lru_before
+    assert pc.lookups == lookups_before
+    assert pc.hit_tokens == hit_tokens_before
+    assert pc.peeks == 3
+
+    # the probe's promise: the subsequent lookup hits exactly peek's
+    # estimate (and only the lookup increfs)
+    hit_pages, hit, _ = pc.lookup(toks, CHUNK)
+    assert hit == 8 and [a.refcount(p) for p in hit_pages] == [1, 1]
+    for p in hit_pages:
+        a.decref(p)
+
+
+def test_prefix_peek_full_prompt_and_eviction_order_unchanged():
+    pc, a = _cache(num_pages=5)             # 4 usable: pool exactly full
+    toks_a = list(range(100, 108))          # 2 full pages each
+    toks_b = list(range(200, 208))
+    pages_a, pages_b = a.alloc(2), a.alloc(2)
+    pc.register(toks_a, pages_a, np.arange(4, dtype=np.float32))
+    pc.register(toks_b, pages_b, np.arange(4, dtype=np.float32))
+    for p in pages_a + pages_b:
+        a.decref(p)
+    assert pc.peek(toks_a, CHUNK) == len(toks_a)   # exact-prompt hit
+    # peek must NOT refresh a's LRU position: under pressure a's pages
+    # (the oldest) are still reclaimed first, exactly as if never peeked
+    got = a.alloc(2)
+    assert set(got) == set(pages_a)
+    assert pc.peek(toks_a, CHUNK) < len(toks_a)    # full entry pruned
+    a.free(got)
+
+
 # ---------------------------------------------------------------------------
 # e2e on the tiny model
 # ---------------------------------------------------------------------------
