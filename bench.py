@@ -917,6 +917,147 @@ def run_serving_fleet_bench() -> dict:
     }
 
 
+def run_serving_disagg_bench() -> dict:
+    """Prefill/decode disaggregation A/B on a long-prompt burst: the
+    SAME prompts through (1) a mixed co-scheduled fleet of 3 members and
+    (2) a role-split fleet of 1 prefill + 2 decode members where every
+    finished prefix ships to a decode member as a KV migration ticket
+    (one jitted gather + one jitted scatter per handoff). The headline
+    is the disaggregated fleet's ITL p99 speedup over the mixed fleet
+    (higher is better — decode members never interleave prefill chunks,
+    so the inter-token tail loses its head-of-line stalls); detail
+    carries per-arm ITL p50/p99 and decode tokens/s, a single-engine
+    reference arm, the migrated-page throughput, and the greedy
+    bit-identity check across all arms (migration resumes from the
+    exact committed KV columns). Deterministic, CPU-sized,
+    in-process."""
+    import time
+    import jax
+    import numpy as np
+    from dla_tpu.generation.engine import GenerationConfig
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.serving import (
+        FleetConfig,
+        FleetRouter,
+        ServingConfig,
+        ServingEngine,
+        ServingMetrics,
+    )
+    from dla_tpu.utils.logging import percentile
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=128, remat="none", dtype="float32",
+        param_dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    # long prompts + small chunk: the regime where co-scheduled prefill
+    # chunks head-of-line-block decode steps and inflate the ITL tail
+    new_tokens, chunk, prompt_len = 8, 8, 24
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1, pad_token_id=0)
+    rs = np.random.RandomState(7)
+    prompts = [[int(t) for t in rs.randint(3, 500, (prompt_len,))]
+               for _ in range(24)]
+    tokens = len(prompts) * new_tokens
+    n_prefill, n_decode, reps = 1, 2, 3
+    engines = n_prefill + n_decode
+    roles = ("prefill",) * n_prefill + ("decode",) * n_decode
+
+    def build_engine(role="mixed"):
+        # fault_plan="" pins members fault-free under $DLA_FAULT_PLAN
+        return ServingEngine(model, params, gen, ServingConfig(
+            page_size=4, num_pages=96, num_slots=2, max_model_len=48,
+            max_prefill_batch=2, prefill_chunk=chunk, prefix_cache=True,
+            fault_plan="", role=role))
+
+    def warm(eng):
+        # compile warmup off the clock; decode-role members gate
+        # submit(), so warm those through restore() — the handoff-only
+        # admission surface compiles the same chunk + decode fns
+        prompt = [int(t) for t in rs.randint(3, 500, (chunk + 1,))]
+        if eng.cfg.role == "decode":
+            eng.restore(prompt, 1, generated=[], arrival_time=0.0)
+        else:
+            eng.submit(prompt, 1)
+        eng.run_until_drained()
+
+    def drive(eng, member_engines):
+        # burst-submit the whole mix; per rep, reset the member metrics
+        # and keep the least-perturbed (fastest) rep's ITL samples
+        best = None
+        for _ in range(reps):
+            for e in member_engines:
+                e.metrics = ServingMetrics()
+            t0 = time.perf_counter()
+            rids = [eng.submit(p, new_tokens) for p in prompts]
+            results = eng.run_until_drained(max_steps=20000)
+            dt = time.perf_counter() - t0
+            outs = [list(results[r].generated) for r in rids]
+            itl = [s for e in member_engines
+                   for s in e.metrics.itl_ms.samples]
+            pages = sum(
+                e.metrics.snapshot()["serving/migration/migrated_pages"]
+                for e in member_engines)
+            if best is None or dt < best[0]:
+                best = (dt, outs, itl, pages)
+        return best
+
+    def run_single():
+        eng = build_engine()
+        warm(eng)
+        dt, outs, itl, _ = drive(eng, [eng])
+        eng.close()
+        return dt, outs, itl
+
+    def run_fleet(role_split):
+        router = FleetRouter(
+            lambda slot: build_engine(
+                roles[slot] if role_split else "mixed"),
+            FleetConfig(engines=engines, min_engines=1,
+                        max_engines=engines,
+                        roles=roles if role_split else None))
+        for m in router.members():
+            warm(m.engine)
+        dt, outs, itl, pages = drive(
+            router, [m.engine for m in router.members()])
+        router.close()
+        return dt, outs, itl, pages
+
+    dt_single, outs_single, itl_single = run_single()
+    dt_mixed, outs_mixed, itl_mixed, _ = run_fleet(False)
+    dt_disagg, outs_disagg, itl_disagg, pages = run_fleet(True)
+
+    p99_mixed = percentile(itl_mixed, 99.0)
+    p99_disagg = percentile(itl_disagg, 99.0)
+    return {
+        "metric": "serving_disagg_itl_p99_speedup",
+        "value": round(p99_mixed / max(p99_disagg, 1e-9), 4),
+        "unit": "x",
+        "detail": {
+            "itl_p99_ms_disagg": round(p99_disagg, 3),
+            "itl_p99_ms_mixed": round(p99_mixed, 3),
+            "itl_p99_ms_single": round(percentile(itl_single, 99.0), 3),
+            "itl_p50_ms_disagg": round(percentile(itl_disagg, 50.0), 3),
+            "itl_p50_ms_mixed": round(percentile(itl_mixed, 50.0), 3),
+            "decode_tokens_per_s_disagg": round(tokens / dt_disagg, 1),
+            "decode_tokens_per_s_mixed": round(tokens / dt_mixed, 1),
+            "decode_tokens_per_s_single": round(tokens / dt_single, 1),
+            "migrated_pages_per_s": round(pages / dt_disagg, 1),
+            "migrated_pages": int(pages),
+            "outputs_identical":
+                bool(outs_single == outs_mixed == outs_disagg),
+            "prefill_engines": n_prefill,
+            "decode_engines": n_decode,
+            "prompt_len": prompt_len,
+            "reps": reps,
+            "requests": len(prompts),
+            "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def run_serving_resilience_bench() -> dict:
     """Serving-resilience chaos bench: a supervised engine
     (dla_tpu/serving/resilience) driven through the full serving fault
@@ -1397,7 +1538,7 @@ def _emit_and_maybe_extra() -> None:
     extra = [headline]
     for fn in (run_ppo_bench, run_decode_bench, run_serving_bench,
                run_serving_prefix_bench, run_serving_spec_bench,
-               run_serving_fleet_bench):
+               run_serving_fleet_bench, run_serving_disagg_bench):
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — extras must not kill the line
@@ -1457,6 +1598,14 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_serving_fleet_bench()))
+        return 0
+    if "serving-disagg" in sys.argv[1:]:
+        # prefill/decode disaggregation A/B target: same in-process
+        # forced-CPU pattern; headline is ITL p99 speedup (higher
+        # better)
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_serving_disagg_bench()))
         return 0
     if "serving-resilience" in sys.argv[1:]:
         # supervised-serving chaos target: same in-process forced-CPU

@@ -59,6 +59,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "placement, and an N-engine fleet with "
                         "cache-aware routing (equivalent to "
                         "latency.serving.fleet.enabled: true)")
+    p.add_argument("--disagg", action="store_true",
+                   help="also run the prefill/decode disaggregation "
+                        "A/B/C: the SAME long-prompt Poisson trace "
+                        "through one chunked engine, a mixed fleet, and "
+                        "a prefill+decode role split with KV page "
+                        "migration (equivalent to "
+                        "latency.serving.disagg.enabled: true)")
     return p.parse_args(argv)
 
 
@@ -460,6 +467,124 @@ def measure_fleet(model, params, srv: Dict) -> Dict[str, object]:
     }
 
 
+def measure_disagg(model, params, srv: Dict) -> Dict[str, object]:
+    """Prefill/decode disaggregation A/B/C: the SAME long-prompt
+    Poisson trace driven through (A) one chunked engine, (B) a mixed
+    co-scheduled fleet of P+D members, and (C) a role-split fleet of P
+    prefill + D decode members where every finished prefix ships to a
+    decode member as a KV migration ticket. All greedy, prefix cache +
+    chunked prefill on. Reports TTFT/ITL p50/p95/p99 per arm plus arm
+    C's migration counters, and asserts bit-identical outputs across
+    all three arms (migration resumes from the exact committed KV, and
+    sampling is ``fold_in(seed, k)`` — placement-independent)."""
+    from dla_tpu.serving import (
+        FleetConfig, FleetRouter, ServingEngine)
+    from dla_tpu.serving.metrics import ServingMetrics
+
+    dg = srv.get("disagg") or {}
+    n_prefill = int(dg.get("prefill_engines", 1))
+    n_decode = int(dg.get("decode_engines", 2))
+    n_req = int(dg.get("num_requests", 24))
+    rate = float(dg.get("arrival_rate",
+                        srv.get("arrival_rate", 16.0)))
+    # long prompts: the regime where prefill HOL-blocks co-scheduled
+    # decode and a dedicated prefill tier pays for the page transfer
+    prompt_len = int(dg.get("prompt_len", 48))
+    new_tokens = int(dg.get("new_tokens", srv.get("new_tokens", 32)))
+    engines = n_prefill + n_decode
+    roles = ("prefill",) * n_prefill + ("decode",) * n_decode
+    transport = str((srv.get("migration") or {}).get("transport", "auto"))
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)          # greedy, run to length
+    rs = np.random.RandomState(int(srv.get("seed", 0)))
+    vocab = model.cfg.vocab_size
+    prompts = [[int(t) for t in rs.randint(3, vocab - 1, (prompt_len,))]
+               for _ in range(n_req)]
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n_req))
+    cp = srv.get("chunked_prefill") or {}
+    chunk = int(cp.get("chunk", 0)) or 2 * int(srv.get("page_size", 16))
+
+    def build_engine(slot=0, role="mixed"):
+        # fault_plan="" pins every member fault-free even when
+        # $DLA_FAULT_PLAN is set in the environment
+        return ServingEngine(model, params, gen, _serving_config(
+            srv, prefill_chunk=chunk, prefix_cache=True, fault_plan="",
+            role=role))
+
+    def warm(eng):
+        # compile warmup off the clock; decode-role members gate
+        # submit(), so warm those through restore() — the handoff-only
+        # admission surface — which compiles the same chunk + decode fns
+        prompt = [int(t) for t in rs.randint(3, vocab - 1, (chunk + 1,))]
+        if eng.cfg.role == "decode":
+            eng.restore(prompt, 1, generated=[], arrival_time=0.0)
+        else:
+            eng.submit(prompt, 1)
+        eng.run_until_drained()
+        eng.metrics = ServingMetrics()
+
+    def arm_stats(member_engines, dt, outs):
+        ttft = [s for e in member_engines
+                for s in e.metrics.ttft_ms.samples]
+        itl = [s for e in member_engines
+               for s in e.metrics.itl_ms.samples]
+        gen_tokens = sum(len(o) for o in outs)
+        return {
+            "duration_s": dt,
+            "decode_tokens_per_s": gen_tokens / max(dt, 1e-9),
+            **{f"ttft_ms_p{q}": percentile(ttft, float(q))
+               for q in (50, 95, 99)},
+            **{f"itl_ms_p{q}": percentile(itl, float(q))
+               for q in (50, 95, 99)},
+        }
+
+    def run_single():
+        eng = build_engine()
+        warm(eng)
+        dt, outs = _drive_open_loop(eng, prompts, arrivals, new_tokens)
+        return outs, arm_stats([eng], dt, outs)
+
+    def run_fleet(role_split: bool):
+        fc = FleetConfig(engines=engines, min_engines=1,
+                         max_engines=engines,
+                         roles=roles if role_split else None,
+                         migration_transport=transport)
+        router = FleetRouter(
+            lambda slot: build_engine(
+                slot, roles[slot] if role_split else "mixed"), fc)
+        for m in router.members():
+            warm(m.engine)
+        dt, outs = _drive_open_loop(router, prompts, arrivals, new_tokens)
+        stats = arm_stats([m.engine for m in router.members()], dt, outs)
+        mig_keys = ("migrations", "migrated_pages", "host_bounce_bytes",
+                    "failed_migrations")
+        snaps = [m.engine.metrics.snapshot() for m in router.members()]
+        stats["migration"] = {
+            k: sum(s[f"serving/migration/{k}"] for s in snaps)
+            for k in mig_keys}
+        stats["migration"]["migrated_pages_per_s"] = (
+            stats["migration"]["migrated_pages"] / max(dt, 1e-9))
+        router.close()
+        return outs, stats
+
+    outs_single, single = run_single()
+    outs_mixed, mixed = run_fleet(role_split=False)
+    outs_split, split = run_fleet(role_split=True)
+    return {
+        "prefill_engines": n_prefill,
+        "decode_engines": n_decode,
+        "num_requests": n_req,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "prefill_chunk": chunk,
+        "migration_transport": transport,
+        "outputs_identical": outs_single == outs_mixed == outs_split,
+        "single": single,
+        "fleet_mixed": mixed,
+        "fleet_disagg": split,
+    }
+
+
 def measure_speculative(model, params, srv: Dict) -> Dict[str, object]:
     """Speculative-decoding A/B: the serving Poisson trace driven
     through two engines — blockwise draft/verify speculation ON vs OFF —
@@ -711,6 +836,24 @@ def main(argv=None) -> None:
                     f"{flt['fleet_random']['ttft_ms_p95']:.1f} ms "
                     f"random, outputs identical: "
                     f"{flt['outputs_identical']}")
+            if args.disagg or \
+                    (srv.get("disagg") or {}).get("enabled", False):
+                entry["disagg"] = measure_disagg(
+                    bundle.model, bundle.params, srv)
+                dsg = entry["disagg"]
+                log_rank_zero(
+                    f"[dla_tpu][latency] disagg ("
+                    f"{dsg['prefill_engines']}P+"
+                    f"{dsg['decode_engines']}D): itl p99 "
+                    f"{dsg['fleet_disagg']['itl_ms_p99']:.2f} ms split "
+                    f"vs {dsg['fleet_mixed']['itl_ms_p99']:.2f} ms "
+                    f"mixed vs {dsg['single']['itl_ms_p99']:.2f} ms "
+                    f"single; migrated "
+                    f"{dsg['fleet_disagg']['migration']['migrations']:.0f}"
+                    f" requests / "
+                    f"{dsg['fleet_disagg']['migration']['migrated_pages']:.0f}"
+                    f" pages, outputs identical: "
+                    f"{dsg['outputs_identical']}")
             if args.speculative or \
                     (srv.get("speculative") or {}).get("enabled", False):
                 entry["speculative"] = measure_speculative(

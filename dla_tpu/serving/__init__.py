@@ -21,6 +21,11 @@ Modules:
              PrefixCache.peek, sticky-prefix affinity, per-member
              supervisors) + SLO-driven Autoscaler with zero-loss
              scale-down (docs/SERVING.md "Fleet")
+  migration  KVMigrator: prefill/decode disaggregation — export a
+             mid-decode request's committed KV pages as a
+             MigrationTicket, install on another engine, resume
+             bit-identically (docs/SERVING.md "Disaggregated
+             prefill/decode")
 """
 from dla_tpu.serving.fleet import (
     Autoscaler,
@@ -35,6 +40,12 @@ from dla_tpu.serving.kv_blocks import (
     PrefixCache,
 )
 from dla_tpu.serving.metrics import ServingMetrics
+from dla_tpu.serving.migration import (
+    KVMigrator,
+    MigrationConfig,
+    MigrationError,
+    MigrationTicket,
+)
 from dla_tpu.serving.resilience import (
     AdmissionController,
     CircuitBreaker,
@@ -67,6 +78,10 @@ __all__ = [
     "FleetConfig",
     "FleetMetrics",
     "FleetRouter",
+    "KVMigrator",
+    "MigrationConfig",
+    "MigrationError",
+    "MigrationTicket",
     "NaNLogitsError",
     "PageAllocator",
     "PagedKVCache",

@@ -33,6 +33,18 @@ idiom), in-flight decodes run to completion, and the member is
 reclaimed only after its last request resolves. Zero lost requests,
 ever.
 
+``FleetConfig.roles`` disaggregates the fleet into prefill and decode
+members: prefill-role members run chunked prefill only, and after every
+router step the handoff pass exports each freshly-prefilled request's
+committed KV pages as a :class:`~dla_tpu.serving.migration
+.MigrationTicket` and installs it on the least-pressured decode-capable
+member (``KVMigrator`` device-to-device transfer, one jitted gather on
+the source and one jitted scatter on the target). The journal entry
+moves between supervisors atomically with the install — popped from the
+source before, re-inserted on failure — so a request lands exactly once
+even when the source dies mid-handoff. Scale-down migrates committed KV
+the same way instead of re-prefilling on a peer.
+
 Outputs are placement-independent by construction: generated token k
 of a request is sampled with ``fold_in(PRNGKey(seed), k)`` where the
 seed depends only on (engine config seed, rid) or on explicit
@@ -51,11 +63,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dla_tpu.serving.migration import (TRANSPORTS, KVMigrator,
+                                       MigrationConfig, MigrationError)
 from dla_tpu.serving.scheduler import TERMINAL_STATES, Request
 from dla_tpu.serving.resilience import Supervisor, SupervisorConfig
 from dla_tpu.telemetry.registry import MetricRegistry
 
 PLACEMENTS = ("cache_aware", "random", "round_robin")
+ROLES = ("prefill", "decode", "mixed")
 
 
 @dataclass(frozen=True)
@@ -67,7 +82,17 @@ class FleetConfig:
     baseline that destroys cross-request prefix locality), or
     ``round_robin``. Autoscaling is off unless ``autoscale`` is set;
     scale decisions need ``patience`` consecutive over/under-threshold
-    checks, one check every ``check_every`` router steps."""
+    checks, one check every ``check_every`` router steps.
+
+    ``roles`` disaggregates the fleet: one role per startup member
+    (``prefill`` members run chunked prefill only and hand finished
+    prefixes to the least-pressured ``decode``/``mixed`` member as KV
+    migration tickets after every router step; ``decode`` members take
+    no router admissions). None keeps every member ``mixed`` — the
+    co-scheduled default. Explicit roles pin the topology, so they are
+    mutually exclusive with ``autoscale``. ``migration_transport`` is
+    the :class:`~dla_tpu.serving.migration.MigrationConfig` transport
+    the handoff path uses."""
 
     engines: int = 2                   # members at startup
     min_engines: int = 1
@@ -85,6 +110,8 @@ class FleetConfig:
     patience: int = 3                  # consecutive checks before acting
     check_every: int = 10              # router steps between checks
     seed: int = 0                      # random-placement stream
+    roles: Optional[Tuple[str, ...]] = None  # per-slot disaggregation
+    migration_transport: str = "auto"  # handoff KV transport
 
     def __post_init__(self):
         if self.placement not in PLACEMENTS:
@@ -98,6 +125,34 @@ class FleetConfig:
         if not (self.min_engines <= self.engines <= self.max_engines):
             raise ValueError(
                 "fleet wants min_engines <= engines <= max_engines")
+        if self.migration_transport not in TRANSPORTS:
+            raise ValueError(
+                f"fleet migration_transport must be one of {TRANSPORTS}, "
+                f"got {self.migration_transport!r}")
+        if self.roles is not None:
+            if len(self.roles) != self.engines:
+                raise ValueError(
+                    f"fleet roles must name every startup member: got "
+                    f"{len(self.roles)} roles for {self.engines} engines")
+            bad = sorted(set(self.roles) - set(ROLES))
+            if bad:
+                raise ValueError(
+                    f"fleet roles must be drawn from {ROLES}, got {bad}")
+            if all(r == "prefill" for r in self.roles):
+                raise ValueError(
+                    "fleet roles need at least one decode-capable "
+                    "(decode/mixed) member to land handoffs on")
+            if self.autoscale:
+                raise ValueError(
+                    "explicit fleet roles pin the topology and cannot "
+                    "be combined with autoscale")
+
+    def role_for(self, slot: int) -> str:
+        """Slot -> role, defaulting to ``mixed`` past the pinned list
+        (slots recycled by a future scale cycle stay co-scheduled)."""
+        if self.roles is not None and 0 <= slot < len(self.roles):
+            return self.roles[slot]
+        return "mixed"
 
     @classmethod
     def from_config(cls, cfg: Optional[Dict]) -> Optional["FleetConfig"]:
@@ -112,6 +167,8 @@ class FleetConfig:
         unknown = set(cfg) - known
         if unknown:
             raise ValueError(f"unknown fleet config keys: {sorted(unknown)}")
+        if isinstance(cfg.get("roles"), list):
+            cfg["roles"] = tuple(cfg["roles"])
         return cls(**cfg)
 
 
@@ -153,9 +210,10 @@ class _Member:
     thread (a single-thread executor keeps the thread persistent and
     the member's JAX dispatch serialized)."""
 
-    def __init__(self, slot: int, sup: Supervisor):
+    def __init__(self, slot: int, sup: Supervisor, role: str = "mixed"):
         self.slot = slot
         self.sup = sup
+        self.role = role               # prefill | decode | mixed
         self.pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"fleet-engine-{slot}")
         self.retiring = False          # scale-down in progress
@@ -234,6 +292,8 @@ class FleetRouter:
         self._steps = 0
         self._draining = False
         self.autoscaler = Autoscaler(self, self.cfg)
+        self.migrator = KVMigrator(MigrationConfig(
+            transport=self.cfg.migration_transport))
         for _ in range(self.cfg.engines):
             self._spawn()
 
@@ -267,7 +327,16 @@ class FleetRouter:
                     if i not in self._slots)
         sup = Supervisor(functools.partial(self.factory, slot),
                          self.sup_cfg)
-        member = _Member(slot, sup)
+        role = self.cfg.role_for(slot)
+        if role == "mixed":
+            # a factory may disaggregate on its own (per-slot engine
+            # configs) — honor the engine's declared role in that case
+            role = getattr(sup.engine.cfg, "role", "mixed")
+        if role == "prefill" and sup.engine.cfg.prefill_chunk <= 0:
+            raise ValueError(
+                f"fleet slot {slot} is prefill-role but its engine has "
+                "prefill_chunk=0: chunked prefill is the whole job")
+        member = _Member(slot, sup, role)
         self._slots[slot] = member
         self.metrics.ensure_slot_gauge(slot, functools.partial(
             self._slot_occupancy, slot))
@@ -286,7 +355,8 @@ class FleetRouter:
                arrival_time: Optional[float] = None,
                deadline_s: Optional[float] = None,
                priority: int = 0, sampling=None) -> int:
-        candidates = [m for m in self.members() if m.accepting()]
+        candidates = [m for m in self.members()
+                      if m.accepting() and m.role != "decode"]
         if self._draining or not candidates:
             raise RuntimeError(
                 "fleet is draining: no member accepts admissions")
@@ -355,6 +425,7 @@ class FleetRouter:
         for _, fut in futures:
             emitted.extend(fut.result())
         self._steps += 1
+        self._handoff_pass()
         self._finalize_retired()
         if self.cfg.autoscale and not self._draining \
                 and self._steps % self.cfg.check_every == 0:
@@ -396,6 +467,84 @@ class FleetRouter:
         raise RuntimeError(
             f"fleet did not drain in {max_steps} steps")
 
+    # ----------------------------------------------------------- handoffs
+
+    def _handoff_pass(self) -> None:
+        """Ship every freshly-prefilled request off prefill-role members
+        to the least-pressured decode-capable member. Runs synchronously
+        between fleet steps — member faults only surface inside
+        ``engine.step()``, so nothing can interrupt a handoff halfway."""
+        sources = [m for m in self.members() if m.role == "prefill"]
+        if not sources:
+            return
+        for src in sources:
+            for req in list(src.engine.scheduler.running.values()):
+                if not req.generated:
+                    continue           # prefill not finished this step
+                sinks = [m for m in self.members()
+                         if m is not src and m.accepting()
+                         and m.role != "prefill"]
+                dedicated = [m for m in sinks if m.role == "decode"]
+                if dedicated:
+                    sinks = dedicated
+                if not sinks:
+                    return             # decode locally; retry next step
+                dst = min(sinks, key=lambda m: (
+                    self.member_pressure(m), m.slot))
+                self._migrate_request(src, req, dst)
+
+    def _migrate_request(self, src: _Member, req: Request,
+                         dst: _Member) -> bool:
+        """Move one mid-decode request ``src`` -> ``dst`` by KV page
+        migration, exactly once: the journal entry is popped from the
+        source supervisor BEFORE the install (a source crash after a
+        successful install must not replay the request there) and
+        re-inserted on failure (the request keeps decoding at home, a
+        later pass retries). Refusals are already counted on the
+        refusing engine's ``serving/migration/failed_migrations``."""
+        try:
+            ticket = self.migrator.export_ticket(
+                src.engine, req.rid, src_slot=src.slot)
+        except MigrationError:
+            return False
+        entry = src.sup.journal.pop(req.rid, None)
+        try:
+            moved = self.migrator.install(dst.engine, ticket)
+        except MigrationError:
+            if entry is not None:
+                src.sup.journal[req.rid] = entry
+            return False
+        src.engine.release_migrated(req.rid)
+        if entry is not None:
+            entry.request = moved
+            entry.done = moved.state in TERMINAL_STATES
+            entry.migrated_from = src.slot
+            entry.migrations += 1
+            dst.sup.journal[req.rid] = entry
+        self._placement[req.rid] = dst
+        self._affinity[self._family(list(req.prompt_tokens))] = dst.slot
+        return True
+
+    def _migrate_running(self, member: _Member) -> int:
+        """Scale-down path: migrate the member's mid-decode requests to
+        the least-pressured decode-capable peer instead of letting them
+        run out on the retiring member (frees the slot sooner) or
+        re-prefilling elsewhere (wastes the committed KV)."""
+        peers = [m for m in self.members()
+                 if m is not member and m.accepting()
+                 and m.role != "prefill"]
+        if not peers:
+            return 0
+        moved = 0
+        for req in list(member.engine.scheduler.running.values()):
+            if not req.generated:
+                continue
+            dst = min(peers, key=lambda m: (
+                self.member_pressure(m), m.slot))
+            if self._migrate_request(member, req, dst):
+                moved += 1
+        return moved
+
     # ------------------------------------------------------------ scaling
 
     def scale_up(self) -> _Member:
@@ -417,7 +566,12 @@ class FleetRouter:
             member = min(active, key=lambda m: (
                 m.engine.scheduler.queue_depth,
                 m.engine.scheduler.active_count, m.slot))
+        if member.role != "prefill" and not any(
+                m.role != "prefill" for m in active if m is not member):
+            raise RuntimeError(
+                "cannot retire the last decode-capable fleet member")
         moved = self._rebalance_queued(member)
+        moved += self._migrate_running(member)
         member.retiring = True
         member.engine.begin_drain()
         self.metrics.scale_downs.inc()
@@ -431,6 +585,11 @@ class FleetRouter:
         over and a later peer rebuild still replays the moved work."""
         peers = [m for m in self.members()
                  if m is not member and m.accepting()]
+        # restore re-runs prefill on the peer, so prefer prefill-capable
+        # members; a decode-only fleet remnant still beats losing work
+        non_decode = [m for m in peers if m.role != "decode"]
+        if non_decode:
+            peers = non_decode
         if not peers:
             return 0
         src = member.sup

@@ -373,6 +373,34 @@ class PrefixCache:
             pages.append(entry.tail_page)
         return pages
 
+    def acquire_pages(self, tokens: Sequence[int]) -> Optional[List[int]]:
+        """Every full page of a PAGE-ALIGNED prefix, each ALREADY
+        increfed — or None, with no references taken, when the prefix is
+        not aligned or any page is missing (an interior eviction hole).
+
+        This is the adopt-without-prefill surface behind
+        ``ServingEngine.restore``'s cache fast path and KV import: unlike
+        ``lookup`` there is no chunk truncation (the caller resumes
+        DECODE, not prefill, so it needs the committed columns exactly)
+        and no full-prompt logits (the next decode input is the last
+        generated token, so no logits are consumed at all)."""
+        key = tuple(tokens)
+        n, ps = len(key), self.page_size
+        self.lookups += 1
+        if n == 0 or n % ps:
+            return None
+        pages: List[int] = []
+        for k in range(1, n // ps + 1):
+            p = self._index.get(key[:k * ps])
+            if p is None:
+                for q in pages:
+                    self.allocator.decref(q)
+                return None
+            self.allocator.incref(p)
+            pages.append(p)
+        self.hit_tokens += n
+        return pages
+
     # ------------------------------------------------------- registration
 
     def register(self, tokens: Sequence[int], pages: Sequence[int],

@@ -68,6 +68,15 @@ class ServingMetrics:
         self.spec_accepted = r.counter("serving/spec/accepted_tokens")
         self.spec_rollbacks = r.counter("serving/spec/rollbacks")
         self.spec_acceptance_rate = r.gauge("serving/spec/acceptance_rate")
+        self.migrations = r.counter("serving/migration/migrations")
+        self.migrated_pages = r.counter(
+            "serving/migration/migrated_pages")
+        self.host_bounce_bytes = r.counter(
+            "serving/migration/host_bounce_bytes")
+        self.failed_migrations = r.counter(
+            "serving/migration/failed_migrations")
+        self.handoff_wait_ms = r.histogram(
+            "serving/migration/handoff_wait_ms")
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {
@@ -112,10 +121,19 @@ class ServingMetrics:
             "serving/spec/rollbacks": float(self.spec_rollbacks.value),
             "serving/spec/acceptance_rate":
                 self.spec_acceptance_rate.value,
+            "serving/migration/migrations": float(self.migrations.value),
+            "serving/migration/migrated_pages": float(
+                self.migrated_pages.value),
+            "serving/migration/host_bounce_bytes": float(
+                self.host_bounce_bytes.value),
+            "serving/migration/failed_migrations": float(
+                self.failed_migrations.value),
         }
         out.update(self.ttft_ms.summary("serving/ttft_ms_"))
         out.update(self.itl_ms.summary("serving/itl_ms_"))
         out.update(self.queue_wait_ms.summary("serving/queue_wait_ms_"))
+        out.update(self.handoff_wait_ms.summary(
+            "serving/migration/handoff_wait_ms_"))
         return out
 
     def report(self, logger: Optional[MetricsLogger], step: int) -> None:

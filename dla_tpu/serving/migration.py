@@ -1,0 +1,219 @@
+"""KV page migration: move an in-flight request's committed state
+between serving engines — the handoff primitive behind prefill/decode
+disaggregation and rebalance-without-recompute.
+
+A request mid-decode is fully described by host metadata the engine
+already mirrors (rid, sampling params, streamed tokens and logprobs,
+the committed length) plus the KV columns its pages hold for the
+committed prefix. :class:`KVMigrator` serializes that into a
+:class:`MigrationTicket`:
+
+- **export** gathers the request's ordered page list out of the source
+  pool in ONE fixed-shape jitted call (``ServingEngine._export_kv_fn``,
+  compile counter pinned at 1 per engine build) — pad page ids route to
+  the trash page, so every export of every request reuses one compile;
+- **transfer** keeps the payload on device when source and target share
+  a device (or ``jax.device_put`` reaches the target directly), with a
+  host bounce as the fallback (``transport: host`` forces it; the
+  bounced bytes are counted on ``serving/migration/host_bounce_bytes``);
+- **install** (``ServingEngine.import_request``) allocates pages on the
+  target, scatters the KV columns in ONE fixed-shape jitted call
+  (``_import_kv_fn``, also pinned at 1), registers the committed full
+  pages into the target's PrefixCache, and binds the request straight
+  into a decode slot — it resumes mid-stream on the next engine step.
+
+The continuation is bit-identical to never having moved: token k of a
+request is sampled with ``fold_in(PRNGKey(seed), k)`` where the seed
+depends only on (engine config seed, rid) or explicit SamplingParams —
+never on slot, engine, or placement — and the import preserves rid,
+sampling, and the generated-token index.
+
+Failure semantics: export REJECTS requests that are not resumable in
+place — queued, prefilling, evicted (their pages are gone: the
+"eviction hole"), or with uncomputed committed columns — and import
+rejects geometry mismatches and page-pool exhaustion, all as
+:class:`MigrationError` with the source request untouched. The fleet's
+handoff path moves the supervisor journal entry atomically with the
+install, so a source-engine crash mid-handoff replays the request on
+exactly one engine (docs/SERVING.md "Disaggregated prefill/decode").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+TRANSPORTS = ("auto", "device", "host")
+
+
+class MigrationError(RuntimeError):
+    """A migration step refused or failed; the source request (when one
+    exists) is untouched and keeps running where it was."""
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrationConfig:
+    """KV handoff knobs (``latency.serving.migration`` in config).
+
+    ``transport`` picks how the page payload travels: ``auto`` stays on
+    device when the pools share one (device-to-device put otherwise,
+    host bounce only when that fails), ``device`` requires a device
+    path, ``host`` forces the bounce — the portability/debug arm, and
+    what exercises ``serving/migration/host_bounce_bytes``."""
+
+    transport: str = "auto"
+
+    def __post_init__(self):
+        if self.transport not in TRANSPORTS:
+            raise ValueError(
+                f"migration transport must be one of {TRANSPORTS}, "
+                f"got {self.transport!r}")
+
+    @classmethod
+    def from_config(cls, cfg: Optional[dict]) -> "MigrationConfig":
+        if not cfg:
+            return cls()
+        cfg = dict(cfg)
+        cfg.pop("enabled", None)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(
+                f"unknown migration config keys: {sorted(unknown)}")
+        return cls(**cfg)
+
+
+@dataclasses.dataclass
+class MigrationTicket:
+    """A request's complete resumable state, engine-independent.
+
+    ``k_payload``/``v_payload`` are the gathered page contents, shape
+    ``[L, pages_per_slot, page_size, KH, D]`` — fixed per engine
+    geometry, with only the first ``n_pages`` rows real (the pad rows
+    hold trash-page contents and are never scattered onto real pages).
+    ``committed_len`` is the number of KV columns the payload covers:
+    ``len(prompt) + len(generated) - 1`` — the last generated token is
+    the next decode input and its column has not been written yet.
+    """
+    rid: int
+    prompt_tokens: List[int]
+    max_new_tokens: int
+    generated: List[int]
+    generated_logprobs: List[float]
+    sampling: Optional[object]          # SamplingParams override or None
+    arrival_time: float
+    deadline: Optional[float]
+    priority: int
+    committed_len: int
+    page_size: int
+    n_pages: int                        # real payload rows (committed)
+    k_payload: object                   # [L, P, page_size, KH, D]
+    v_payload: object
+    transport: str = "device"           # how the payload currently lives
+    src_slot: Optional[int] = None      # fleet slot of the exporter
+    # source-engine clocks, carried so TTFT is not double-counted and
+    # the cross-engine ITL gap (the handoff wait) is real
+    admitted_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
+
+    @property
+    def payload_bytes(self) -> int:
+        k, v = self.k_payload, self.v_payload
+        return int(getattr(k, "nbytes", 0)) + int(getattr(v, "nbytes", 0))
+
+
+class KVMigrator:
+    """Orchestrates export -> transfer -> install between two engines.
+
+    The migrator is stateless beyond its config; counters live on the
+    ENGINES' ``_mig_stats`` (delta-mirrored into their registries each
+    step, Supervisor-re-seeded across rebuilds — the speculative-counter
+    idiom), so totals stay monotone however many migrators touch an
+    engine. Export failures count on the source, import failures and
+    successes on the target."""
+
+    def __init__(self, cfg: Optional[MigrationConfig] = None):
+        self.cfg = cfg or MigrationConfig()
+
+    # ---------------------------------------------------------- pipeline
+
+    def export_ticket(self, engine, rid: int,
+                      src_slot: Optional[int] = None) -> MigrationTicket:
+        """Serialize ``rid``'s committed state out of ``engine``. Raises
+        :class:`MigrationError` (and counts a failed migration on the
+        source) when the request is not resumable in place."""
+        ticket = engine.export_request(rid)
+        ticket.src_slot = src_slot
+        return ticket
+
+    def deliver(self, ticket: MigrationTicket, dst_engine) -> None:
+        """Apply the transport policy: land the payload where the target
+        engine's pool lives. Mutates the ticket in place."""
+        mode = self.cfg.transport
+        if mode == "host":
+            self._bounce(ticket)
+            return
+        dst_dev = self._pool_device(dst_engine)
+        src_dev = self._payload_device(ticket)
+        if dst_dev is None or src_dev is None or src_dev == dst_dev:
+            return                      # shared device: zero-copy handoff
+        try:
+            ticket.k_payload = jax.device_put(ticket.k_payload, dst_dev)
+            ticket.v_payload = jax.device_put(ticket.v_payload, dst_dev)
+        except Exception as exc:  # noqa: BLE001 — no D2D path: bounce
+            if mode == "device":
+                raise MigrationError(
+                    f"device-to-device transfer failed and transport is "
+                    f"pinned to 'device': {exc!r}") from exc
+            self._bounce(ticket)
+
+    def install(self, dst_engine, ticket: MigrationTicket):
+        """Install the ticket into the target engine (see
+        ``ServingEngine.import_request``); returns the live Request."""
+        self.deliver(ticket, dst_engine)
+        return dst_engine.import_request(ticket)
+
+    def migrate(self, src_engine, rid: int, dst_engine):
+        """Engine-level end-to-end move: export, transfer, install, then
+        release the source copy. On an install failure the source
+        request keeps running untouched. Fleet handoffs do NOT use this
+        directly — they interleave the supervisor-journal move for the
+        exactly-once crash contract (serving.fleet)."""
+        ticket = self.export_ticket(src_engine, rid)
+        req = self.install(dst_engine, ticket)
+        src_engine.release_migrated(rid)
+        return req
+
+    # --------------------------------------------------------- internals
+
+    @staticmethod
+    def _pool_device(engine):
+        devs = getattr(engine.cache.k_pages, "devices", None)
+        if devs is None:
+            return None
+        try:
+            return next(iter(devs()))
+        except Exception:  # noqa: BLE001 — sharded/committed-less array
+            return None
+
+    @staticmethod
+    def _payload_device(ticket: MigrationTicket):
+        devs = getattr(ticket.k_payload, "devices", None)
+        if devs is None:
+            return None                 # host-resident payload
+        try:
+            return next(iter(devs()))
+        except Exception:  # noqa: BLE001
+            return None
+
+    @staticmethod
+    def _bounce(ticket: MigrationTicket) -> None:
+        if ticket.transport == "host":
+            return
+        # dla: disable=host-sync-in-hot-loop -- designed migration host bounce: one D2H per migrated request, counted on serving/migration/host_bounce_bytes
+        ticket.k_payload = np.asarray(ticket.k_payload)
+        ticket.v_payload = np.asarray(ticket.v_payload)
+        ticket.transport = "host"

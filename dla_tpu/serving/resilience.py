@@ -308,6 +308,14 @@ class JournalEntry:
     request: Request      # live request object on the CURRENT engine
     sampling: Optional[object] = None          # SamplingParams override
     streamed_logps: List[float] = dataclasses.field(default_factory=list)
+    # migration provenance: the fleet moves a journal entry to the
+    # TARGET member's supervisor atomically with the KV install (popped
+    # from the source first), so replay after a mid-handoff crash lands
+    # the request on exactly one engine. These fields record where it
+    # came from and how many hops it has taken — postmortem breadcrumbs,
+    # not replay inputs.
+    migrated_from: Optional[int] = None        # source fleet slot
+    migrations: int = 0                        # completed handoffs
 
 
 class Supervisor:
@@ -361,6 +369,11 @@ class Supervisor:
         # stay monotonic across rebuilds like the supervisor counters
         self._spec_totals = {"rounds": 0, "proposed": 0, "accepted": 0,
                              "rollbacks": 0}
+        # same carry for the KV-migration counters: serving/migration/*
+        # totals survive rebuilds of the engine that earned them
+        self._mig_totals = {"migrations": 0, "migrated_pages": 0,
+                            "host_bounce_bytes": 0,
+                            "failed_migrations": 0}
         self.failures: List[str] = []     # restart kinds, in order
         self.tripped = False
         self.breaker = CircuitBreaker(
@@ -398,6 +411,12 @@ class Supervisor:
             m.spec_rollbacks.inc(t["rollbacks"])
             if t["proposed"]:
                 m.spec_acceptance_rate.set(t["accepted"] / t["proposed"])
+        mt = self._mig_totals
+        if any(mt.values()):
+            m.migrations.inc(mt["migrations"])
+            m.migrated_pages.inc(mt["migrated_pages"])
+            m.host_bounce_bytes.inc(mt["host_bounce_bytes"])
+            m.failed_migrations.inc(mt["failed_migrations"])
         self._arm_watchdog()
         if self.tripped:
             self.engine.begin_drain()
@@ -469,7 +488,8 @@ class Supervisor:
         eng = self.engine
         compile_mark = (eng.decode_compiles, eng.prefill_compiles,
                         eng.prefill_chunk_compiles,
-                        eng.spec_draft_compiles, eng.spec_verify_compiles)
+                        eng.spec_draft_compiles, eng.spec_verify_compiles,
+                        eng.export_compiles, eng.import_compiles)
         wd = self._watchdog
         wd.resume()
         try:
@@ -485,7 +505,8 @@ class Supervisor:
         if self._hang.is_set():
             if (eng.decode_compiles, eng.prefill_compiles,
                     eng.prefill_chunk_compiles, eng.spec_draft_compiles,
-                    eng.spec_verify_compiles) != compile_mark:
+                    eng.spec_verify_compiles, eng.export_compiles,
+                    eng.import_compiles) != compile_mark:
                 # an XLA compile landed in this step: tracing/lowering
                 # legitimately blows any serving latency budget (and
                 # recurs on every rebuilt engine), so it is a known
@@ -565,6 +586,10 @@ class Supervisor:
             # before teardown; _build_engine re-seeds them
             for key in self._spec_totals:
                 self._spec_totals[key] += int(stats.get(key, 0))
+        mig = getattr(eng, "_mig_stats", None)
+        if mig:
+            for key in self._mig_totals:
+                self._mig_totals[key] += int(mig.get(key, 0))
         self.breaker.record(self.now())
         out_of_budget = self.tripped   # tripped BEFORE this failure
         self.tripped = self.tripped or self.breaker.tripped

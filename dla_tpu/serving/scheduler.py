@@ -293,6 +293,30 @@ class Scheduler:
         self.prefilling.pop(req.slot, None)
         self.running[req.slot] = req
 
+    def adopt(self, req: Request, pages: List[int]) -> int:
+        """Bind a request whose committed KV already sits in the pool
+        straight into a DECODE slot — the KV-import and restore-from-
+        cache entry point (no queue, no prefill). The caller owns one
+        reference per page in ``pages`` (freshly allocated, or increfed
+        cache aliases) and sets up the slot's cache metadata itself;
+        from here the request is indistinguishable from one that
+        prefilled locally. Raises when the request cannot fit the slot
+        window or no slot is free — the caller unwinds its references."""
+        geom = self.cache.geom
+        need = len(req.prompt_tokens) + req.max_new_tokens
+        if need > geom.slot_window:
+            raise ValueError(
+                f"request {req.rid}: prompt+max_new ({need}) exceeds the "
+                f"slot window ({geom.slot_window})")
+        if not self.free_slots:
+            raise RuntimeError(
+                f"request {req.rid}: no free slot to adopt into")
+        req.pages = list(pages)
+        req.slot = self.free_slots.pop()
+        req.state = RequestState.DECODE
+        self.running[req.slot] = req
+        return req.slot
+
     # --------------------------------------------------- page-pool safety
 
     def ensure_decode_pages(self, span: int = 1) -> List[Request]:

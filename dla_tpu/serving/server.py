@@ -47,6 +47,7 @@ from dla_tpu.serving.kv_blocks import (
     PrefixCache,
 )
 from dla_tpu.serving.metrics import ServingMetrics
+from dla_tpu.serving.migration import MigrationError, MigrationTicket
 from dla_tpu.serving.resilience import (
     AdmissionController,
     DegradationLadder,
@@ -146,6 +147,15 @@ class ServingConfig:
     # tokens itself at the request's fold_in(seed, k) stream positions
     # and accepts a draft token only when it EQUALS the target's sample.
     speculative: Optional[Dict] = None
+    # disaggregation role of this engine within a fleet:
+    #   "mixed"   — prefill + decode co-scheduled (the default; a
+    #               standalone engine is always mixed)
+    #   "prefill" — runs chunked prefill only; the fleet ships each
+    #               finished prefix to a decode engine as a
+    #               MigrationTicket (requires prefill_chunk > 0)
+    #   "decode"  — admission is handoff-only: submit() refuses, work
+    #               arrives via import_request / restore
+    role: str = "mixed"
 
     @property
     def pages_per_slot(self) -> int:
@@ -186,6 +196,15 @@ class ServingEngine:
                 "prefix_cache requires prefill_chunk > 0: cache hits "
                 "are chunk-granular, so the monolithic prefill path "
                 "cannot consume them")
+        if cfg.role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'mixed', got "
+                f"{cfg.role!r}")
+        if cfg.role == "prefill" and not cfg.prefill_chunk:
+            raise ValueError(
+                "role 'prefill' requires prefill_chunk > 0: a prefill "
+                "engine ships chunk-aligned prefixes, and only chunked "
+                "prefill lands page-aligned committed state to export")
         spec = dict(cfg.speculative or {})
         if spec and not spec.get("enabled", True):
             spec = {}
@@ -238,6 +257,12 @@ class ServingEngine:
         self._spec_stats = {"rounds": 0, "proposed": 0, "accepted": 0,
                             "rollbacks": 0}
         self._spec_mirrored = dict(self._spec_stats)
+        # KV migration accounting: same delta-mirror idiom. Export
+        # failures count on the source engine; imports, page counts and
+        # host-bounce bytes on the target.
+        self._mig_stats = {"migrations": 0, "migrated_pages": 0,
+                           "host_bounce_bytes": 0, "failed_migrations": 0}
+        self._mig_mirrored = dict(self._mig_stats)
         # the draft tree: int8 weight-only self-draft (quantize_weights
         # adds _wscale leaves, so this is a DIFFERENT treedef from the
         # target and rides the spec fns as its own jit argument) or the
@@ -319,6 +344,8 @@ class ServingEngine:
         self.prefill_chunk_compiles = 0
         self.spec_draft_compiles = 0
         self.spec_verify_compiles = 0
+        self.export_compiles = 0
+        self.import_compiles = 0
         self._decode = jax.jit(self._decode_fn)
         self._prefill = jax.jit(self._prefill_fn)
         self._prefill_chunk = jax.jit(self._prefill_chunk_fn)
@@ -326,6 +353,8 @@ class ServingEngine:
                             if self._spec_k else None)
         self._spec_verify = (jax.jit(self._spec_verify_fn)
                              if self._spec_k else None)
+        self._export_kv = jax.jit(self._export_kv_fn)
+        self._import_kv = jax.jit(self._import_kv_fn)
         # anomaly auto-triage over inter-token latency + unattributed
         # recompiles; captures land next to the other postmortems
         anomaly_cfg = AnomalyConfig.from_config(cfg.anomaly)
@@ -356,6 +385,8 @@ class ServingEngine:
             if self._spec_k:
                 named += [("spec_draft", self._spec_draft),
                           ("spec_verify", self._spec_verify)]
+            named += [("kv_export", self._export_kv),
+                      ("kv_import", self._import_kv)]
             wrapped = [
                 IntrospectedFunction(
                     name, fn, registry=self.metrics.registry,
@@ -365,7 +396,8 @@ class ServingEngine:
                 for name, fn in named]
             self._decode, self._prefill, self._prefill_chunk = wrapped[:3]
             if self._spec_k:
-                self._spec_draft, self._spec_verify = wrapped[3:]
+                self._spec_draft, self._spec_verify = wrapped[3:5]
+            self._export_kv, self._import_kv = wrapped[-2:]
         else:
             self.mfu_calc = None
 
@@ -470,6 +502,32 @@ class ServingEngine:
         k_pages = k_pages.at[:, page_ids, offs].set(k_cols[:, 0])
         v_pages = v_pages.at[:, page_ids, offs].set(v_cols[:, 0])
         return k_pages, v_pages, logits
+
+    def _export_kv_fn(self, k_pages, v_pages, page_ids):
+        """Gather one request's ordered pages out of the pool into a
+        migration payload. ``page_ids`` [pages_per_slot] physical page
+        ids with pad entries routed to trash page 0 — the shape is fixed
+        by engine geometry, so every export of every request reuses ONE
+        compile. Returns (k_payload, v_payload)
+        [L, pages_per_slot, page_size, KH, D]; the payload stays on
+        device (the migrator decides whether it ever touches the host).
+        """
+        self.export_compiles += 1  # dla: disable=trace-side-effect -- deliberate trace-time compile counter, pinned by the migration compile-once tests
+        return k_pages[:, page_ids], v_pages[:, page_ids]
+
+    def _import_kv_fn(self, k_pages, v_pages, k_payload, v_payload,
+                      page_ids):
+        """Scatter a migration payload onto freshly allocated pages in
+        ONE fixed-shape call — the install half of the KV handoff.
+        ``page_ids`` [pages_per_slot] with pad entries routed to trash
+        page 0 (pad payload rows carry the source's trash contents, so
+        the duplicate page-0 writes are garbage-onto-garbage by the
+        trash-page convention). Same one-compile-per-engine contract as
+        the export gather."""
+        self.import_compiles += 1  # dla: disable=trace-side-effect -- deliberate trace-time compile counter, pinned by the migration compile-once tests
+        k_pages = k_pages.at[:, page_ids].set(k_payload)
+        v_pages = v_pages.at[:, page_ids].set(v_payload)
+        return k_pages, v_pages
 
     def _decode_fn(self, params, k_pages, v_pages, block_tables, valid,
                    pos, lengths, tokens, active, temps, top_ps, top_ks,
@@ -666,6 +724,10 @@ class ServingEngine:
         if self._draining:
             raise RuntimeError(
                 "engine is draining (SIGTERM received): admission closed")
+        if self.cfg.role == "decode":
+            raise RuntimeError(
+                "engine role is 'decode': admission is handoff-only "
+                "(import_request / restore)")
         geom = self.cache.geom
         req = Request(prompt_tokens=list(prompt_tokens),
                       max_new_tokens=int(max_new_tokens),
@@ -766,7 +828,15 @@ class ServingEngine:
         ``sampling``) must be preserved for that determinism when the
         request used the rid-derived default seed. Bypasses the
         admission gate and the drain closure: replayed requests ARE the
-        in-flight work a drain exists to finish."""
+        in-flight work a drain exists to finish.
+
+        When the prefix cache already holds EVERY page of the committed
+        prefix (the usual case on supervisor replay — the crashed
+        engine's registrations are gone, but fleet rebalance hands the
+        request to an engine that often served the same prompt), the
+        request adopts those pages straight into a decode slot and
+        resumes with ZERO prefill; otherwise it queues for the normal
+        re-prefill."""
         req = Request(prompt_tokens=list(prompt_tokens),
                       max_new_tokens=int(max_new_tokens),
                       arrival_time=arrival_time,
@@ -779,14 +849,250 @@ class ServingEngine:
         req.generated_logprobs = (
             list(generated_logprobs) if generated_logprobs is not None
             else [0.0] * len(req.generated))
-        self.scheduler.submit(req)
         if req.remaining_new_tokens <= 0:
             # every token already streamed before the failure: nothing
             # left to recompute
+            self.scheduler.submit(req)
             self.scheduler.cancel(req, "length")
             self.metrics.requests_finished.inc()
+        elif not self._try_adopt_cached(req):
+            self.scheduler.submit(req)
         self._results[req.rid] = req
         return req
+
+    def _try_adopt_cached(self, req: Request) -> bool:
+        """Restore fast path: when the prefix cache holds every page of
+        the request's COMMITTED prefix (``prefix_tokens[:-1]`` — the
+        last generated token is the next decode input, its column not
+        yet written), alias them into a free decode slot and resume
+        decode directly, skipping prefill entirely. Only a page-aligned
+        committed length qualifies: partial tail columns are never
+        indexed, so an unaligned prefix always needs at least one chunk
+        recomputed and takes the normal queue path. References taken
+        here are unwound completely on any refusal — the fallback is
+        indistinguishable from never having tried."""
+        if self.prefix_cache is None or not req.generated:
+            return False
+        ps = self.cfg.page_size
+        committed = len(req.prefix_tokens) - 1
+        if committed < ps or committed % ps:
+            return False
+        geom = self.cache.geom
+        if len(req.prompt_tokens) + req.max_new_tokens > geom.slot_window:
+            return False     # let submit() raise its precise error
+        if not self.scheduler.free_slots:
+            return False
+        if self.scheduler._admission_headroom() == 0:
+            return False
+        pages = self.prefix_cache.acquire_pages(
+            req.prefix_tokens[:committed])
+        if pages is None:
+            return False
+        n_extra = min(self.cfg.decode_reserve_pages,
+                      geom.pages_per_slot - len(pages))
+        extra = self.cache.allocator.alloc(n_extra) if n_extra > 0 else []
+        if extra is None:
+            for p in pages:
+                self.cache.allocator.decref(p)
+            return False
+        self._adopt_committed(req, pages + extra, committed)
+        self.metrics.prefill_tokens_saved.inc(committed)
+        return True
+
+    def _adopt_committed(self, req: Request, pages: List[int],
+                         committed: int) -> None:
+        """Shared tail of the two no-prefill entry paths (cache-alias
+        restore and KV import): bind the request into a decode slot over
+        ``pages`` whose first ``ceil(committed/ps)`` entries hold its
+        committed KV, and enter the decode batch with the last generated
+        token as the next input."""
+        slot = self.scheduler.adopt(req, pages)
+        self.cache.open_slot_prefill(slot, req.pages, committed)
+        self.cache.begin_decode(slot, committed, req.generated[-1])
+        self._bind_slot_sampling(req)
+
+    # ------------------------------------------------------- KV migration
+
+    def export_request(self, rid: int) -> MigrationTicket:
+        """Serialize a mid-decode request's committed state into a
+        :class:`MigrationTicket` (the extract half of the KV handoff —
+        usually reached via ``KVMigrator``). The request itself is NOT
+        released: it keeps decoding here until ``release_migrated``,
+        so a failed install downstream loses nothing.
+
+        Refuses (``MigrationError``, counted on
+        ``serving/migration/failed_migrations``) requests that are not
+        resumable in place: unknown, queued/prefilling/terminal, or with
+        an eviction hole — block-table pages no longer covering the
+        committed columns."""
+        req = self._results.get(rid)
+        if req is None:
+            return self._export_refuse(f"unknown rid {rid}")
+        if req.state is not RequestState.DECODE or req.slot is None \
+                or self.scheduler.running.get(req.slot) is not req:
+            return self._export_refuse(
+                f"request {rid} is {req.state.value}, not mid-decode: "
+                "only requests with committed KV in the pool can "
+                "migrate (eviction hole — queued work just re-routes)")
+        committed = len(req.prefix_tokens) - 1
+        if committed < 1:
+            return self._export_refuse(
+                f"request {rid} has no committed columns yet")
+        geom = self.cache.geom
+        needed = geom.pages_for(committed)
+        btab = self.cache.block_tables[req.slot]
+        if len(req.pages) < needed or not all(
+                int(btab[i]) == req.pages[i] and req.pages[i] != 0
+                for i in range(needed)):
+            return self._export_refuse(
+                f"request {rid}: block table does not cover its "
+                f"committed prefix (eviction hole)")
+        if not bool(self.cache.valid[req.slot, :committed].all()):
+            return self._export_refuse(
+                f"request {rid}: uncomputed committed columns")
+        ids = np.zeros((geom.pages_per_slot,), np.int32)
+        ids[:needed] = req.pages[:needed]
+        with annotate("serve_kv_export"):
+            k_payload, v_payload = self._export_kv(
+                self.cache.k_pages, self.cache.v_pages, self._dev(ids))
+        return MigrationTicket(
+            rid=req.rid,
+            prompt_tokens=list(req.prompt_tokens),
+            max_new_tokens=req.max_new_tokens,
+            generated=list(req.generated),
+            generated_logprobs=list(req.generated_logprobs),
+            sampling=req.sampling,
+            arrival_time=req.arrival_time,
+            deadline=req.deadline,
+            priority=req.priority,
+            committed_len=committed,
+            page_size=self.cfg.page_size,
+            n_pages=needed,
+            k_payload=k_payload,
+            v_payload=v_payload,
+            admitted_time=req.admitted_time,
+            first_token_time=req.first_token_time,
+            last_token_time=req.last_token_time)
+
+    def _export_refuse(self, msg: str):
+        self._mig_stats["failed_migrations"] += 1
+        raise MigrationError(msg)
+
+    def import_request(self, ticket: MigrationTicket) -> Request:
+        """Install a migrated request (the install half of the KV
+        handoff): allocate pages, scatter the payload in ONE jitted
+        fixed-shape call, register the committed FULL pages into the
+        prefix cache (tail columns of a partial page stay private), and
+        resume decode mid-stream — the request decodes on the very next
+        engine step, bit-identically to never having moved.
+
+        The source clocks ride the ticket, so TTFT is never re-recorded
+        and the first post-handoff ITL sample honestly includes the
+        handoff wait (also recorded on
+        ``serving/migration/handoff_wait_ms``). Refuses geometry
+        mismatches, window overflows, slot/page exhaustion
+        (``MigrationError``, counted on failed_migrations) — the caller
+        keeps the source copy running."""
+        t_start = self.now()
+        if ticket.page_size != self.cfg.page_size:
+            return self._import_refuse(
+                f"page_size mismatch: ticket {ticket.page_size}, "
+                f"engine {self.cfg.page_size}")
+        if not ticket.generated:
+            return self._import_refuse(
+                f"ticket {ticket.rid} carries no generated tokens")
+        committed = len(ticket.prompt_tokens) + len(ticket.generated) - 1
+        if committed != ticket.committed_len:
+            return self._import_refuse(
+                f"ticket {ticket.rid}: committed_len "
+                f"{ticket.committed_len} != prefix-1 ({committed})")
+        geom = self.cache.geom
+        needed = geom.pages_for(committed)
+        if ticket.n_pages != needed:
+            return self._import_refuse(
+                f"ticket {ticket.rid}: n_pages {ticket.n_pages} != "
+                f"{needed} for {committed} committed columns")
+        kshape = tuple(getattr(ticket.k_payload, "shape", ()))
+        if len(kshape) < 2 or kshape[1] != geom.pages_per_slot:
+            return self._import_refuse(
+                f"ticket {ticket.rid}: payload geometry {kshape} does "
+                f"not match pages_per_slot {geom.pages_per_slot}")
+        if len(ticket.prompt_tokens) + ticket.max_new_tokens \
+                > geom.slot_window:
+            return self._import_refuse(
+                f"ticket {ticket.rid} cannot fit the slot window "
+                f"({geom.slot_window})")
+        if not self.scheduler.free_slots \
+                or self.scheduler._admission_headroom() == 0:
+            return self._import_refuse(
+                f"ticket {ticket.rid}: no free decode slot")
+        n_alloc = min(needed + self.cfg.decode_reserve_pages,
+                      geom.pages_per_slot)
+        pages = self.cache.allocator.alloc(n_alloc)
+        if pages is None:
+            return self._import_refuse(
+                f"ticket {ticket.rid}: page pool cannot supply "
+                f"{n_alloc} pages")
+        ids = np.zeros((geom.pages_per_slot,), np.int32)
+        ids[:needed] = pages[:needed]
+        with annotate("serve_kv_import"):
+            self.cache.k_pages, self.cache.v_pages = self._import_kv(
+                self.cache.k_pages, self.cache.v_pages,
+                ticket.k_payload, ticket.v_payload, self._dev(ids))
+        req = Request(prompt_tokens=list(ticket.prompt_tokens),
+                      max_new_tokens=int(ticket.max_new_tokens),
+                      arrival_time=ticket.arrival_time,
+                      priority=int(ticket.priority),
+                      sampling=ticket.sampling)
+        req.rid = ticket.rid
+        req.deadline = ticket.deadline
+        req.generated = list(ticket.generated)
+        req.generated_logprobs = list(ticket.generated_logprobs)
+        req.admitted_time = ticket.admitted_time
+        req.first_token_time = ticket.first_token_time
+        req.last_token_time = ticket.last_token_time
+        self._adopt_committed(req, pages, committed)
+        if self.prefix_cache is not None:
+            # index the committed FULL pages so later identical prompts
+            # (and future migrations back) alias them; no logits entry —
+            # the request resumes decode, there are no prefill logits
+            self.prefix_cache.register(
+                req.prefix_tokens[:committed], pages)
+        self._results[req.rid] = req
+        self._mig_stats["migrations"] += 1
+        self._mig_stats["migrated_pages"] += needed
+        if ticket.transport == "host":
+            self._mig_stats["host_bounce_bytes"] += ticket.payload_bytes
+        if ticket.last_token_time is not None:
+            self.metrics.handoff_wait_ms.record(
+                (t_start - ticket.last_token_time) * 1000.0)
+        if self.tracer.enabled:
+            self.tracer.async_begin(
+                "request", "request", req.rid, t=req.arrival_time,
+                prompt_tokens=len(req.prompt_tokens),
+                max_new_tokens=req.max_new_tokens)
+        return req
+
+    def _import_refuse(self, msg: str):
+        self._mig_stats["failed_migrations"] += 1
+        raise MigrationError(msg)
+
+    def release_migrated(self, rid: int) -> None:
+        """Drop the SOURCE copy of a request that a target engine has
+        successfully imported: free its slot and page references and
+        forget it from the result surface (its live state — and final
+        result — now belong to the target). Called only after the
+        install committed, so the request exists on exactly one engine
+        at every step boundary."""
+        req = self._results.pop(rid, None)
+        if req is None:
+            return
+        if req.state is RequestState.DECODE:
+            self.scheduler.cancel(req, "migrated")
+        if self.tracer.enabled:
+            self.tracer.async_end("request", "request", req.rid,
+                                  status="migrated",
+                                  tokens=len(req.generated))
 
     def has_work(self) -> bool:
         return bool(self.scheduler.queue or self.scheduler.running
@@ -810,6 +1116,8 @@ class ServingEngine:
             if self._spec_k:
                 self._spec_draft.step = self.engine_steps
                 self._spec_verify.step = self.engine_steps
+            self._export_kv.step = self.engine_steps
+            self._import_kv.step = self.engine_steps
         emitted: List[Tuple[int, int]] = []
         # a speculative round may COMMIT up to K+1 columns per slot, so
         # page headroom / copy-on-write cover the whole write span
@@ -849,6 +1157,7 @@ class ServingEngine:
             self.anomaly.on_step(self.engine_steps)
         self._mirror_cache_counters()
         self._mirror_spec_counters()
+        self._mirror_migration_counters()
         m = self.metrics
         m.queue_depth.set(self.scheduler.queue_depth)
         m.active_requests.set(self.scheduler.active_count)
@@ -1439,6 +1748,22 @@ class ServingEngine:
         if m.spec_proposed.value > 0:
             m.spec_acceptance_rate.set(
                 m.spec_accepted.value / m.spec_proposed.value)
+
+    def _mirror_migration_counters(self) -> None:
+        """Delta-mirror the KV migration stats into the registry (the
+        prefix-cache/speculative mirror contract: a fresh ServingMetrics
+        swap sees only post-swap activity; the Supervisor re-seeds
+        cumulative totals into rebuilt engines so the counters stay
+        monotone across restarts)."""
+        m, s, seen = self.metrics, self._mig_stats, self._mig_mirrored
+        m.migrations.inc(s["migrations"] - seen["migrations"])
+        m.migrated_pages.inc(
+            s["migrated_pages"] - seen["migrated_pages"])
+        m.host_bounce_bytes.inc(
+            s["host_bounce_bytes"] - seen["host_bounce_bytes"])
+        m.failed_migrations.inc(
+            s["failed_migrations"] - seen["failed_migrations"])
+        seen.update(s)
 
     def _emit(self, req: Request, tok: int, t: float,
               emitted: List[Tuple[int, int]],

@@ -284,6 +284,27 @@ CATALOG: Tuple[MetricSpec, ...] = (
     _s("serving/fleet/rebalanced_requests", "counter", "requests",
        "queued requests moved to a peer member during scale-down "
        "(rid/sampling/streamed state preserved)", "step"),
+    # -- KV page migration (serving.migration): the prefill/decode
+    #    disaggregation handoff. Counters are engine-side, delta-
+    #    mirrored (speculative-counter idiom) so totals stay monotone
+    #    across supervisor rebuilds; export failures land on the source
+    #    engine, everything else on the target.
+    _s("serving/migration/migrations", "counter", "requests",
+       "requests installed via KV page migration (import_request)",
+       "step"),
+    _s("serving/migration/migrated_pages", "counter", "pages",
+       "committed KV pages scattered into target pools", "step"),
+    _s("serving/migration/host_bounce_bytes", "counter", "bytes",
+       "migration payload bytes that took the host-bounce transport "
+       "(0 on device-to-device handoffs)", "step"),
+    _s("serving/migration/failed_migrations", "counter", "requests",
+       "refused/failed exports and imports (eviction holes, geometry "
+       "mismatches, slot/page exhaustion); the request keeps running "
+       "on its source engine", "step"),
+    _s("serving/migration/handoff_wait_ms", "histogram", "ms",
+       "source's last emitted token -> target install (the stream gap "
+       "a migrated request's first post-handoff ITL sample includes)",
+       "step"),
     # -- RLHF rollout subsystem (dla_tpu/rollout): serving-backed
     #    generation for train_rlhf (docs/RLHF.md)
     _s("rollout/rollouts", "counter", "rollouts",

@@ -522,6 +522,30 @@ class FleetSchema:
     patience: Any = None
     check_every: Any = None
     seed: Any = None
+    roles: Any = None
+    migration_transport: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DisaggSchema:
+    """eval_latency --disagg A/B/C: single chunked engine vs a mixed
+    co-scheduled fleet vs a role-split prefill/decode fleet of the same
+    size, all replaying the SAME long-prompt Poisson trace."""
+    enabled: Any = None
+    prefill_engines: Any = None
+    decode_engines: Any = None
+    num_requests: Any = None
+    arrival_rate: Any = None
+    prompt_len: Any = None
+    new_tokens: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrationSchema:
+    """serving.migration.MigrationConfig: KV-page handoff transport for
+    the disaggregated fleet (auto / device / host)."""
+    enabled: Any = None
+    transport: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -547,6 +571,8 @@ class ServingLatencySchema:
     overload: Optional[OverloadSchema] = None
     speculative: Optional[SpeculativeSchema] = None
     fleet: Optional[FleetSchema] = None
+    disagg: Optional[DisaggSchema] = None
+    migration: Optional[MigrationSchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

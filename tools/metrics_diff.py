@@ -48,7 +48,8 @@ LOWER_IS_BETTER = ("_ms", "latency", "stall", "badput", "overhead",
 HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
                     "samples_per_sec", "_per_second", "saved_frac",
                     "hit_rate", "tokens_per_s", "padding_waste_recovered",
-                    "acceptance_rate", "speedup", "retention", "scaling")
+                    "acceptance_rate", "speedup", "retention", "scaling",
+                    "pages_per_s")
 
 
 def direction(name: str) -> int:
