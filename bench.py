@@ -1257,6 +1257,140 @@ def run_resilience_bench() -> dict:
     }
 
 
+def run_elastic_resilience_bench() -> dict:
+    """Host-loss recovery bench for the elastic gang
+    (dla_tpu/resilience/elastic): a simulated 8-host pod loses host 1
+    mid-run (fault plan ``host=1:step=6:lost``), the gang detects the
+    stale lease within ``lease_ttl_steps``, exits resumably, and the
+    run resumes on a 4-device mesh from the latest checkpoint with the
+    global batch preserved (grad accum recomputed). Reports:
+
+      - steps replayed — detection step minus the resumed-from step
+        (work re-done because the outage landed between saves)
+      - detection lag — steps from the injected loss to the agreed
+        shrink (bounded by lease_ttl_steps)
+      - elastic badput — the detect -> restart -> resume gap as the
+        resumed run's ``telemetry/badput_elastic`` fraction
+
+    Deterministic, CPU-sized, in-process (no tunnel involved)."""
+    import shutil as _shutil
+    import tempfile
+
+    import jax
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.ops.fused_ce import model_fused_ce
+    from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dla_tpu.resilience import ElasticRestart
+    from dla_tpu.training.trainer import Trainer
+
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=64, remat="none", dtype="float32",
+        param_dtype="float32")
+    seq, max_steps, save_every = 64, 12, 4
+    lease_ttl_steps, fault_step, lost_host = 3, 5, 1
+    devices = jax.devices()
+    if len(devices) < 8:
+        return {"metric": "elastic_steps_replayed",
+                "error": f"needs 8 CPU devices, have {len(devices)}"}
+    mesh8 = build_mesh(MeshConfig(data=1, fsdp=8, model=1, sequence=1),
+                       devices=devices[:8])
+    mesh4 = build_mesh(MeshConfig(data=1, fsdp=4, model=1, sequence=1),
+                       devices=devices[:4])
+    model = Transformer(cfg)
+
+    def loss_fn(p, frozen, batch, rng):
+        del frozen, rng
+        loss, _ = model_fused_ce(model, p, batch)
+        return loss, {}
+
+    def batches():
+        rs = np.random.RandomState(0)
+        while True:
+            yield {
+                "input_ids": rs.randint(1, cfg.vocab_size, (8, seq)
+                                        ).astype(np.int32),
+                "attention_mask": np.ones((8, seq), np.int32),
+                "labels": rs.randint(1, cfg.vocab_size, (8, seq)
+                                     ).astype(np.int32),
+            }
+
+    def make_config(out_dir, world, fault_plan=""):
+        return {
+            "experiment_name": "bench_elastic",
+            "optimization": {
+                "total_batch_size": 8, "micro_batch_size": 1,
+                "learning_rate": 1e-4, "max_train_steps": max_steps,
+                "lr_scheduler": "constant", "max_grad_norm": 1.0,
+            },
+            "data": {"prefetch": 0},
+            "logging": {"output_dir": out_dir, "log_dir": None,
+                        "save_every_steps": save_every,
+                        "log_every_steps": 10 ** 6},
+            "hardware": {"gradient_accumulation_steps": 1},
+            "resilience": {
+                "fault_plan": fault_plan,
+                "elastic": {"enabled": True, "lease_ttl_s": 0,
+                            "lease_ttl_steps": lease_ttl_steps,
+                            "sim_world": world},
+            },
+        }
+
+    out_dir = tempfile.mkdtemp(prefix="dla_bench_elastic_")
+    try:
+        fault_plan = f"host={lost_host}:step={fault_step}:lost"
+        with jax.sharding.set_mesh(mesh8):
+            trainer = Trainer(
+                config=make_config(out_dir, 8, fault_plan), mesh=mesh8,
+                loss_fn=loss_fn, params=model.init(jax.random.key(0)),
+                param_specs=model.partition_specs())
+            detect_step = None
+            try:
+                trainer.fit(batches(), rng=jax.random.key(1))
+            except ElasticRestart as exc:
+                detect_step = exc.step
+        if detect_step is None:
+            return {"metric": "elastic_steps_replayed",
+                    "error": "host loss was never detected"}
+        with jax.sharding.set_mesh(mesh4):
+            resumed = Trainer(
+                config=make_config(out_dir, 4), mesh=mesh4,
+                loss_fn=loss_fn, params=model.init(jax.random.key(0)),
+                param_specs=model.partition_specs())
+            resumed.fit(batches(), rng=jax.random.key(1), resume=True)
+            resume_step = None
+            for ev in resumed.recorder.events:
+                if ev["kind"] == "elastic_resume":
+                    resume_step = ev["step"]
+            badput = resumed.clock.badput()["elastic"]
+            final_step = resumed.step
+    finally:
+        _shutil.rmtree(out_dir, ignore_errors=True)
+
+    replayed = detect_step - (resume_step or 0)
+    return {
+        "metric": "elastic_steps_replayed",
+        "value": int(replayed),
+        "unit": "steps",
+        # a full save interval is the worst case for an outage landing
+        # right before a save; <1.0 means detection beat the cadence
+        "vs_baseline": round(replayed / save_every, 4),
+        "detail": {
+            "detect_step": int(detect_step),
+            "resumed_from_step": int(resume_step or 0),
+            "detection_lag_steps": int(detect_step - fault_step),
+            "lease_ttl_steps": int(lease_ttl_steps),
+            "badput_elastic": round(float(badput), 6),
+            "final_step": int(final_step),
+            "target_steps": int(max_steps),
+            "train_step_compiles": int(resumed.train_step_compiles),
+            "fault_plan": fault_plan,
+        },
+    }
+
+
 def run_telemetry_bench() -> dict:
     """Telemetry-overhead microbench (dla_tpu/telemetry): the same tiny
     SFT run twice — telemetry on (step clock + in-graph collector +
@@ -1538,7 +1672,8 @@ def _emit_and_maybe_extra() -> None:
     extra = [headline]
     for fn in (run_ppo_bench, run_decode_bench, run_serving_bench,
                run_serving_prefix_bench, run_serving_spec_bench,
-               run_serving_fleet_bench, run_serving_disagg_bench):
+               run_serving_fleet_bench, run_serving_disagg_bench,
+               run_elastic_resilience_bench):
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — extras must not kill the line
@@ -1577,6 +1712,13 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_resilience_bench()))
+        return 0
+    if "elastic-resilience" in sys.argv[1:]:
+        # host-loss chaos target: simulated 8-host gang loses a host and
+        # resumes at 4 devices; needs the 8-device virtual CPU mesh
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform(8)
+        print(json.dumps(run_elastic_resilience_bench()))
         return 0
     if "rollout" in sys.argv[1:]:
         # disaggregated-rollout A/B target: same in-process forced-CPU

@@ -314,6 +314,22 @@ class Checkpointer:
         steps = self.step_tags()
         return steps[-1] if steps else None
 
+    def peek_aux(self, tag: Optional[str] = None) -> Dict[str, Any]:
+        """Read a checkpoint's aux dict without touching any tensor data —
+        the cheap pre-restore peek entry points use to size data iterators
+        to the SAVED global batch before ``Trainer.try_resume`` adopts it
+        (a topology-shift resume must see full-size batches from its
+        first step). Returns {} when nothing restorable exists."""
+        tag = tag or self.latest_tag()
+        if tag is None:
+            return {}
+        try:
+            idx = resolve_checkpoint_dir(self.dir / tag) / "index.json"
+            with idx.open() as fh:
+                return json.load(fh).get("aux", {})
+        except (OSError, ValueError):
+            return {}
+
     def restore(self, template: Any, tag: Optional[str] = None,
                 shardings: Optional[Any] = None
                 ) -> Tuple[Any, Dict[str, Any]]:

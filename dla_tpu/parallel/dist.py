@@ -11,11 +11,94 @@ us.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 
 _INITIALIZED = False
+
+# Default collective deadline (seconds) + suspect resolver, armed by the
+# elastic GangMonitor: a hung collective then surfaces as a typed
+# CollectiveTimeout naming the stale rank(s) instead of 40 identical
+# stuck stacks. None = unbounded (the pre-elastic behavior).
+_DEADLINE_S: Optional[float] = None
+_SUSPECTS: Optional[Callable[[], Sequence[int]]] = None
+
+
+class CollectiveTimeout(RuntimeError):
+    """A cross-host collective exceeded its deadline — some peer never
+    arrived. ``suspects`` carries the rank(s) whose heartbeat lease was
+    stale when the deadline fired (empty when no resolver is armed)."""
+
+    def __init__(self, name: str, deadline_s: float,
+                 suspects: Sequence[int] = ()):
+        self.name = name
+        self.deadline_s = float(deadline_s)
+        self.suspects = tuple(suspects)
+        sus = (f"; suspect rank(s): {list(self.suspects)}"
+               if self.suspects else "")
+        super().__init__(
+            f"collective {name!r} exceeded its {self.deadline_s:.1f}s "
+            f"deadline{sus}")
+
+
+def set_collective_deadline(
+        seconds: Optional[float],
+        suspects: Optional[Callable[[], Sequence[int]]] = None) -> None:
+    """Arm a default deadline for :func:`barrier` / :func:`allgather_floats`
+    (the elastic path ties it to the gang lease TTL). ``suspects`` is a
+    zero-arg callable returning the currently-stale ranks — typically
+    ``GangMonitor.stale_ranks`` — consulted only when a timeout fires.
+    ``None`` seconds disarms."""
+    global _DEADLINE_S, _SUSPECTS
+    # dla: disable=host-sync-in-hot-loop -- config scalar coercion; armed once at fit entry, not per step
+    _DEADLINE_S = float(seconds) if seconds else None
+    _SUSPECTS = suspects
+
+
+def clear_collective_deadline() -> None:
+    set_collective_deadline(None, None)
+
+
+def _resolve_suspects() -> Sequence[int]:
+    if _SUSPECTS is None:
+        return ()
+    try:
+        return tuple(_SUSPECTS())
+    except Exception:  # noqa: BLE001 — attribution must not mask the timeout
+        return ()
+
+
+def _run_with_deadline(fn: Callable[[], Any], name: str,
+                       deadline_s: float) -> Any:
+    """Run a (potentially hanging) collective under a wall-clock bound.
+
+    The collective runs on a daemon worker thread; on timeout the thread
+    is abandoned — a hung rendezvous cannot be cancelled, only orphaned —
+    and :class:`CollectiveTimeout` raises on the caller with the suspect
+    ranks resolved at that instant. The caller is expected to exit the
+    process (ElasticRestart), so the orphan never outlives the run."""
+    out: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def _call() -> None:
+        try:
+            out["value"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised on caller
+            out["error"] = exc
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_call, name=f"dla-collective-{name}",
+                         daemon=True)
+    t.start()
+    if not done.wait(deadline_s):
+        raise CollectiveTimeout(name, deadline_s, _resolve_suspects())
+    t.join()
+    if "error" in out:
+        raise out["error"]
+    return out.get("value")
 
 
 def initialize_distributed(hardware_cfg: Optional[Dict[str, Any]] = None) -> None:
@@ -68,26 +151,43 @@ def log_main(*args: Any) -> None:
         print(*args, flush=True)
 
 
-def barrier(name: str = "barrier") -> None:
+def barrier(name: str = "barrier",
+            deadline_s: Optional[float] = None) -> None:
     """Cross-host barrier (reference: accelerator.wait_for_everyone,
-    train_rlhf.py:164)."""
+    train_rlhf.py:164). ``deadline_s`` (or the armed module default)
+    bounds the rendezvous: past it, :class:`CollectiveTimeout` raises
+    with the stale rank(s) attributed instead of hanging forever."""
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    deadline = deadline_s if deadline_s is not None else _DEADLINE_S
+    if deadline:
+        _run_with_deadline(
+            lambda: multihost_utils.sync_global_devices(name),
+            name, deadline)
+    else:
+        multihost_utils.sync_global_devices(name)
 
 
-def allgather_floats(row) -> "np.ndarray":
+def allgather_floats(row, deadline_s: Optional[float] = None) -> "np.ndarray":
     """Gather one small float row from every host: [k] -> [hosts, k].
 
     The telemetry aggregation path (telemetry.aggregate) rides this at
     log cadence; it is a rendezvous, so every host must call it at the
     same point. Single-process returns the row as [1, k] with no
-    collective at all.
+    collective at all. ``deadline_s`` (or the armed module default)
+    bounds the rendezvous like :func:`barrier`.
     """
     import numpy as np
     arr = np.asarray(row, dtype=np.float64)
     if jax.process_count() == 1:
         return arr[None, :]
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(arr))
+
+    def _gather() -> "np.ndarray":
+        return np.asarray(multihost_utils.process_allgather(arr))
+
+    deadline = deadline_s if deadline_s is not None else _DEADLINE_S
+    if deadline:
+        return _run_with_deadline(_gather, "allgather_floats", deadline)
+    return _gather()

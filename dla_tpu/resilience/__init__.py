@@ -14,6 +14,12 @@ import os
 from typing import Any, Dict, Optional
 
 from dla_tpu.resilience.async_checkpoint import AsyncCheckpointer
+from dla_tpu.resilience.elastic import (
+    ElasticConfig,
+    ElasticRestart,
+    GangMonitor,
+    ShrinkDecision,
+)
 from dla_tpu.resilience.faults import ENV_VAR, Fault, FaultPlan
 from dla_tpu.resilience.guard import (
     GuardConfig,
@@ -32,8 +38,11 @@ from dla_tpu.resilience.watchdog import Watchdog, format_all_stacks
 __all__ = [
     "AsyncCheckpointer",
     "ENV_VAR",
+    "ElasticConfig",
+    "ElasticRestart",
     "Fault",
     "FaultPlan",
+    "GangMonitor",
     "GuardConfig",
     "GuardState",
     "PreemptionExit",
@@ -42,6 +51,7 @@ __all__ = [
     "RETRY",
     "ROLLBACK",
     "SKIP",
+    "ShrinkDecision",
     "Watchdog",
     "format_all_stacks",
     "install_sigterm_flag",
@@ -63,6 +73,7 @@ class ResilienceConfig:
     watchdog_enabled: bool = False
     watchdog_timeout_s: float = 1800.0
     fault_plan: FaultPlan = dataclasses.field(default_factory=FaultPlan)
+    elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
 
     @classmethod
     def from_config(cls, cfg: Optional[Dict[str, Any]]) -> "ResilienceConfig":
@@ -79,4 +90,5 @@ class ResilienceConfig:
             watchdog_enabled=bool(wd.get("enabled", False)),
             watchdog_timeout_s=float(wd.get("timeout_s", 1800.0)),
             fault_plan=FaultPlan.parse(spec),
+            elastic=ElasticConfig.from_config(cfg.get("elastic")),
         )

@@ -83,6 +83,11 @@ class AsyncCheckpointer(Checkpointer):
         self.retries_total = 0
         self.last_stall_ms = 0.0
         self.total_stall_ms = 0.0
+        # flaky-FS visibility (resilience/ckpt_retries + _last_error_age_s
+        # FuncGauges): written from the writer thread, read at scrape
+        # cadence — two plain attribute stores, atomic under the GIL
+        self.last_error: Optional[str] = None
+        self.last_error_time: Optional[float] = None
 
     # ------------------------------------------------------------------ api
 
@@ -125,6 +130,14 @@ class AsyncCheckpointer(Checkpointer):
     @property
     def in_flight(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
+
+    def last_error_age_s(self) -> float:
+        """Seconds since the most recent write ``OSError``; -1 when the
+        writer has never failed (the gauge-friendly sentinel — a flaky
+        FS shows up as a small, churning age)."""
+        if self.last_error_time is None:
+            return -1.0
+        return time.monotonic() - self.last_error_time
 
     # --------------------------------------------------------------- writer
 
@@ -177,6 +190,8 @@ class AsyncCheckpointer(Checkpointer):
                 attempt()
                 return
             except OSError as exc:
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                self.last_error_time = time.monotonic()
                 if n >= self.max_retries:
                     raise
                 self.retries_total += 1

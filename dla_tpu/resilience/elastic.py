@@ -1,0 +1,355 @@
+"""Elastic pod resilience: gang membership, peer-loss detection, and
+the resumable restart path for topology-shift resume.
+
+One lost host is the pod fault the rest of ``resilience/`` cannot see:
+every healthy peer blocks inside the next collective until the local
+Watchdog SIGABRTs the whole job, and nothing on disk says WHICH host
+died. The :class:`GangMonitor` closes that gap with a heartbeat lease
+per host on the shared checkpoint filesystem — the same GCS/NFS
+assumption the sharded checkpointer already makes — beaten from the
+trainer's step loop:
+
+- **Peer loss vs. local hang.** A peer whose lease stops refreshing is
+  *lost* (the survivors act); a local step loop that stops beating its
+  own lease is a *hang* (the Watchdog acts, as before). Staleness is
+  judged by wall clock (``lease_ttl_s``) and/or step lag
+  (``lease_ttl_steps`` — deterministic, because lockstep collectives
+  keep healthy hosts' steps together; the mode CPU tests use).
+- **Agreement.** Survivors agree on one shrink decision through an
+  epoch-numbered ``membership.json``: the lowest-rank survivor proposes
+  (atomic write-aside + rename, the checkpoint pointer idiom), every
+  other survivor adopts the record it reads. Exactly one decision per
+  epoch, no quorum protocol needed — the proposer is a pure function of
+  the stale set, and a wrong guess only delays the restart by one TTL.
+- **Resumable exit.** The trainer turns a decision into a ``host_lost``
+  flight-recorder postmortem naming the missing rank(s) and raises
+  :class:`ElasticRestart` — ``SystemExit(0)``, the ``PreemptionExit``
+  idiom — so the launcher restarts at the surviving host count and the
+  run resumes from the latest complete checkpoint (no emergency save is
+  attempted: the lost host can never join the save barriers).
+- **Badput accounting.** The membership record carries the lost host's
+  last beat and the decision time; :meth:`consume_restart_gap` (called
+  once by the resumed trainer) turns the full detect → restart → resume
+  gap into the StepClock's ``elastic`` badput category.
+
+Chaos testing rides the fault plan's ``host=H:step=N:lost|slow`` scope
+(resilience.faults): in **simulated-pod mode** (``sim=True``) one CPU
+process beats leases for a whole imaginary gang and the plan entries
+kill or lag individual "hosts" deterministically — how the acceptance
+test drives an 8-host loss → 4-host resume without 8 processes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from dla_tpu.resilience.faults import FaultPlan
+
+MEMBERSHIP_FILE = "membership.json"
+
+
+class ElasticRestart(SystemExit):
+    """Raised by the trainer once the gang agreed to shrink (or a
+    collective timed out with the gang armed).
+
+    ``SystemExit`` with code 0: to the launcher this is a clean,
+    resumable exit — restart at the surviving host count with
+    ``--resume`` and the run continues from the latest checkpoint."""
+
+    def __init__(self, step: int, epoch: int = 0,
+                 survivors: Tuple[int, ...] = (),
+                 lost: Tuple[int, ...] = ()):
+        super().__init__(0)
+        self.step = int(step)
+        self.epoch = int(epoch)
+        self.survivors = tuple(survivors)
+        self.lost = tuple(lost)
+
+    def __str__(self) -> str:
+        return (f"elastic restart @ step {self.step}: lost host(s) "
+                f"{list(self.lost)}, surviving {list(self.survivors)} "
+                f"(membership epoch {self.epoch})")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShrinkDecision:
+    """One agreed membership transition (decoded ``membership.json``)."""
+    epoch: int
+    survivors: Tuple[int, ...]
+    lost: Tuple[int, ...]
+    step: int                  # proposer's step when it decided
+    decided_by: int
+    lost_last_beat: float      # oldest last-beat wall time among lost
+    decided_time: float
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    """Parsed ``resilience.elastic:`` block."""
+    enabled: bool = False
+    lease_ttl_s: float = 60.0      # wall-clock lease expiry
+    lease_ttl_steps: int = 0       # >0: step-lag staleness (deterministic)
+    gang_dir: Optional[str] = None  # default: <output_dir>/gang
+    sim_world: int = 0             # >0: simulate an N-host gang in-process
+    collective_deadline_s: float = 0.0  # 0 -> lease_ttl_s
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]]) -> "ElasticConfig":
+        cfg = cfg or {}
+        return cls(
+            enabled=bool(cfg.get("enabled", False)),
+            lease_ttl_s=float(cfg.get("lease_ttl_s", 60.0)),
+            lease_ttl_steps=int(cfg.get("lease_ttl_steps", 0)),
+            gang_dir=cfg.get("gang_dir"),
+            sim_world=int(cfg.get("sim_world", 0)),
+            collective_deadline_s=float(
+                cfg.get("collective_deadline_s", 0.0)),
+        )
+
+
+class GangMonitor:
+    """Per-host heartbeat lease + lowest-rank-survivor shrink protocol.
+
+    ``beat(step)`` refreshes this host's lease (and, in sim mode, every
+    simulated peer's); ``check(step)`` returns a :class:`ShrinkDecision`
+    once peer loss is detected and agreed, else None. Lease files carry
+    the membership epoch, so leases from before a restart never count
+    against the shrunken gang.
+
+    >>> gang = GangMonitor(dir, rank=jax.process_index(),
+    ...                    world=jax.process_count(), lease_ttl_s=60)
+    >>> gang.beat(step); d = gang.check(step)
+    >>> if d: raise ElasticRestart(step, d.epoch, d.survivors, d.lost)
+    """
+
+    def __init__(self, gang_dir, rank: int, world: int, *,
+                 lease_ttl_s: float = 60.0, lease_ttl_steps: int = 0,
+                 faults: Optional[FaultPlan] = None, recorder=None,
+                 sim: bool = False, now=time.time):
+        self.dir = Path(gang_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.lease_ttl_steps = int(lease_ttl_steps)
+        self.faults = faults or FaultPlan()
+        self.recorder = recorder     # telemetry.FlightRecorder (optional)
+        self.sim = bool(sim)
+        self.now = now
+        self._t0 = now()             # startup grace for never-seen peers
+        self.decision: Optional[ShrinkDecision] = None
+        # simulated-pod state: which imaginary hosts died / lag
+        self._sim_lost: set = set()
+        self._sim_lag: Dict[int, int] = {}
+        self._slow_reported: set = set()
+        rec = self._read_membership()
+        # adopt a prior epoch's survivor set only when it was consumed
+        # (resumed=True): an UNconsumed record belongs to the restart we
+        # are the resumed process of, and consume_restart_gap() owns it
+        self.epoch = int(rec["epoch"]) if rec else 0
+        self.members: Tuple[int, ...] = tuple(range(self.world))
+
+    # -------------------------------------------------------------- leases
+
+    def _lease_path(self, rank: int) -> Path:
+        return self.dir / f"lease_{rank:04d}.json"
+
+    def _write_json(self, path: Path, doc: Dict[str, Any]) -> None:
+        # write-aside + atomic rename (the `latest` pointer idiom): a
+        # crash mid-write can never leave a truncated lease/record
+        tmp = path.with_name(path.name + f".tmp{self.rank}")
+        tmp.write_text(json.dumps(doc))
+        os.replace(tmp, path)
+
+    def _read_json(self, path: Path) -> Optional[Dict[str, Any]]:
+        try:
+            return json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None              # missing or mid-replace: treat as absent
+
+    def read_lease(self, rank: int) -> Optional[Dict[str, Any]]:
+        doc = self._read_json(self._lease_path(rank))
+        if doc is None or int(doc.get("epoch", 0)) != self.epoch:
+            return None              # a pre-restart lease proves nothing
+        return doc
+
+    def beat(self, step: int) -> None:
+        """Refresh this host's lease from the step loop; in sim mode,
+        also beat every simulated peer that the fault plan has not
+        killed (and lag the ones it marked slow)."""
+        if self.sim:
+            self._poll_sim_faults(step)
+        self._write_lease(self.rank, step)
+        if self.sim:
+            for r in self.members:
+                if r == self.rank or r in self._sim_lost:
+                    continue
+                self._write_lease(r, step - self._sim_lag.get(r, 0))
+
+    def _write_lease(self, rank: int, step: int) -> None:
+        self._write_json(self._lease_path(rank), {
+            "rank": rank, "step": int(step), "time": self.now(),
+            "epoch": self.epoch})
+
+    def _poll_sim_faults(self, step: int) -> None:
+        while True:
+            f = self.faults.take("lost", step, site="host")
+            if f is None:
+                break
+            if f.host is None or int(f.host) == self.rank:
+                continue             # cannot lose the simulating host
+            self._sim_lost.add(int(f.host))
+        while True:
+            f = self.faults.take("slow", step, site="host")
+            if f is None:
+                break
+            if f.host is None or int(f.host) == self.rank:
+                continue
+            lag = int(f.arg) if f.arg is not None else 1
+            self._sim_lag[int(f.host)] = lag
+            self._record("host_slow", step=step, rank=int(f.host),
+                         lag_steps=lag)
+            self._slow_reported.add(int(f.host))
+
+    # ----------------------------------------------------------- staleness
+
+    def stale_ranks(self, step: Optional[int] = None) -> List[int]:
+        """Ranks whose lease has expired — the collective-timeout
+        suspect resolver and the shrink trigger. ``step`` enables the
+        step-lag rule; without it only the wall-clock rule applies."""
+        now = self.now()
+        stale: List[int] = []
+        for r in self.members:
+            if r == self.rank:
+                continue
+            lease = self.read_lease(r)
+            if lease is None:
+                # never beaten this epoch: grant startup grace, then the
+                # same TTL rules apply against our own start time
+                ref_t, ref_step = self._t0, 0
+            else:
+                ref_t, ref_step = lease["time"], int(lease["step"])
+            if self.lease_ttl_steps > 0 and step is not None \
+                    and step - ref_step >= self.lease_ttl_steps:
+                stale.append(r)
+            elif self.lease_ttl_s > 0 and now - ref_t > self.lease_ttl_s:
+                stale.append(r)
+        return stale
+
+    def check(self, step: int) -> Optional[ShrinkDecision]:
+        """Detection + agreement, one poll per step boundary. Returns
+        the agreed decision (sticky once made) or None while healthy."""
+        if self.decision is not None:
+            return self.decision
+        # a lower-rank survivor may have decided already — adopt first,
+        # so every survivor reports the SAME epoch/lost set
+        rec = self._read_membership()
+        if rec is not None and int(rec["epoch"]) > self.epoch \
+                and not rec.get("resumed"):
+            if self.rank in rec["survivors"]:
+                self.decision = _decode(rec)
+                self._record_loss(self.decision, step)
+                return self.decision
+        stale = self.stale_ranks(step)
+        if not stale:
+            self._early_warning(step)
+            return None
+        survivors = tuple(r for r in self.members if r not in stale)
+        if self.rank != min(survivors):
+            return None              # the proposer will post; adopt next poll
+        leases = {r: self.read_lease(r) for r in stale}
+        lost_last_beat = min(
+            (l["time"] if l else self._t0) for l in leases.values())
+        decision = ShrinkDecision(
+            epoch=self.epoch + 1, survivors=survivors,
+            lost=tuple(sorted(stale)), step=int(step),
+            decided_by=self.rank, lost_last_beat=lost_last_beat,
+            decided_time=self.now())
+        self._write_json(self.dir / MEMBERSHIP_FILE, {
+            "epoch": decision.epoch, "survivors": list(decision.survivors),
+            "lost": list(decision.lost), "step": decision.step,
+            "decided_by": decision.decided_by,
+            "lost_last_beat": decision.lost_last_beat,
+            "decided_time": decision.decided_time, "resumed": False})
+        self.decision = decision
+        self._record_loss(decision, step)
+        return decision
+
+    def _early_warning(self, step: int) -> None:
+        """One-shot ``host_slow`` event for a peer lagging past half the
+        step TTL but not yet stale (sim mode reports at injection)."""
+        if self.sim or self.lease_ttl_steps < 2:
+            return
+        for r in self.members:
+            if r == self.rank or r in self._slow_reported:
+                continue
+            lease = self.read_lease(r)
+            if lease is None:
+                continue
+            lag = step - int(lease["step"])
+            if lag >= max(1, self.lease_ttl_steps // 2):
+                self._slow_reported.add(r)
+                self._record("host_slow", step=step, rank=r, lag_steps=lag)
+
+    def _record_loss(self, d: ShrinkDecision, step: int) -> None:
+        self._record("host_lost", step=step, lost=list(d.lost),
+                     survivors=list(d.survivors), epoch=d.epoch,
+                     decided_by=d.decided_by,
+                     last_beat_age_s=self.now() - d.lost_last_beat)
+
+    def _record(self, kind: str, **fields) -> None:
+        if self.recorder is not None:
+            step = fields.pop("step", None)
+            self.recorder.record(kind, step=step, **fields)
+
+    # -------------------------------------------------------------- resume
+
+    def _read_membership(self) -> Optional[Dict[str, Any]]:
+        return self._read_json(self.dir / MEMBERSHIP_FILE)
+
+    def consume_restart_gap(self) -> Optional[Dict[str, Any]]:
+        """Called once by the resumed trainer: if an unconsumed shrink
+        record exists, mark it resumed, sweep the previous epoch's
+        leases, and return ``{"gap_s", "epoch", "survivors", "lost",
+        "step"}`` — ``gap_s`` spans the lost host's last beat through
+        now, i.e. the full detect → restart → resume badput. One-shot:
+        a second call (or the other survivors) returns None/no-write."""
+        rec = self._read_membership()
+        if rec is None or rec.get("resumed"):
+            return None
+        resumed_time = self.now()
+        # dla: disable=host-sync-in-hot-loop -- membership.json scalar; runs once per restart, no device fetch
+        gap_s = max(0.0, resumed_time - float(rec["lost_last_beat"]))
+        new_epoch = int(rec["epoch"])
+        survivors = rec.get("survivors") or []
+        if not survivors or self.rank == min(survivors):
+            rec["resumed"] = True
+            rec["resumed_time"] = resumed_time
+            self._write_json(self.dir / MEMBERSHIP_FILE, rec)
+            for p in self.dir.glob("lease_*.json"):
+                doc = self._read_json(p)
+                if doc is None or int(doc.get("epoch", 0)) < new_epoch:
+                    try:
+                        p.unlink()
+                    except OSError:
+                        pass         # a peer swept it first
+        self.epoch = new_epoch
+        self.members = tuple(range(self.world))
+        return {"gap_s": gap_s, "epoch": new_epoch,
+                "survivors": list(rec["survivors"]),
+                "lost": list(rec["lost"]), "step": int(rec["step"])}
+
+
+def _decode(rec: Dict[str, Any]) -> ShrinkDecision:
+    return ShrinkDecision(
+        epoch=int(rec["epoch"]), survivors=tuple(rec["survivors"]),
+        lost=tuple(rec["lost"]), step=int(rec["step"]),
+        decided_by=int(rec["decided_by"]),
+        # dla: disable=host-sync-in-hot-loop -- membership.json scalars; parsed only when a shrink decision exists
+        lost_last_beat=float(rec["lost_last_beat"]),
+        # dla: disable=host-sync-in-hot-loop -- membership.json scalars; parsed only when a shrink decision exists
+        decided_time=float(rec["decided_time"]))

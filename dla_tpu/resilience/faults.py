@@ -71,11 +71,28 @@ wedge           an engine step early in the rollout sleeps ``arg``
                 seconds (default 0.3), tripping the watchdog
 ==============  ===================================================
 
-The three scopes are disjoint: ``take(kind, step)`` only matches
+Gang scope: entries of the form ``host=H:step=N:lost|slow[:arg]`` arm
+against the elastic GangMonitor (resilience.elastic) in its
+CPU-simulated pod mode: host ``H``'s heartbeat lease stops refreshing
+(``lost``) or starts lagging by ``arg`` steps (``slow``, default 1)
+once the monitor's step reaches ``N``. The host id rides the entry's
+``host`` field; the step field keeps the one-shot ``take()`` contract::
+
+    DLA_FAULT_PLAN="host=1:step=6:lost"
+
+==============  ===================================================
+lost            host H's lease is never beaten again -> the
+                survivors' shrink protocol fires within one TTL
+slow            host H's lease step lags by ``arg`` (a one-shot
+                ``host_slow`` flight-recorder event; no restart
+                unless the lag reaches the TTL)
+==============  ===================================================
+
+The four scopes are disjoint: ``take(kind, step)`` only matches
 ``step=`` entries, ``take(kind, step, site="engine_step")`` only
 matches ``engine_step=`` entries, and likewise ``site="rollout_step"``
-— so a co-located trainer, engine, and rollout loop can share one plan
-string.
+and ``site="host"`` — so a co-located trainer, engine, rollout loop,
+and gang monitor can share one plan string.
 """
 from __future__ import annotations
 
@@ -96,8 +113,12 @@ SERVING_KINDS = ("wedge", "device_error", "nan_logits", "burst")
 # engine_step entries so the failure fires mid-rollout
 ROLLOUT_KINDS = ("device_error", "nan_logits", "wedge")
 
+# gang-scoped kinds, legal only in the ``host=H:step=N:<kind>`` form:
+# polled by the elastic GangMonitor's simulated-pod beat
+HOST_KINDS = ("lost", "slow")
+
 _SITE_KINDS = {"step": KNOWN_KINDS, "engine_step": SERVING_KINDS,
-               "rollout_step": ROLLOUT_KINDS}
+               "rollout_step": ROLLOUT_KINDS, "host": HOST_KINDS}
 
 
 @dataclasses.dataclass
@@ -107,7 +128,8 @@ class Fault:
     kind: str
     arg: Optional[float] = None
     fired: bool = False
-    site: str = "step"           # "step" (training) | "engine_step"
+    site: str = "step"           # "step" (training) | "engine_step" | ...
+    host: Optional[int] = None   # which host, for the ``host=`` scope
 
 
 class FaultPlan:
@@ -125,10 +147,12 @@ class FaultPlan:
         return f"FaultPlan({self.spec()!r})"
 
     def spec(self) -> str:
-        return ";".join(
-            f"{f.site}={f.step}:{f.kind}"
-            + ("" if f.arg is None else f":{f.arg:g}")
-            for f in self.entries)
+        def one(f: Fault) -> str:
+            head = (f"host={f.host}:step={f.step}:{f.kind}"
+                    if f.site == "host"
+                    else f"{f.site}={f.step}:{f.kind}")
+            return head + ("" if f.arg is None else f":{f.arg:g}")
+        return ";".join(one(f) for f in self.entries)
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "FaultPlan":
@@ -143,6 +167,26 @@ class FaultPlan:
                 if fields[0].startswith(cand + "="):
                     site = cand
                     break
+            if site == "host":
+                # host=H:step=N:lost|slow[:arg] — the gang scope names
+                # WHICH host on top of the usual step + kind
+                if len(fields) not in (3, 4) \
+                        or not fields[1].strip().startswith("step="):
+                    raise ValueError(
+                        f"bad fault entry {part!r}; expected "
+                        f"'host=<H>:step=<N>:<kind>[:<arg>]' with kind "
+                        f"one of {HOST_KINDS}")
+                kind = fields[2].strip()
+                if kind not in HOST_KINDS:
+                    raise ValueError(
+                        f"unknown fault kind {kind!r} in {part!r}; "
+                        f"known for host=: {HOST_KINDS}")
+                entries.append(Fault(
+                    step=int(fields[1].strip()[len("step="):]),
+                    kind=kind,
+                    arg=float(fields[3]) if len(fields) == 4 else None,
+                    site="host", host=int(fields[0][len("host="):])))
+                continue
             if len(fields) not in (2, 3) or site is None:
                 raise ValueError(
                     f"bad fault entry {part!r}; expected "

@@ -65,7 +65,7 @@ import numpy as np
 
 from dla_tpu.serving.migration import (TRANSPORTS, KVMigrator,
                                        MigrationConfig, MigrationError)
-from dla_tpu.serving.scheduler import TERMINAL_STATES, Request
+from dla_tpu.serving.scheduler import TERMINAL_STATES, Request, RequestState
 from dla_tpu.serving.resilience import Supervisor, SupervisorConfig
 from dla_tpu.telemetry.registry import MetricRegistry
 
@@ -92,7 +92,12 @@ class FleetConfig:
     co-scheduled default. Explicit roles pin the topology, so they are
     mutually exclusive with ``autoscale``. ``migration_transport`` is
     the :class:`~dla_tpu.serving.migration.MigrationConfig` transport
-    the handoff path uses."""
+    the handoff path uses. ``max_handoff_retries`` bounds how many
+    times one request's decode handoff may be refused (page exhaustion,
+    geometry mismatch) before the router gives up on migrating it:
+    the request then finishes decoding on its prefill member, or is
+    shed if that member is draining — never an unbounded
+    refuse/re-insert cycle."""
 
     engines: int = 2                   # members at startup
     min_engines: int = 1
@@ -112,6 +117,7 @@ class FleetConfig:
     seed: int = 0                      # random-placement stream
     roles: Optional[Tuple[str, ...]] = None  # per-slot disaggregation
     migration_transport: str = "auto"  # handoff KV transport
+    max_handoff_retries: int = 8       # refusals before decoding at home
 
     def __post_init__(self):
         if self.placement not in PLACEMENTS:
@@ -125,6 +131,8 @@ class FleetConfig:
         if not (self.min_engines <= self.engines <= self.max_engines):
             raise ValueError(
                 "fleet wants min_engines <= engines <= max_engines")
+        if self.max_handoff_retries < 1:
+            raise ValueError("fleet needs max_handoff_retries >= 1")
         if self.migration_transport not in TRANSPORTS:
             raise ValueError(
                 f"fleet migration_transport must be one of {TRANSPORTS}, "
@@ -188,6 +196,8 @@ class FleetMetrics:
         self.scale_downs = r.counter("serving/fleet/scale_downs")
         self.rebalanced_requests = r.counter(
             "serving/fleet/rebalanced_requests")
+        self.failed_handoffs = r.counter(
+            "serving/migration/failed_handoffs")
         self._slot_gauges: set = set()
 
     def ensure_slot_gauge(self, slot: int,
@@ -287,6 +297,8 @@ class FleetRouter:
         self._placement: Dict[int, _Member] = {}       # rid -> member
         self._affinity: Dict[Tuple[int, ...], int] = {}  # family -> slot
         self._archive: Dict[int, Request] = {}  # results of retired slots
+        self._handoff_fails: Dict[int, int] = {}  # rid -> refusal count
+        self._handoff_pinned: set = set()  # rids decoding at home for good
         self._rs = np.random.RandomState(self.cfg.seed)
         self._rr = 0                   # round-robin cursor
         self._steps = 0
@@ -473,14 +485,34 @@ class FleetRouter:
         """Ship every freshly-prefilled request off prefill-role members
         to the least-pressured decode-capable member. Runs synchronously
         between fleet steps — member faults only surface inside
-        ``engine.step()``, so nothing can interrupt a handoff halfway."""
+        ``engine.step()``, so nothing can interrupt a handoff halfway.
+
+        Refusals (page exhaustion, geometry mismatch) are retried on
+        later passes at most ``max_handoff_retries`` times per request;
+        past the bound the request is pinned to finish decoding on its
+        prefill member (the engine is decode-capable, the role is router
+        policy) — or shed if that member is draining — and
+        ``serving/migration/failed_handoffs`` ticks once."""
         sources = [m for m in self.members() if m.role == "prefill"]
         if not sources:
             return
+        if self._handoff_pinned or self._handoff_fails:
+            # retire bookkeeping only for requests the source scheduler
+            # no longer tracks (terminal): an evicted-but-live request
+            # keeps its refusal count and its pin across re-admission
+            live = {req.rid for m in sources
+                    for req in (*m.engine.scheduler.queue,
+                                *m.engine.scheduler.prefilling.values(),
+                                *m.engine.scheduler.running.values())}
+            self._handoff_pinned &= live
+            self._handoff_fails = {r: c for r, c in
+                                   self._handoff_fails.items() if r in live}
         for src in sources:
             for req in list(src.engine.scheduler.running.values()):
                 if not req.generated:
                     continue           # prefill not finished this step
+                if req.rid in self._handoff_pinned:
+                    continue           # gave up: decoding at home
                 sinks = [m for m in self.members()
                          if m is not src and m.accepting()
                          and m.role != "prefill"]
@@ -491,7 +523,30 @@ class FleetRouter:
                     return             # decode locally; retry next step
                 dst = min(sinks, key=lambda m: (
                     self.member_pressure(m), m.slot))
-                self._migrate_request(src, req, dst)
+                if self._migrate_request(src, req, dst):
+                    self._handoff_fails.pop(req.rid, None)
+                else:
+                    self._note_handoff_failure(src, req)
+
+    def _note_handoff_failure(self, src: _Member, req: Request) -> None:
+        """One refused handoff attempt; enforce the retry bound."""
+        fails = self._handoff_fails.get(req.rid, 0) + 1
+        if fails < self.cfg.max_handoff_retries:
+            self._handoff_fails[req.rid] = fails
+            return
+        self._handoff_fails.pop(req.rid, None)
+        self.metrics.failed_handoffs.inc()
+        if src.accepting():
+            self._handoff_pinned.add(req.rid)
+            return
+        # a draining/retiring source cannot keep the decode: terminal shed
+        # (tokens-so-far preserved on the request, journal entry closed)
+        src.engine.scheduler.cancel(req, "handoff_failed",
+                                    RequestState.SHED)
+        entry = src.sup.journal.get(req.rid)
+        if entry is not None:
+            entry.request = req
+            entry.done = True
 
     def _migrate_request(self, src: _Member, req: Request,
                          dst: _Member) -> bool:

@@ -175,6 +175,9 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "wall fraction lost to failed/retried steps"),
     _s("telemetry/badput_checkpoint", "gauge", "fraction",
        "wall fraction lost to checkpoint stalls"),
+    _s("telemetry/badput_elastic", "gauge", "fraction",
+       "wall fraction lost to host-loss outages (lease expiry through "
+       "topology-shift resume)"),
     _s("telemetry/mfu", "gauge", "fraction",
        "model FLOPs utilization vs chip peak"),
     # -- pod-wide aggregation (telemetry.aggregate; host 0 only)
@@ -301,6 +304,10 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "refused/failed exports and imports (eviction holes, geometry "
        "mismatches, slot/page exhaustion); the request keeps running "
        "on its source engine", "step"),
+    _s("serving/migration/failed_handoffs", "counter", "requests",
+       "decode handoffs abandoned after max_handoff_retries refusals: "
+       "the request finishes decoding on its prefill member (mixed-"
+       "capable) or is shed", "step"),
     _s("serving/migration/handoff_wait_ms", "histogram", "ms",
        "source's last emitted token -> target install (the stream gap "
        "a migrated request's first post-handoff ITL sample includes)",
@@ -348,11 +355,19 @@ CATALOG: Tuple[MetricSpec, ...] = (
     _s("resilience/ckpt_saves_completed", "counter", "saves"),
     _s("resilience/ckpt_io_retries", "counter", "retries",
        "background-writer retry attempts"),
+    _s("resilience/ckpt_retries", "counter", "retries",
+       "checkpoint write retry attempts (alias feed of ckpt_io_retries "
+       "for the flaky-FS triage pair)"),
+    _s("resilience/ckpt_last_error_age_s", "gauge", "s",
+       "seconds since the newest checkpoint write OSError; -1 when the "
+       "writer never failed"),
     _s("resilience/ckpt_stall_ms_total", "counter", "ms",
        "cumulative step-loop checkpoint stall"),
     _s("resilience/guard_bad_steps", "counter", "steps"),
     _s("resilience/guard_rollbacks", "counter", "rollbacks"),
     _s("resilience/preemptions_requested", "counter", "signals"),
+    _s("resilience/elastic_epoch", "gauge", "epoch",
+       "gang membership epoch (bumps once per agreed shrink)"),
 )
 
 #: Dynamic-name families a static check cannot enumerate: any name under

@@ -17,6 +17,10 @@ Badput is broken out by cause so the fix is obvious from the metric:
   injected faults, held-batch replays). Fix: see resilience knobs.
 - ``checkpoint`` — step-loop stall waiting on checkpoint writes. Fix:
   async checkpointing / larger writer backlog.
+- ``elastic``    — wall time lost to a host-loss event: lease-expiry
+  detection through the restart to the topology-shift resume (charged
+  in one piece by the resumed trainer via ``charge_external``). Fix:
+  tighter lease TTL, denser checkpoint cadence.
 
 Usage (the trainer's fit loop)::
 
@@ -44,7 +48,7 @@ from dla_tpu.telemetry.trace import Tracer, get_tracer
 #: attributed), never passed to segment().
 SEGMENTS = ("data_wait", "h2d", "compute", "checkpoint_stall", "logging",
             "eval")
-LOSS_KINDS = ("compile", "fault", "checkpoint")
+LOSS_KINDS = ("compile", "fault", "checkpoint", "elastic")
 
 
 class _NullContext:
@@ -172,6 +176,22 @@ class StepClock:
         self._step_start = None
         self._seg_acc = {}
         self._compile_pending = False
+
+    def charge_external(self, kind: str, seconds: float) -> None:
+        """Attribute wall time that happened OUTSIDE this step loop to
+        one badput kind — the elastic detect → restart → resume gap
+        spans a process exit, so the resumed trainer charges it here in
+        one piece. Extends ``wall_total`` too, so goodput reflects the
+        outage honestly."""
+        if not self.enabled or seconds <= 0.0:
+            return
+        if kind not in LOSS_KINDS:
+            raise ValueError(f"unknown badput kind {kind!r}; "
+                             f"one of {LOSS_KINDS}")
+        # dla: disable=host-sync-in-hot-loop -- caller passes a host wall-clock gap; once per resume, no device fetch
+        self.lost[kind] += float(seconds)
+        # dla: disable=host-sync-in-hot-loop -- caller passes a host wall-clock gap; once per resume, no device fetch
+        self.wall_total += float(seconds)
 
     # --------------------------------------------------------------- exports
 

@@ -601,6 +601,16 @@ class WatchdogSchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class ElasticSchema:
+    enabled: Any = None
+    lease_ttl_s: Any = None
+    lease_ttl_steps: Any = None
+    gang_dir: Any = None
+    sim_world: Any = None
+    collective_deadline_s: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ResilienceSchema:
     async_checkpointing: Any = None
     save_retries: Any = None
@@ -610,6 +620,7 @@ class ResilienceSchema:
     fault_plan: Any = None
     guard: Optional[GuardSchema] = None
     watchdog: Optional[WatchdogSchema] = None
+    elastic: Optional[ElasticSchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

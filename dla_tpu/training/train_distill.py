@@ -194,7 +194,7 @@ def main(argv=None) -> None:
                 f"[dla_tpu] packing: {len(train_ds)} rows, "
                 f"{train_ds.packing_efficiency():.1%} token efficiency")
         train_it = ShardedBatchIterator(
-            train_ds, trainer.global_batch,
+            train_ds, trainer.planned_global_batch(args.resume),
             seed=int(config.get("seed", 0)),
             process_index=jax.process_index(),
             process_count=jax.process_count())

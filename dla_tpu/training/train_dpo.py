@@ -175,7 +175,7 @@ def main(argv=None) -> None:
                 frozen=ref.params, frozen_specs=ref.specs)
 
         train_it = ShardedBatchIterator(
-            train_ds, trainer.global_batch,
+            train_ds, trainer.planned_global_batch(args.resume),
             seed=int(config.get("seed", 0)),
             process_index=jax.process_index(),
             process_count=jax.process_count())

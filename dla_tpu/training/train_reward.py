@@ -151,7 +151,7 @@ def main(argv=None) -> None:
                 params=bundle.params, param_specs=bundle.specs)
 
         train_it = ShardedBatchIterator(
-            train_ds, trainer.global_batch,
+            train_ds, trainer.planned_global_batch(args.resume),
             seed=int(config.get("seed", 0)),
             process_index=jax.process_index(),
             process_count=jax.process_count())

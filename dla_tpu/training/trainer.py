@@ -27,6 +27,7 @@ once. A phase supplies a pure ``loss_fn(params, frozen, batch, rng) ->
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
@@ -38,6 +39,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dla_tpu.checkpoint.checkpointer import Checkpointer
 from dla_tpu.data.prefetch import PrefetchIterator
+from dla_tpu.parallel.dist import (
+    CollectiveTimeout,
+    clear_collective_deadline,
+    set_collective_deadline,
+)
 from dla_tpu.parallel.mesh import data_parallel_size
 from dla_tpu.parallel.sharding import (
     make_global_batch,
@@ -48,6 +54,8 @@ from dla_tpu.resilience import (
     RETRY,
     ROLLBACK,
     AsyncCheckpointer,
+    ElasticRestart,
+    GangMonitor,
     GuardState,
     PreemptionExit,
     PreemptionHandler,
@@ -242,6 +250,27 @@ class Trainer:
         self.watchdog = (Watchdog(self.resilience.watchdog_timeout_s,
                                   recorder=self.recorder)
                          if self.resilience.watchdog_enabled else None)
+        # ---- elastic gang (resilience.elastic): heartbeat leases on the
+        # shared checkpoint FS + lowest-rank-survivor shrink agreement.
+        # sim_world > 0 simulates an N-host gang inside this process (the
+        # CPU chaos-test mode); otherwise rank/world come from jax.
+        el = self.resilience.elastic
+        self.gang: Optional[GangMonitor] = None
+        if el.enabled:
+            self.gang = GangMonitor(
+                el.gang_dir or os.path.join(ckpt_dir, "gang"),
+                rank=jax.process_index(),
+                world=(el.sim_world if el.sim_world > 0
+                       else jax.process_count()),
+                lease_ttl_s=el.lease_ttl_s,
+                lease_ttl_steps=el.lease_ttl_steps,
+                faults=self.resilience.fault_plan,
+                recorder=self.recorder, sim=el.sim_world > 0)
+            # a hung collective now surfaces as CollectiveTimeout with the
+            # stale rank(s) attributed, instead of blocking until SIGABRT
+            set_collective_deadline(
+                el.collective_deadline_s or el.lease_ttl_s,
+                suspects=self.gang.stale_ranks)
         self._register_func_gauges()
         # SLO watch on the same payloads the log loop emits (top-level
         # slo: config block; None without declared objectives)
@@ -288,12 +317,21 @@ class Trainer:
                          lambda: ck.retries_total)
             r.func_gauge("resilience/ckpt_stall_ms_total",
                          lambda: ck.total_stall_ms)
+            # flaky-FS triage pair: how often writes retried, and how
+            # fresh the most recent failure is (-1 = never failed)
+            r.func_gauge("resilience/ckpt_retries",
+                         lambda: ck.retries_total)
+            r.func_gauge("resilience/ckpt_last_error_age_s",
+                         lambda: ck.last_error_age_s())
         r.func_gauge("resilience/guard_bad_steps",
                      lambda: self.guard.bad_steps_total)
         r.func_gauge("resilience/guard_rollbacks",
                      lambda: self.guard.rollbacks)
         r.func_gauge("resilience/preemptions_requested",
                      lambda: self.preemption.requests_total)
+        if self.gang is not None:
+            r.func_gauge("resilience/elastic_epoch",
+                         lambda: self.gang.epoch)
         r.func_gauge("telemetry/trace_events", lambda: self.tracer.emitted)
         r.func_gauge("telemetry/trace_dropped", lambda: self.tracer.dropped)
         if self.xla_introspect_enabled:
@@ -631,6 +669,7 @@ class Trainer:
                 self._poll_host_faults()
                 if self.watchdog is not None:
                     self.watchdog.beat()
+                self._poll_gang()
                 if held is None:
                     # clean step boundary: every consumed batch is
                     # trained, so data_state is exact — the only point a
@@ -724,6 +763,8 @@ class Trainer:
                     self.anomaly.observe("step_ms", self.clock.last_wall_ms,
                                          self.step)
                     self.anomaly.on_step(self.step)
+        except CollectiveTimeout as exc:
+            self._on_collective_timeout(exc)
         finally:
             # a failed step must not lose an already-open trace window
             self.profile.close()
@@ -735,6 +776,8 @@ class Trainer:
                 self.watchdog.stop()
             if self.resilience.preemption:
                 self.preemption.uninstall()
+            if self.gang is not None:
+                clear_collective_deadline()
             if wrapper is not None:
                 wrapper.close()
 
@@ -754,15 +797,57 @@ class Trainer:
         if hang is not None:
             time.sleep(hang.arg if hang.arg is not None else 1.0)
 
+    def _poll_gang(self) -> None:
+        """Beat this host's lease and poll for an agreed shrink. On a
+        decision: postmortem naming the lost rank(s), then the resumable
+        exit. No emergency save is attempted — the lost host can never
+        join the save barriers, so the run resumes from the latest
+        complete checkpoint instead."""
+        if self.gang is None:
+            return
+        self.gang.beat(self.step)
+        decision = self.gang.check(self.step)
+        if decision is None:
+            return
+        log_rank_zero(
+            f"[dla_tpu][elastic] lost host(s) {list(decision.lost)} "
+            f"@ step {self.step}; restarting with "
+            f"{len(decision.survivors)} survivor(s) "
+            f"(membership epoch {decision.epoch})")
+        self.recorder.dump("host_lost")
+        raise ElasticRestart(self.step, decision.epoch,
+                             decision.survivors, decision.lost)
+
+    def _on_collective_timeout(self, exc: CollectiveTimeout) -> None:
+        """A cross-host collective blew its deadline: some peer never
+        arrived. With the gang armed this is the hung twin of lease
+        expiry — same postmortem, same resumable exit; without it the
+        timeout propagates (loud beats hung)."""
+        self.recorder.record(
+            "collective_timeout", step=self.step, name=exc.name,
+            deadline_s=exc.deadline_s, suspects=list(exc.suspects))
+        self.recorder.dump("collective_timeout")
+        if self.gang is None:
+            raise exc
+        lost = tuple(exc.suspects)
+        survivors = tuple(r for r in self.gang.members if r not in lost)
+        log_rank_zero(
+            f"[dla_tpu][elastic] collective {exc.name!r} timed out "
+            f"(suspect rank(s) {list(lost)}); restarting")
+        raise ElasticRestart(self.step, self.gang.epoch + 1,
+                             survivors, lost) from exc
+
     def poll_preemption(self, data_state: Optional[Callable[[], Dict]] = None,
                         extra_aux: Optional[Dict[str, Any]] = None) -> None:
         """For externally-driven loops (the RLHF rollout loop): call at a
         resumable boundary. Fires host fault-plan entries, feeds the
-        watchdog, and, on an agreed preemption, writes the emergency
+        watchdog and the gang lease (raising ElasticRestart on an agreed
+        shrink), and, on an agreed preemption, writes the emergency
         checkpoint and raises PreemptionExit."""
         self._poll_host_faults()
         if self.watchdog is not None:
             self.watchdog.beat()
+        self._poll_gang()
         if self.preemption.should_checkpoint(self.step):
             self._emergency_save(data_state, extra_aux)
 
@@ -870,6 +955,10 @@ class Trainer:
              extra_aux: Optional[Dict[str, Any]] = None,
              tag: Optional[str] = None) -> None:
         aux = {"step": self.step, "data_state": data_state or {},
+               # the topology-shift resume re-derives grad accum from
+               # this: global batch is an optimization invariant, not a
+               # property of the pod shape that saved it
+               "global_batch": int(self.global_batch),
                **(extra_aux or {})}
         self.checkpointer.save(self.step, self._state_tree(), aux, tag=tag)
         log_rank_zero(f"[dla_tpu] saved checkpoint @ step {self.step}")
@@ -916,8 +1005,65 @@ class Trainer:
         self.params = tree["params"]
         self.opt_state = tree["opt_state"]
         self.step = int(aux.get("step", 0))
+        self._adopt_saved_global_batch(aux)
+        if self.gang is not None:
+            info = self.gang.consume_restart_gap()
+            if info is not None:
+                # the full detect -> restart -> resume outage, charged in
+                # one piece as `elastic` badput by the resumed trainer
+                self.clock.charge_external("elastic", info["gap_s"])
+                self.recorder.record(
+                    "elastic_resume", step=self.step,
+                    gap_s=info["gap_s"], epoch=info["epoch"],
+                    survivors=info["survivors"], lost=info["lost"])
+                log_rank_zero(
+                    f"[dla_tpu][elastic] topology-shift resume @ step "
+                    f"{self.step}: epoch {info['epoch']}, survivors "
+                    f"{info['survivors']} (outage {info['gap_s']:.1f}s)")
         log_rank_zero(f"[dla_tpu] resumed from {tag} @ step {self.step}")
         return aux
+
+    def _adopt_saved_global_batch(self, aux: Dict[str, Any]) -> None:
+        """Preserve the optimization trajectory across a topology shift:
+        the checkpoint's global batch wins, and grad accumulation is
+        recomputed for the CURRENT host count so ``micro * dp * accum``
+        still lands on it. Must run before the first train-step dispatch
+        (``self.accum`` is read at trace time)."""
+        saved_gb = int(aux.get("global_batch", 0) or 0)
+        if not saved_gb or saved_gb == self.global_batch:
+            return
+        per_step = self.micro * self.dp
+        if saved_gb % per_step:
+            raise ValueError(
+                f"cannot resume: checkpoint global batch {saved_gb} is not "
+                f"divisible by micro_batch_size * data_parallel "
+                f"({self.micro} * {self.dp} = {per_step}) on this topology; "
+                f"resume on a host count that divides it, or change "
+                f"micro_batch_size")
+        new_accum = saved_gb // per_step
+        if new_accum != self.accum and self.train_step_compiles:
+            raise RuntimeError(
+                "topology-shift resume after the train step already "
+                "compiled: grad accum is baked into the traced graph")
+        log_rank_zero(
+            f"[dla_tpu][elastic] preserving global batch {saved_gb}: "
+            f"grad accum {self.accum} -> {new_accum} "
+            f"(micro {self.micro} x dp {self.dp})")
+        self.accum = new_accum
+        self.global_batch = saved_gb
+
+    def planned_global_batch(self, resume: bool = False) -> int:
+        """The global batch ``fit`` will actually train with — what entry
+        points must size their data iterators to. A fresh run answers
+        ``self.global_batch``; a resume peeks the checkpoint aux so a
+        topology-shift resume (``_adopt_saved_global_batch`` recomputing
+        grad accum for the survivor count) is fed full-size batches from
+        its first step instead of the shrunken topology's smaller ones."""
+        if not resume:
+            return self.global_batch
+        saved = int(self.checkpointer.peek_aux().get("global_batch", 0)
+                    or 0)
+        return saved or self.global_batch
 
 
 def _match_opt_shardings(optimizer, params: Pytree, param_shardings: Pytree,

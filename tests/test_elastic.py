@@ -1,0 +1,453 @@
+"""Elastic pod resilience tests (docs/RESILIENCE.md "Elastic
+training"): the ``host=`` fault-plan scope, GangMonitor heartbeat
+leases + lowest-rank-survivor shrink agreement, the timeout-guarded
+collectives, grad-accum recomputation on topology-shift resume, and
+the clean-closure path for externally-driven (RLHF) loops.
+
+THE acceptance pin: an 8-host simulated pod loses host 1 mid-run; the
+survivors detect it within one lease TTL, write a ``host_lost``
+postmortem naming the rank, and exit resumably. The run resumes at
+world 4 with the global batch preserved (grad accum 1 -> 2) and its
+post-resume loss trajectory + final parameters are bit-identical to a
+PLANNED fault-free topology shift through the same checkpoint — with
+``train_step_compiles == 1`` per world and the whole outage charged as
+``elastic`` badput.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dla_tpu.parallel.dist import (
+    CollectiveTimeout,
+    _run_with_deadline,
+    allgather_floats,
+    barrier,
+    clear_collective_deadline,
+    set_collective_deadline,
+)
+from dla_tpu.parallel.mesh import MeshConfig, build_mesh
+from dla_tpu.resilience import (
+    ElasticConfig,
+    ElasticRestart,
+    FaultPlan,
+    GangMonitor,
+    ResilienceConfig,
+)
+from dla_tpu.telemetry.flight_recorder import FlightRecorder
+
+
+# ---------------------------------------------------------------------------
+# fault-plan host scope
+# ---------------------------------------------------------------------------
+
+def test_host_fault_grammar_roundtrip_and_one_shot():
+    plan = FaultPlan.parse(
+        "host=1:step=6:lost; host=2:step=3:slow:2 ;step=4:nan")
+    # entries sort by step; host entries spec() back in host= form
+    assert plan.spec() == "host=2:step=3:slow:2;step=4:nan;host=1:step=6:lost"
+    # host entries only match site="host" (scopes are disjoint)
+    assert plan.take("lost", 100) is None
+    assert plan.take("nan", 100, site="host") is None
+    hit = plan.take("lost", 7, site="host")
+    assert hit is not None and hit.host == 1 and hit.step == 6
+    assert plan.take("lost", 7, site="host") is None      # one-shot
+    slow = plan.take("slow", 3, site="host")
+    assert slow.host == 2 and slow.arg == 2.0
+
+
+def test_host_fault_grammar_rejects_bad_specs():
+    with pytest.raises(ValueError, match="host="):
+        FaultPlan.parse("host=1:lost")               # missing step=
+    with pytest.raises(ValueError, match="known for host="):
+        FaultPlan.parse("host=1:step=3:wedge")       # serving kind
+    with pytest.raises(ValueError):
+        FaultPlan.parse("host=1:at=3:lost")          # wrong step key
+    with pytest.raises(ValueError):
+        FaultPlan.parse("step=3:lost")               # host kind, wrong scope
+
+
+def test_elastic_config_defaults_and_block():
+    cfg = ElasticConfig.from_config(None)
+    assert not cfg.enabled and cfg.lease_ttl_s == 60.0
+    assert cfg.lease_ttl_steps == 0 and cfg.sim_world == 0
+    cfg = ElasticConfig.from_config(
+        {"enabled": True, "lease_ttl_s": 5, "lease_ttl_steps": 3,
+         "gang_dir": "/tmp/g", "sim_world": 8, "collective_deadline_s": 2})
+    assert cfg.enabled and cfg.lease_ttl_s == 5.0
+    assert cfg.lease_ttl_steps == 3 and cfg.gang_dir == "/tmp/g"
+    assert cfg.sim_world == 8 and cfg.collective_deadline_s == 2.0
+    # rides the resilience block
+    rc = ResilienceConfig.from_config(
+        {"elastic": {"enabled": True, "sim_world": 4}})
+    assert rc.elastic.enabled and rc.elastic.sim_world == 4
+    assert not ResilienceConfig.from_config(None).elastic.enabled
+
+
+def test_elastic_restart_is_clean_systemexit():
+    exc = ElasticRestart(7, epoch=1, survivors=(0, 2, 3), lost=(1,))
+    assert isinstance(exc, SystemExit)
+    assert exc.code == 0                  # resumable to the launcher
+    assert exc.step == 7 and exc.epoch == 1
+    assert exc.survivors == (0, 2, 3) and exc.lost == (1,)
+    assert "lost host(s) [1]" in str(exc)
+
+
+# ---------------------------------------------------------------------------
+# GangMonitor: simulated-pod detection and agreement
+# ---------------------------------------------------------------------------
+
+def _sim_gang(tmp_path, plan="", world=4, ttl_steps=2, recorder=None):
+    return GangMonitor(
+        tmp_path / "gang", rank=0, world=world, lease_ttl_s=0,
+        lease_ttl_steps=ttl_steps, faults=FaultPlan.parse(plan),
+        recorder=recorder, sim=True)
+
+
+def test_sim_gang_detects_lost_host_within_ttl(tmp_path):
+    rec = FlightRecorder(out_dir=None)
+    gang = _sim_gang(tmp_path, "host=2:step=1:lost", recorder=rec)
+    decisions = {}
+    for s in range(4):
+        gang.beat(s)
+        d = gang.check(s)
+        if d is not None:
+            decisions[s] = d
+            break
+    # host 2's last lease is step 0; stale at step - 0 >= ttl (2)
+    assert list(decisions) == [2]
+    d = decisions[2]
+    assert d.epoch == 1 and d.lost == (2,) and d.survivors == (0, 1, 3)
+    assert d.decided_by == 0
+    assert gang.check(5) is d             # sticky once made
+    assert any(e["kind"] == "host_lost" and e["lost"] == [2]
+               for e in rec.events)
+    # the membership record is on disk for the resumed process
+    rec2 = json.loads((tmp_path / "gang" / "membership.json").read_text())
+    assert rec2["epoch"] == 1 and rec2["lost"] == [2]
+    assert rec2["resumed"] is False
+
+
+def test_sim_gang_cannot_lose_the_simulating_host(tmp_path):
+    gang = _sim_gang(tmp_path, "host=0:step=0:lost")
+    for s in range(5):
+        gang.beat(s)
+        assert gang.check(s) is None      # entry consumed but inert
+
+
+def test_sim_gang_slow_host_records_early_warning(tmp_path):
+    rec = FlightRecorder(out_dir=None)
+    # lag 2 stays below ttl 4: warning, never a shrink
+    gang = _sim_gang(tmp_path, "host=3:step=1:slow:2", ttl_steps=4,
+                     recorder=rec)
+    for s in range(8):
+        gang.beat(s)
+        assert gang.check(s) is None
+    slow = [e for e in rec.events if e["kind"] == "host_slow"]
+    assert len(slow) == 1                 # one-shot report
+    assert slow[0]["rank"] == 3 and slow[0]["lag_steps"] == 2
+
+
+def test_two_monitors_agree_and_restart_gap_is_one_shot(tmp_path):
+    gdir = tmp_path / "gang"
+    m0 = GangMonitor(gdir, rank=0, world=3, lease_ttl_s=0,
+                     lease_ttl_steps=2)
+    m1 = GangMonitor(gdir, rank=1, world=3, lease_ttl_s=0,
+                     lease_ttl_steps=2)
+    for s in range(2):                    # host 2 never beats
+        m0.beat(s), m1.beat(s)
+        assert m0.check(s) is None and m1.check(s) is None
+    m0.beat(2), m1.beat(2)
+    # rank 1 is not the lowest survivor: it waits for the proposal
+    assert m1.check(2) is None
+    d0 = m0.check(2)
+    assert d0 is not None and d0.lost == (2,) and d0.decided_by == 0
+    # rank 1 adopts the SAME decision from membership.json
+    d1 = m1.check(2)
+    assert d1 == d0
+
+    # the resumed (world-2) gang adopts epoch 1 and consumes the gap once
+    fresh = GangMonitor(gdir, rank=0, world=2, lease_ttl_s=0,
+                        lease_ttl_steps=2)
+    assert fresh.epoch == 1
+    info = fresh.consume_restart_gap()
+    assert info is not None
+    assert info["epoch"] == 1 and info["lost"] == [2]
+    assert info["survivors"] == [0, 1] and info["gap_s"] >= 0.0
+    assert fresh.consume_restart_gap() is None            # one-shot
+    # pre-restart leases were swept; a peer's resumed gang reads None too
+    assert not list(gdir.glob("lease_*.json"))
+    peer = GangMonitor(gdir, rank=1, world=2, lease_ttl_s=0,
+                       lease_ttl_steps=2)
+    assert peer.epoch == 1 and peer.consume_restart_gap() is None
+
+
+# ---------------------------------------------------------------------------
+# timeout-guarded collectives
+# ---------------------------------------------------------------------------
+
+def test_run_with_deadline_passes_value_and_errors_through():
+    assert _run_with_deadline(lambda: 42, "fast", 5.0) == 42
+    with pytest.raises(ValueError, match="boom"):
+        _run_with_deadline(lambda: (_ for _ in ()).throw(
+            ValueError("boom")), "err", 5.0)
+
+
+def test_run_with_deadline_times_out_with_suspects():
+    set_collective_deadline(10.0, suspects=lambda: [3])
+    try:
+        with pytest.raises(CollectiveTimeout) as ei:
+            _run_with_deadline(lambda: time.sleep(2.0), "hung", 0.05)
+        exc = ei.value
+        assert exc.name == "hung" and exc.suspects == (3,)
+        assert "suspect rank(s): [3]" in str(exc)
+    finally:
+        clear_collective_deadline()
+    # a crashing resolver must not mask the timeout itself
+    set_collective_deadline(10.0,
+                            suspects=lambda: 1 / 0)  # raises at resolve
+    try:
+        with pytest.raises(CollectiveTimeout) as ei:
+            _run_with_deadline(lambda: time.sleep(2.0), "hung2", 0.05)
+        assert ei.value.suspects == ()
+    finally:
+        clear_collective_deadline()
+
+
+def test_single_process_collectives_skip_the_deadline_machinery():
+    # fast paths return before any worker thread exists, so an armed
+    # deadline can never false-positive a single-process run
+    set_collective_deadline(1e-9, suspects=lambda: [1])
+    try:
+        assert barrier("b") is None
+        row = allgather_floats([1.0, 2.0])
+        assert row.shape == (1, 2) and row[0, 1] == 2.0
+    finally:
+        clear_collective_deadline()
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: the same tiny regression problem test_resilience
+# pins its checkpoint-identity guarantees on
+# ---------------------------------------------------------------------------
+
+DIM = 8
+
+
+def _make_batch(i, bs=8):
+    rs = np.random.RandomState(1000 + i)
+    x = rs.normal(size=(bs, DIM)).astype(np.float32)
+    w_true = np.arange(1, DIM + 1, dtype=np.float32)
+    return {"x": x, "y": (x @ w_true).astype(np.float32)}
+
+
+class CountingIter:
+    """Deterministic stream whose position is exact resume state — and
+    topology-independent: it always yields the GLOBAL batch, which the
+    trainer splits by its own (recomputed) grad accum."""
+
+    def __init__(self):
+        self.i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        b = _make_batch(self.i)
+        self.i += 1
+        return b
+
+    def state_dict(self):
+        return {"i": self.i}
+
+    def load_state_dict(self, state):
+        self.i = int(state["i"])
+
+
+def _linear_loss(params, frozen, batch, rng):
+    del frozen, rng
+    pred = batch["x"] @ params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+
+def _make_trainer(mesh, out_dir, *, max_steps=12, save_every=4, accum=1,
+                  resilience=None):
+    from dla_tpu.training.trainer import Trainer
+    config = {
+        "experiment_name": "elastic_test",
+        "data": {"prefetch": 0},
+        "optimization": {"total_batch_size": 8, "micro_batch_size": 1,
+                         "learning_rate": 1e-2, "max_train_steps": max_steps,
+                         "lr_scheduler": "constant", "max_grad_norm": 1.0},
+        "logging": {"output_dir": str(out_dir), "log_dir": None,
+                    "save_every_steps": save_every,
+                    "log_every_steps": 10 ** 6},
+        "hardware": {"gradient_accumulation_steps": accum},
+    }
+    if resilience is not None:
+        config["resilience"] = resilience
+    return Trainer(config=config, mesh=mesh, loss_fn=_linear_loss,
+                   params={"w": jnp.zeros((DIM,), jnp.float32)},
+                   param_specs={"w": P()})
+
+
+def _elastic_res(world, fault_plan=""):
+    return {"elastic": {"enabled": True, "lease_ttl_s": 0,
+                        "lease_ttl_steps": 3, "sim_world": world},
+            "fault_plan": fault_plan}
+
+
+def test_adopt_saved_global_batch_rules(mesh8, tmp_path):
+    """dp=4 here: a checkpoint batch of 6 has no integral accum; 16 is
+    adopted by recomputing accum 2 -> 4; adopting after the train step
+    compiled is refused (accum is baked into the traced graph)."""
+    with jax.sharding.set_mesh(mesh8):
+        tr = _make_trainer(mesh8, tmp_path / "a", accum=2)
+        assert tr.global_batch == 8
+        tr._adopt_saved_global_batch({"global_batch": 8})     # no-op
+        assert tr.accum == 2
+        with pytest.raises(ValueError, match="not.*divisible"):
+            tr._adopt_saved_global_batch({"global_batch": 6})
+        tr._adopt_saved_global_batch({"global_batch": 16})
+        assert tr.accum == 4 and tr.global_batch == 16
+
+        tr2 = _make_trainer(mesh8, tmp_path / "b", accum=2)
+        tr2.train_step_compiles = 1
+        with pytest.raises(RuntimeError, match="already.*compiled"):
+            tr2._adopt_saved_global_batch({"global_batch": 16})
+
+
+def test_planned_global_batch_peeks_checkpoint_aux(mesh8, tmp_path):
+    """Entry points size their data iterators before try_resume runs, so
+    a topology-shift resume must announce the SAVED global batch up
+    front: planned_global_batch(resume=True) peeks the checkpoint aux
+    without restoring tensors; fresh runs (or an empty checkpoint dir)
+    answer the current geometry."""
+    with jax.sharding.set_mesh(mesh8):
+        out = tmp_path / "gb"
+        tr = _make_trainer(mesh8, out, accum=2)          # global batch 8
+        assert tr.planned_global_batch(resume=False) == 8
+        assert tr.planned_global_batch(resume=True) == 8  # nothing saved
+        tr.global_batch = 16                              # pretend a
+        tr.save()                                         # bigger world
+        tr.global_batch = 8
+        assert tr.checkpointer.peek_aux()["global_batch"] == 16
+        assert tr.planned_global_batch(resume=True) == 16
+        assert tr.planned_global_batch(resume=False) == 8
+
+
+def test_poll_preemption_surfaces_elastic_restart(mesh8, tmp_path):
+    """Externally-driven loops (the RLHF rollout path) poll at rollout
+    boundaries: a lost gang peer must surface there as the same clean
+    ElasticRestart the fit loop raises — with the postmortem written."""
+    with jax.sharding.set_mesh(mesh8):
+        out = tmp_path / "rollout"
+        tr = _make_trainer(
+            mesh8, out, accum=2,
+            resilience={"elastic": {"enabled": True, "lease_ttl_s": 0.05,
+                                    "sim_world": 4},
+                        "fault_plan": "host=1:step=0:lost"})
+        try:
+            tr.poll_preemption()          # beats; takes the lost fault
+            time.sleep(0.12)              # host 1's lease expires
+            with pytest.raises(ElasticRestart) as ei:
+                tr.poll_preemption()
+            exc = ei.value
+            assert exc.code == 0
+            assert exc.lost == (1,) and exc.survivors == (0, 2, 3)
+            pm = json.loads((out / "postmortem_host_lost.json").read_text())
+            assert pm["reason"] == "host_lost"
+            assert any(e["kind"] == "host_lost" and e["lost"] == [1]
+                       for e in pm["events"])
+        finally:
+            clear_collective_deadline()
+
+
+def test_chaos_host_loss_resumes_at_world_4_bit_identical(tmp_path):
+    """THE acceptance pin. Arm A: an 8-host simulated pod loses host 1
+    at step 5 (``host=1:step=5:lost``); its last lease is step 4, so
+    with lease_ttl_steps=3 detection lands at step 7 — within one TTL —
+    as an ElasticRestart naming rank 1, after a ``host_lost``
+    postmortem. The run resumes on 4 hosts from the step-4 checkpoint
+    with the global batch preserved (grad accum 1 -> 2) and the full
+    outage charged as ``elastic`` badput. Arm B: a PLANNED fault-free
+    topology shift through the same step-4 boundary. Both arms' post-
+    resume loss trajectories and final parameters must match
+    bit-for-bit, with exactly one train-step compile per world."""
+    devices = jax.devices()
+    assert len(devices) == 8
+    mesh_w8 = build_mesh(MeshConfig(data=1, fsdp=8, model=1, sequence=1),
+                         devices=devices[:8])
+    mesh_w4 = build_mesh(MeshConfig(data=1, fsdp=4, model=1, sequence=1),
+                         devices=devices[:4])
+
+    # ---- arm A: faulted world-8 run
+    out_a = tmp_path / "faulted"
+    with jax.sharding.set_mesh(mesh_w8):
+        tr = _make_trainer(mesh_w8, out_a,
+                           resilience=_elastic_res(8, "host=1:step=5:lost"))
+        it = CountingIter()
+        with pytest.raises(ElasticRestart) as ei:
+            tr.fit(it, rng=jax.random.key(42), data_state=it.state_dict)
+        exc = ei.value
+        assert exc.code == 0              # clean, resumable exit
+        assert exc.step == 7              # fault@5, lease@4, ttl 3
+        assert exc.epoch == 1
+        assert exc.lost == (1,)
+        assert exc.survivors == (0, 2, 3, 4, 5, 6, 7)
+        assert tr.train_step_compiles == 1
+    pm = json.loads((out_a / "postmortem_host_lost.json").read_text())
+    assert pm["reason"] == "host_lost"
+    assert any(e["kind"] == "host_lost" and e["lost"] == [1]
+               for e in pm["events"])
+
+    # ---- arm A resumed at world 4
+    with jax.sharding.set_mesh(mesh_w4):
+        res = _make_trainer(mesh_w4, out_a, resilience=_elastic_res(4))
+        it2 = CountingIter()
+        p_res = res.fit(it2, rng=jax.random.key(42),
+                        data_state=it2.state_dict, resume=True)
+        assert res.step == 12
+        assert res.accum == 2             # recomputed: 8 = 1 * dp4 * 2
+        assert res.global_batch == 8      # the invariant, preserved
+        assert it2.i == 12                # data fast-forwarded to 4
+        assert res.train_step_compiles == 1
+        assert res.gang.epoch == 1
+        ev = [e for e in res.recorder.events
+              if e["kind"] == "elastic_resume"]
+        assert len(ev) == 1
+        assert ev[0]["step"] == 4 and ev[0]["lost"] == [1]
+        assert ev[0]["gap_s"] > 0.0
+        # the whole detect -> restart -> resume gap is elastic badput
+        assert res.clock.lost["elastic"] == pytest.approx(
+            ev[0]["gap_s"])
+        assert res.clock.badput()["elastic"] > 0.0
+        loss_a = [(e["step"], e["loss"]) for e in res.recorder.events
+                  if e["kind"] == "step_end"]
+
+    # ---- arm B: planned fault-free shift through the same boundary
+    out_b = tmp_path / "planned"
+    with jax.sharding.set_mesh(mesh_w8):
+        ref = _make_trainer(mesh_w8, out_b, max_steps=4,
+                            resilience=_elastic_res(8))
+        itb = CountingIter()
+        ref.fit(itb, rng=jax.random.key(42), data_state=itb.state_dict)
+        assert ref.step == 4
+    with jax.sharding.set_mesh(mesh_w4):
+        ref_res = _make_trainer(mesh_w4, out_b, resilience=_elastic_res(4))
+        itb2 = CountingIter()
+        p_ref = ref_res.fit(itb2, rng=jax.random.key(42),
+                            data_state=itb2.state_dict, resume=True)
+        assert ref_res.step == 12 and ref_res.accum == 2
+        assert ref_res.train_step_compiles == 1
+        loss_b = [(e["step"], e["loss"]) for e in ref_res.recorder.events
+                  if e["kind"] == "step_end"]
+
+    # post-resume trajectories and final params: bit-identical
+    assert loss_a == loss_b
+    assert np.asarray(p_res["w"]).tobytes() \
+        == np.asarray(p_ref["w"]).tobytes()

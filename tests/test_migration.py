@@ -98,6 +98,8 @@ def test_fleet_role_config_validation():
                     autoscale=True, max_engines=4)
     with pytest.raises(ValueError, match="migration_transport"):
         FleetConfig(migration_transport="carrier")
+    with pytest.raises(ValueError, match="max_handoff_retries"):
+        FleetConfig(max_handoff_retries=0)
     # list from YAML coerces to tuple
     cfg = FleetConfig.from_config(
         {"engines": 2, "roles": ["prefill", "decode"]})
@@ -391,6 +393,42 @@ def test_disagg_chaos_on_source_lands_requests_exactly_once(serve_setup):
     assert lost == []
     assert restarts[0] >= 1 and restarts[1:] == [0, 0]
     assert got == want
+
+
+def test_handoff_retry_bound_pins_requests_locally(serve_setup,
+                                                   monkeypatch):
+    """Every install refused: after ``max_handoff_retries`` passes the
+    router stops re-offering each request (no unbounded refuse/re-insert
+    cycle), ticks ``serving/migration/failed_handoffs`` once per
+    request, and the requests finish decoding on their prefill member —
+    the engine is decode-capable, the role is router policy — with
+    tokens still equal to the single-engine run."""
+    prompts = _prompts(n=4, seed=17)
+    single = _engine(serve_setup)
+    want = _serve(single, prompts)
+    single.close()
+
+    router = FleetRouter(_role_factory(serve_setup),
+                         FleetConfig(engines=3, roles=ROLES,
+                                     max_handoff_retries=2))
+
+    def refuse(dst_engine, ticket):
+        raise MigrationError("injected: sink refuses every install")
+
+    monkeypatch.setattr(router.migrator, "install", refuse)
+    got = _serve(router, prompts)
+    assert got == want                   # placement-independent tokens
+    assert router.metrics.failed_handoffs.value == len(prompts)
+    migrations = sum(
+        m.engine.metrics.snapshot()["serving/migration/migrations"]
+        for m in router.members())
+    assert migrations == 0               # nothing ever moved
+    # bookkeeping retired once the pinned requests finished
+    assert not router._handoff_pinned and not router._handoff_fails
+    for m in router.members():
+        m.engine.scheduler.assert_consistent()
+        assert m.engine.cache.allocator.used_count == 0
+    router.close()
 
 
 def test_scale_down_migrates_running_work_zero_loss(serve_setup):

@@ -232,6 +232,26 @@ def test_async_checkpointer_surfaces_exhausted_retries(tmp_path):
     assert ck.latest_tag() == "step_00000002"
 
 
+def test_async_checkpointer_exposes_last_error_age(tmp_path):
+    """The flaky-FS gauges: ``last_error_age_s()`` is -1 until a write
+    attempt fails, then tracks the age of the newest OSError — even when
+    the retry recovered (a flaky FS shows up as a small, churning age
+    next to a growing ``retries_total``)."""
+    ck = AsyncCheckpointer(str(tmp_path / "ck"), max_retries=3,
+                           backoff_s=0.01,
+                           faults=FaultPlan.parse("step=0:io_error"))
+    assert ck.last_error_age_s() == -1.0
+    assert ck.last_error is None
+    ck.save(1, _ck_tree(), aux={"step": 1})
+    ck.wait()                                 # retry recovered
+    assert ck.retries_total == 1 and ck.saves_completed == 1
+    age = ck.last_error_age_s()
+    assert 0.0 <= age < 60.0
+    assert "injected io_error" in ck.last_error
+    time.sleep(0.02)
+    assert ck.last_error_age_s() > age        # it is an age, not a flag
+
+
 def test_sweep_stale_tmp_and_atomic_latest(tmp_path):
     ck = Checkpointer(str(tmp_path / "ck"))
     ck.save(1, _ck_tree(), aux={"step": 1})

@@ -47,6 +47,8 @@ SELF_CHECK_DIR = REPO / "tests" / "fixtures" / "doctor_run"
 CAUSE_KINDS: Dict[str, Tuple[str, float]] = {
     "ckpt_retry": ("checkpoint I/O retry", 3.5),
     "fault_injected": ("injected fault", 3.5),
+    "host_lost": ("host lease lost", 3.5),
+    "collective_timeout": ("collective deadline timeout", 3.5),
     "ckpt_save_start": ("checkpoint save", 3.0),
     "watchdog_hang": ("watchdog hang", 3.0),
     "compile": ("XLA recompile", 2.5),
@@ -56,6 +58,8 @@ CAUSE_KINDS: Dict[str, Tuple[str, float]] = {
     "request_shed": ("load shedding", 2.0),
     "degradation_cache_flush": ("degradation cache flush", 2.0),
     "preemption_exit": ("preemption exit", 2.0),
+    "elastic_resume": ("elastic topology-shift resume", 2.0),
+    "host_slow": ("lagging host lease", 2.0),
     "slo_burn": ("SLO burn alert", 1.5),
 }
 
