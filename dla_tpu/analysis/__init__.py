@@ -33,10 +33,18 @@ from dla_tpu.analysis.report import (
     lint_text_report,
     validate_report,
 )
+from dla_tpu.analysis.witness import (
+    LockWitness,
+    get_witness,
+    install_witness,
+    uninstall_witness,
+    watch_attributes,
+)
 
 __all__ = [
     "Finding", "LintResult", "Project", "Rule", "all_rules",
     "collect_files", "register", "run_lint", "SCHEMA_ID", "build_report",
     "dump_report", "finding_row", "lint_json_report", "lint_text_report",
-    "validate_report",
+    "validate_report", "LockWitness", "get_witness", "install_witness",
+    "uninstall_witness", "watch_attributes",
 ]

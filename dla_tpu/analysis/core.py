@@ -219,7 +219,8 @@ def register(cls):
 def all_rules() -> Dict[str, "Rule"]:
     # import-for-effect: rule modules self-register on first use
     from dla_tpu.analysis import (  # noqa: F401
-        rules_config, rules_hotloop, rules_jit, rules_metrics, rules_pallas)
+        rules_concurrency, rules_config, rules_hotloop, rules_jit,
+        rules_metrics, rules_pallas)
     return dict(_RULES)
 
 

@@ -1,0 +1,390 @@
+"""Concurrency rules: cross-thread state, lock ordering, blocking
+under locks, and rank-divergent collectives.
+
+All four rules ride the thread-role model (:mod:`threads`): spawn sites
+seed roles, the call graph propagates them, and the lexical held-lock
+walk says what each access runs under. The static rules are the cheap
+half of the story — the runtime lock witness (:mod:`witness`) checks
+the same invariants against real acquisition orders during the test
+suite.
+
+Precision over recall throughout: an unresolvable thread target or an
+ambiguous method name produces *no* role and therefore no finding — a
+concurrency linter that cries wolf gets ``disable=all``'d.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from dla_tpu.analysis.core import Finding, Project, Rule, register
+from dla_tpu.analysis.threads import (
+    INIT_METHODS, MAIN_ROLE, get_model)
+
+
+def _fmt_roles(roles: FrozenSet[str]) -> str:
+    return "/".join(sorted(roles))
+
+
+def _short(lock_id: str) -> str:
+    """'dla_tpu/rollout/pipeline.py::RolloutPipeline._lock' ->
+    'RolloutPipeline._lock'."""
+    return lock_id.rpartition("::")[2]
+
+
+def _concurrent(a: FrozenSet[str], b: FrozenSet[str]) -> bool:
+    """Two role sets can overlap in time iff they span two distinct
+    roles (a {main} access can never race another {main} access)."""
+    return any(r1 != r2 for r1 in a for r2 in b)
+
+
+# ------------------------------------------------------------ shared state
+
+@register
+class SharedStateRule(Rule):
+    """A ``self._x`` attribute written under one thread role and
+    read/written under a different role, with no common lock lexically
+    held on both paths. Scope: classes that themselves spawn work onto
+    another thread (``Thread``/``Timer``/executor/signal sites) — the
+    repo's producer-thread pattern keeps spawner and shared state in
+    one class; cross-class handoffs are the runtime witness's job.
+    ``__init__``-time writes are exempt (they happen-before the
+    spawn)."""
+
+    name = "unsynchronized-shared-state"
+    summary = ("attribute crossed between thread roles without a "
+               "common lock on both paths")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        model = get_model(project)
+        for rel, cls in sorted(model.spawn_classes()):
+            # attr -> [(line, is_write, held, roles, qualname)]
+            acc: Dict[str, List[Tuple[int, bool, FrozenSet[str],
+                                      FrozenSet[str], str]]] = {}
+            for fd in model.class_defs(rel, cls):
+                if fd.name in INIT_METHODS:
+                    continue
+                roles = model.roles_of(fd.qualname)
+                for node, held in model.iter_held(fd):
+                    for attr, line, write in _self_accesses(node):
+                        acc.setdefault(attr, []).append(
+                            (line, write, held, roles, fd.qualname))
+            for attr in sorted(acc):
+                f = self._conflict(rel, cls, attr, acc[attr])
+                if f is not None:
+                    yield f
+
+    def _conflict(self, rel: str, cls: str, attr: str,
+                  accesses: List) -> Optional[Finding]:
+        order = lambda t: (t[0], not t[1], t[4])  # noqa: E731
+        writes = sorted((a for a in accesses if a[1]), key=order)
+        for w in writes:
+            for a in sorted(accesses, key=order):
+                if a is w and len(w[3]) < 2:
+                    continue             # an access only races itself
+                                         # when it runs on 2+ roles
+                if not _concurrent(w[3], a[3]):
+                    continue
+                if w[2] & a[2]:
+                    continue             # common lock on both paths
+                kind = "written" if a[1] else "read"
+                return Finding(
+                    rule=self.name, path=rel, line=w[0],
+                    message=(
+                        f"{cls}.{attr} is written on thread role(s) "
+                        f"[{_fmt_roles(w[3])}] here and {kind} on role(s) "
+                        f"[{_fmt_roles(a[3])}] at line {a[0]} with no "
+                        f"common lock held on both paths"),
+                    data={"class": cls, "attr": attr,
+                          "write": {"line": w[0],
+                                    "roles": sorted(w[3]),
+                                    "locks": sorted(w[2])},
+                          "other": {"line": a[0], "write": a[1],
+                                    "roles": sorted(a[3]),
+                                    "locks": sorted(a[2])}})
+        return None
+
+
+def _self_accesses(node: ast.AST) -> Iterator[Tuple[str, int, bool]]:
+    """(attr, line, is_write) for self-attribute touches at this node.
+    Subscript stores (``self._d[k] = v``) count as writes to the
+    container attribute."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        yield node.attr, node.lineno, isinstance(node.ctx,
+                                                 (ast.Store, ast.Del))
+    elif isinstance(node, ast.Subscript) \
+            and isinstance(node.ctx, (ast.Store, ast.Del)) \
+            and isinstance(node.value, ast.Attribute) \
+            and isinstance(node.value.value, ast.Name) \
+            and node.value.value.id == "self":
+        yield node.value.attr, node.lineno, True
+
+
+# ------------------------------------------------------------- lock order
+
+@register
+class LockOrderRule(Rule):
+    """Acquired-while-holding edges collected across the call graph; a
+    cycle means two code paths take the same locks in opposite orders —
+    a deadlock waiting for the right interleaving. The finding names
+    both witness chains."""
+
+    name = "lock-order-inversion"
+    summary = "two code paths acquire the same locks in opposite orders"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        model = get_model(project)
+        # (a, b) -> witness {rel, line, via chain}
+        edges: Dict[Tuple[str, str], Dict] = {}
+
+        def note(a: str, b: str, rel: str, line: int,
+                 chain: Tuple[str, ...]) -> None:
+            if a != b and (a, b) not in edges:
+                edges[(a, b)] = {"path": rel, "line": line,
+                                 "via": list(chain)}
+
+        for qn in sorted(model.graph.defs):
+            fd = model.graph.defs[qn]
+            for lid, line, held in model.direct_acquires(fd):
+                for h in held:
+                    note(h, lid, fd.rel, line, (qn,))
+            for node, held in model.iter_held(fd):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                callee = model.resolve_call(node, fd)
+                if callee is None:
+                    continue
+                for lid, (line, chain) in sorted(
+                        model.transitive_acquires(callee).items()):
+                    for h in held:
+                        note(h, lid, fd.rel, node.lineno, (qn,) + chain)
+
+        for cycle in _cycles(edges):
+            first = edges[(cycle[0], cycle[1])]
+            legs = []
+            for i, a in enumerate(cycle[:-1]):
+                b = cycle[i + 1]
+                w = edges[(a, b)]
+                legs.append(f"{_short(a)} -> {_short(b)} "
+                            f"(at {w['path']}:{w['line']} "
+                            f"via {' -> '.join(w['via'])})")
+            yield Finding(
+                rule=self.name, path=first["path"], line=first["line"],
+                message=("lock-order inversion: " + "; but ".join(legs)),
+                data={"cycle": list(cycle),
+                      "edges": [dict(edges[(cycle[i], cycle[i + 1])],
+                                     frm=cycle[i], to=cycle[i + 1])
+                                for i in range(len(cycle) - 1)]})
+
+
+def _cycles(edges: Dict[Tuple[str, str], Dict]) -> List[Tuple[str, ...]]:
+    """Simple cycles in the lock digraph, deduplicated by canonical
+    rotation, returned as closed node tuples (a, …, a)."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    for outs in adj.values():
+        outs.sort()
+    seen: Set[Tuple[str, ...]] = set()
+    out: List[Tuple[str, ...]] = []
+
+    def dfs(start: str, cur: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in adj.get(cur, ()):
+            if nxt == start and len(path) > 1:
+                ring = path[:]
+                pivot = ring.index(min(ring))
+                canon = tuple(ring[pivot:] + ring[:pivot])
+                if canon not in seen:
+                    seen.add(canon)
+                    out.append(tuple(canon) + (canon[0],))
+            elif nxt not in on_path and nxt > start:
+                # only walk nodes > start: each cycle is found exactly
+                # once, from its smallest node
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for node in sorted(adj):
+        dfs(node, node, [node], {node})
+    return out
+
+
+# -------------------------------------------------------- blocking calls
+
+#: canonical call targets that block the calling thread outright
+_BLOCKING_CANON = {
+    "subprocess.run": "subprocess.run()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "time.sleep": "time.sleep()",
+    "numpy.save": "file I/O (numpy.save)",
+    "numpy.load": "file I/O (numpy.load)",
+}
+
+#: collective wrappers — blocking AND divergence-sensitive
+_COLLECTIVES = {"barrier", "allgather_floats", "process_allgather",
+                "sync_global_devices", "broadcast_one_to_all"}
+
+_FILE_IO_ATTRS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+
+def _blocking_label(call: ast.Call, imports) -> Optional[str]:
+    """Label when a call can block its thread indefinitely (or long
+    enough to matter under a lock), else None."""
+    func = call.func
+    canon = imports.canonical(func) if imports is not None else None
+    if canon:
+        if canon in _BLOCKING_CANON:
+            return _BLOCKING_CANON[canon]
+        if canon.rpartition(".")[2] in _COLLECTIVES:
+            return f"collective {canon.rpartition('.')[2]}()"
+    if isinstance(func, ast.Name) and func.id == "open":
+        return "file I/O (open)"
+    if not isinstance(func, ast.Attribute):
+        return None
+    timed = any(kw.arg == "timeout" for kw in call.keywords)
+    if func.attr == "block_until_ready":
+        return ".block_until_ready()"
+    if func.attr == "result" and not call.args and not timed:
+        return "Future.result() (untimed)"
+    if func.attr in ("get", "wait", "join") and not call.args and not timed:
+        return f".{func.attr}() (untimed)"
+    if func.attr in _FILE_IO_ATTRS:
+        return f"file I/O (.{func.attr})"
+    return None
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    """A call that can block indefinitely — ``Future.result``, untimed
+    ``queue.get``/``Event.wait``/``join``, ``block_until_ready``, file
+    I/O, subprocesses, or a collective — reachable while a lock is
+    held. Every other thread needing that lock now inherits the stall:
+    the class of hang the Watchdog and CollectiveTimeout catch only at
+    runtime."""
+
+    name = "blocking-under-lock"
+    summary = "indefinitely-blocking call reachable while a lock is held"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        model = get_model(project)
+        blk_memo: Dict[str, Optional[Tuple[str, str, int, Tuple[str, ...]]]] \
+            = {}
+
+        def transitive(qn: str):
+            if qn in blk_memo:
+                return blk_memo[qn]
+            best = None
+            for q, chain in model.graph.reachable_from([qn]).items():
+                fd = model.graph.defs.get(q)
+                if fd is None:
+                    continue
+                sf = project.by_rel[fd.rel]
+                for node in ast.walk(fd.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    lbl = _blocking_label(node, sf.imports)
+                    if lbl and (best is None or len(chain) < len(best[3])):
+                        best = (lbl, fd.rel, node.lineno, chain)
+            blk_memo[qn] = best
+            return best
+
+        seen: Set[Tuple[str, int]] = set()
+        for qn in sorted(model.graph.defs):
+            fd = model.graph.defs[qn]
+            sf = project.by_rel[fd.rel]
+            for node, held in model.iter_held(fd):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                key = (fd.rel, node.lineno)
+                if key in seen:
+                    continue
+                lbl = _blocking_label(node, sf.imports)
+                chain: Tuple[str, ...] = ()
+                site = ""
+                if lbl is None:
+                    callee = model.resolve_call(node, fd)
+                    if callee is None:
+                        continue
+                    hit = transitive(callee)
+                    if hit is None:
+                        continue
+                    lbl, hit_rel, hit_line, chain = hit
+                    site = f" (at {hit_rel}:{hit_line} via " \
+                           f"{' -> '.join(chain)})"
+                seen.add(key)
+                locks = ", ".join(sorted(_short(h) for h in held))
+                yield Finding(
+                    rule=self.name, path=fd.rel, line=node.lineno,
+                    message=(f"{lbl} reachable while holding {locks}"
+                             f"{site} — any thread needing the lock "
+                             f"inherits the stall"),
+                    data={"label": lbl, "locks": sorted(held),
+                          "chain": list(chain)})
+
+
+# -------------------------------------------------- conditional collective
+
+#: identifiers whose value differs across hosts of one job — a branch
+#: testing them sends hosts down different paths. process_count and
+#: friends are deliberately absent: they agree on every host.
+_RANK_TOKENS = {"is_main", "rank", "process_index", "host_id",
+                "process_id", "local_rank"}
+
+
+@register
+class ConditionalCollectiveRule(Rule):
+    """A collective call lexically under a rank-/host-dependent branch:
+    the hosts that skip the branch never enter the collective, the rest
+    wait forever — the classic SPMD deadlock. Hoist the collective out
+    of the branch (every host calls it; rank-dependent work stays
+    inside)."""
+
+    name = "conditional-collective"
+    summary = "collective call under a rank-/host-dependent branch"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.py_files():
+            yield from self._scan(sf, sf.tree, rank_ifs=[])
+
+    def _scan(self, sf, node: ast.AST,
+              rank_ifs: List[Tuple[int, str]]) -> Iterator[Finding]:
+        if isinstance(node, ast.Call):
+            canon = sf.imports.canonical(node.func) or ""
+            short = canon.rpartition(".")[2]
+            if short in _COLLECTIVES and rank_ifs:
+                line, tokens = rank_ifs[-1]
+                yield Finding(
+                    rule=self.name, path=sf.rel, line=node.lineno,
+                    message=(
+                        f"collective {short}() under the rank-dependent "
+                        f"branch at line {line} (test reads {tokens}) — "
+                        f"hosts that skip the branch deadlock the rest; "
+                        f"hoist the collective out of the branch"),
+                    data={"collective": short, "branch_line": line,
+                          "tokens": tokens})
+        if isinstance(node, (ast.If, ast.IfExp)):
+            tokens = sorted(self._rank_tokens(node.test))
+            if tokens:
+                inner = rank_ifs + [(node.lineno, ", ".join(tokens))]
+                yield from self._scan(sf, node.test, rank_ifs)
+                for child in ast.iter_child_nodes(node):
+                    if child is not node.test:
+                        yield from self._scan(sf, child, inner)
+                return
+        for child in ast.iter_child_nodes(node):
+            yield from self._scan(sf, child, rank_ifs)
+
+    @staticmethod
+    def _rank_tokens(test: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for n in ast.walk(test):
+            if isinstance(n, ast.Name) and n.id in _RANK_TOKENS:
+                out.add(n.id)
+            elif isinstance(n, ast.Attribute) and n.attr in _RANK_TOKENS:
+                out.add(n.attr)
+        return out

@@ -1,0 +1,455 @@
+"""Thread-role inference: which thread(s) can each function run on?
+
+The repo is multi-threaded in a small, disciplined set of ways — a
+checkpoint writer, a rollout generator, per-fleet-member single-thread
+executors, the watchdog, collective deadline threads, the metrics HTTP
+server, and signal handlers. This module recovers that structure
+statically: it finds every *spawn site* (``threading.Thread(target=…)``,
+``ThreadPoolExecutor(…)``/``.submit(…)``, ``threading.Timer``,
+``signal.signal`` registrations, ``BaseHTTPRequestHandler``
+subclasses), names each one's *role* from its thread-name literal, and
+propagates roles through the :class:`~dla_tpu.analysis.callgraph.CallGraph`
+so every function carries the set of roles it may execute under.
+
+Role semantics (lint-grade, precision over recall):
+
+- A function reachable from a spawn target carries that spawn's role.
+- A function with no incoming call edges that is not itself a spawn
+  target is a *main-thread entry point*; ``"main"`` propagates from all
+  of those. A function reachable from both kinds of root carries both.
+- Anything the model has never seen defaults to ``{"main"}``.
+
+The model also indexes every ``threading.Lock``/``RLock`` the project
+creates (``self._x = threading.Lock()`` attributes and module-level
+``_lock = threading.Lock()`` globals) and provides the lexical
+held-lock walk the concurrency rules share. One model is built per
+:class:`~dla_tpu.analysis.core.Project` and cached on it — four rules
+pay for one call graph.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from dla_tpu.analysis.callgraph import CallGraph, FuncDef, _module_name
+from dla_tpu.analysis.core import Project
+
+MAIN_ROLE = "main"
+
+#: constructors whose call creates a lock the model tracks
+_LOCK_CTORS = {"threading.Lock": "Lock", "threading.RLock": "RLock"}
+
+#: init-like methods whose attribute writes happen before any thread
+#: can exist — exempt from shared-state analysis
+INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+#: method names shared with ubiquitous stdlib objects (Event.wait,
+#: Queue.get/full, Thread.start, file.write, Future.result, ...). The
+#: call graph's unique-method fallback must not let one project class
+#: that happens to define ``wait`` absorb every ``Event.wait()`` call
+#: into its thread-role set — that edge poisons role propagation.
+_GENERIC_METHODS = frozenset({
+    "wait", "join", "get", "put", "set", "clear", "is_set", "acquire",
+    "release", "result", "submit", "shutdown", "cancel", "start", "run",
+    "close", "stop", "full", "empty", "get_nowait", "put_nowait",
+    "task_done", "notify", "notify_all", "locked", "read", "write",
+    "open", "flush", "send", "recv", "items", "keys", "values", "pop",
+    "append", "update", "copy", "sort", "add", "remove", "discard",
+})
+
+
+class _RoleGraph(CallGraph):
+    """CallGraph with the unique-method fallback disabled for
+    stdlib-colliding names. Explicit ``self.m``/module-function
+    resolution is unaffected; only the project-wide "exactly one class
+    defines this method" guess is suppressed, trading recall for the
+    precision role propagation needs."""
+
+    def _unique_method(self, name: str):
+        if name in _GENERIC_METHODS:
+            return None
+        return super()._unique_method(name)
+
+
+@dataclasses.dataclass
+class SpawnSite:
+    """One place the project puts work onto another thread."""
+    rel: str
+    line: int
+    kind: str                    # thread | timer | executor | submit | signal
+    role: str                    # readable role ("dla-watchdog", "signal", …)
+    owner: Optional[str]         # qualname of the function with the spawn
+    cls: Optional[str]           # class containing the spawn, if any
+    target: Optional[str]        # resolved qualname of the entry function
+    name_source: Optional[str]   # the name=/thread_name_prefix= literal
+                                 # ("dla-ckpt-*" for f-strings), None if absent
+
+
+@dataclasses.dataclass
+class LockDef:
+    """One lock the project creates."""
+    lock_id: str                 # "rel::Cls.attr" or "rel::name"
+    rel: str
+    cls: Optional[str]
+    attr: str
+    line: int
+    kind: str                    # Lock | RLock
+
+
+def _name_literal(node: Optional[ast.AST]) -> Optional[str]:
+    """The thread-name literal: constants verbatim, f-strings with
+    interpolations collapsed to ``*`` ("dla-ckpt-*"), else None."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _keyword(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class ThreadModel:
+    """Spawn sites, roles, and locks for one project. Build through
+    :func:`get_model`, which caches the instance on the Project so the
+    four concurrency rules share one call graph."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.graph = _RoleGraph(project)
+        self.spawns: List[SpawnSite] = []
+        self.locks: Dict[str, LockDef] = {}
+        self.class_locks: Dict[Tuple[str, Optional[str]], Dict[str, str]] = {}
+        self.module_locks: Dict[str, Dict[str, str]] = {}
+        self._roles: Dict[str, Set[str]] = {}
+        self._defs_by_class: Dict[Tuple[str, str], List[FuncDef]] = {}
+        self._class_rel: Dict[str, str] = {}
+        self._attr_types: Dict[str, Set[str]] = {}
+        self._acq_memo: Dict[str, Dict[str, Tuple[int, Tuple[str, ...]]]] = {}
+
+        for fd in self.graph.defs.values():
+            if fd.cls is not None:
+                self._defs_by_class.setdefault((fd.rel, fd.cls), []).append(fd)
+        self._index_classes()
+        self._index_locks()
+        self._index_spawns()
+        self._propagate_roles()
+
+    # ------------------------------------------------------------- indexing
+
+    def _index_classes(self) -> None:
+        """Class-name -> file, and attribute-type hints from
+        ``self.x = ClassName(...)`` assignments plus ``__init__`` params
+        annotated with a project class (``def __init__(self, sup:
+        Supervisor)`` then ``self.sup = sup``)."""
+        ambiguous: Set[str] = set()
+        for sf in self.project.py_files():
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    if node.name in self._class_rel:
+                        ambiguous.add(node.name)
+                    self._class_rel[node.name] = sf.rel
+        for name in ambiguous:
+            self._class_rel.pop(name, None)
+
+        for fd in self.graph.defs.values():
+            ann: Dict[str, str] = {}
+            for a in fd.node.args.args + fd.node.args.kwonlyargs:
+                if isinstance(a.annotation, ast.Name) \
+                        and a.annotation.id in self._class_rel:
+                    ann[a.arg] = a.annotation.id
+            for stmt in ast.walk(fd.node):
+                if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                    continue
+                tgt = stmt.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                cls_name = None
+                if isinstance(stmt.value, ast.Call):
+                    fn = stmt.value.func
+                    base = fn.id if isinstance(fn, ast.Name) else (
+                        fn.attr if isinstance(fn, ast.Attribute) else None)
+                    if base in self._class_rel:
+                        cls_name = base
+                elif isinstance(stmt.value, ast.Name):
+                    cls_name = ann.get(stmt.value.id)
+                if cls_name is not None:
+                    self._attr_types.setdefault(tgt.attr, set()).add(cls_name)
+
+    def _index_locks(self) -> None:
+        for sf in self.project.py_files():
+            # module-level: _lock = threading.Lock()
+            for node in sf.tree.body:
+                kind = self._lock_ctor(node, sf)
+                if kind and isinstance(node.targets[0], ast.Name):
+                    name = node.targets[0].id
+                    self._add_lock(LockDef(f"{sf.rel}::{name}", sf.rel,
+                                           None, name, node.lineno, kind))
+            # class attributes: self._lock = threading.Lock()
+            for fd in self.graph.defs.values():
+                if fd.rel != sf.rel or fd.cls is None:
+                    continue
+                for node in ast.walk(fd.node):
+                    kind = self._lock_ctor(node, sf)
+                    if not kind:
+                        continue
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self":
+                        self._add_lock(LockDef(
+                            f"{sf.rel}::{fd.cls}.{tgt.attr}", sf.rel,
+                            fd.cls, tgt.attr, node.lineno, kind))
+
+    def _lock_ctor(self, node: ast.AST, sf) -> Optional[str]:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)):
+            return None
+        canon = sf.imports.canonical(node.value.func)
+        return _LOCK_CTORS.get(canon or "")
+
+    def _add_lock(self, ld: LockDef) -> None:
+        if ld.lock_id in self.locks:
+            return
+        self.locks[ld.lock_id] = ld
+        if ld.cls is not None:
+            self.class_locks.setdefault((ld.rel, ld.cls), {})[ld.attr] \
+                = ld.lock_id
+        else:
+            self.module_locks.setdefault(ld.rel, {})[ld.attr] = ld.lock_id
+
+    # ---------------------------------------------------------- spawn sites
+
+    def _index_spawns(self) -> None:
+        for fd in self.graph.defs.values():
+            sf = self.project.by_rel[fd.rel]
+            mod = _module_name(fd.rel)
+            for node in ast.walk(fd.node):
+                if isinstance(node, ast.Call):
+                    self._spawn_from_call(node, fd, sf, mod)
+
+    def _spawn_from_call(self, call: ast.Call, fd: FuncDef, sf,
+                         mod: str) -> None:
+        canon = sf.imports.canonical(call.func) or ""
+        short = canon.rpartition(".")[2]
+        if short == "Thread" and canon in ("threading.Thread", "Thread"):
+            target = _keyword(call, "target")
+            name = _name_literal(_keyword(call, "name"))
+            self._add_spawn(call, fd, "thread", name,
+                            self._resolve_target(target, mod, fd, sf))
+        elif short == "Timer" and canon in ("threading.Timer", "Timer"):
+            target = call.args[1] if len(call.args) > 1 \
+                else _keyword(call, "function")
+            name = _name_literal(_keyword(call, "name"))
+            self._add_spawn(call, fd, "timer", name,
+                            self._resolve_target(target, mod, fd, sf))
+        elif short == "ThreadPoolExecutor":
+            name = _name_literal(_keyword(call, "thread_name_prefix"))
+            self._add_spawn(call, fd, "executor", name, None)
+        elif canon == "signal.signal" and len(call.args) >= 2:
+            self._add_spawn(call, fd, "signal", "signal",
+                            self._resolve_target(call.args[1], mod, fd, sf))
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "submit" and call.args:
+            # executor.submit(fn, …): role borrows the file's (single)
+            # thread_name_prefix when one exists
+            target = self._resolve_target(call.args[0], mod, fd, sf)
+            if target is not None:
+                self._add_spawn(call, fd, "submit",
+                                self._file_prefix(fd.rel), target)
+
+    def _add_spawn(self, call: ast.Call, fd: FuncDef, kind: str,
+                   name: Optional[str], target: Optional[str]) -> None:
+        role = name or f"{kind}@{fd.rel}:{call.lineno}"
+        if kind == "signal":
+            role = "signal"
+        self.spawns.append(SpawnSite(
+            rel=fd.rel, line=call.lineno, kind=kind, role=role,
+            owner=fd.qualname, cls=fd.cls, target=target, name_source=name))
+
+    def _file_prefix(self, rel: str) -> Optional[str]:
+        prefixes = {s.name_source for s in self.spawns
+                    if s.rel == rel and s.kind == "executor"
+                    and s.name_source}
+        return next(iter(prefixes)) if len(prefixes) == 1 else None
+
+    def _resolve_target(self, expr: Optional[ast.AST], mod: str,
+                        fd: FuncDef, sf) -> Optional[str]:
+        """Resolve a thread-entry expression to a def qualname. Reuses
+        the call graph's resolution, plus a typed-attribute fallback so
+        ``m.sup.step`` resolves when some ``__init__`` assigned
+        ``self.sup = Supervisor(...)`` (or a ``sup: Supervisor``
+        param)."""
+        if expr is None:
+            return None
+        qn = self.graph._resolve(expr, mod, fd, sf.imports)
+        if qn is not None:
+            return qn
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Attribute):
+            owners = self._attr_types.get(expr.value.attr, set())
+            if len(owners) == 1:
+                cls = next(iter(owners))
+                rel = self._class_rel.get(cls)
+                if rel:
+                    qn = f"{rel}::{cls}.{expr.attr}"
+                    if qn in self.graph.defs:
+                        return qn
+        return None
+
+    # ----------------------------------------------------------------- roles
+
+    def _propagate_roles(self) -> None:
+        targets: Set[str] = set()
+        for site in self.spawns:
+            if site.target is None:
+                continue
+            targets.add(site.target)
+            for qn in self.graph.reachable_from([site.target]):
+                self._roles.setdefault(qn, set()).add(site.role)
+        # HTTP handler methods run on server threads
+        http_seeds: List[str] = []
+        for sf in self.project.py_files():
+            for node in sf.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = {b.rpartition(".")[2] for b in
+                         (sf.imports.canonical(base) or ""
+                          for base in node.bases)}
+                if "BaseHTTPRequestHandler" in bases:
+                    for child in node.body:
+                        if isinstance(child, ast.FunctionDef):
+                            http_seeds.append(
+                                f"{sf.rel}::{node.name}.{child.name}")
+        targets.update(http_seeds)
+        for qn in self.graph.reachable_from(http_seeds):
+            self._roles.setdefault(qn, set()).add("http")
+        # main propagates from every entry point that is not a thread
+        # target: defs nobody in the project calls
+        called: Set[str] = set()
+        for outs in self.graph.edges.values():
+            called.update(outs)
+        main_roots = [qn for qn in self.graph.defs
+                      if qn not in called and qn not in targets]
+        for qn in self.graph.reachable_from(main_roots):
+            self._roles.setdefault(qn, set()).add(MAIN_ROLE)
+
+    def roles_of(self, qualname: str) -> FrozenSet[str]:
+        return frozenset(self._roles.get(qualname) or {MAIN_ROLE})
+
+    def spawn_classes(self) -> Set[Tuple[str, str]]:
+        """(rel, class) pairs that put work on another thread — the
+        scope of the shared-state rule (precision over recall: a class
+        that never spawns shares state only through explicit handoffs,
+        which the runtime witness covers)."""
+        return {(s.rel, s.cls) for s in self.spawns if s.cls is not None}
+
+    def class_defs(self, rel: str, cls: str) -> List[FuncDef]:
+        return sorted(self._defs_by_class.get((rel, cls), []),
+                      key=lambda fd: fd.node.lineno)
+
+    # ------------------------------------------------------- lexical locking
+
+    def with_locks(self, node: ast.With, rel: str,
+                   cls: Optional[str]) -> List[Tuple[str, int]]:
+        """Lock ids a ``with`` statement acquires (``with self._lock:``
+        for a class lock, ``with _lock:`` for a module global)."""
+        out: List[Tuple[str, int]] = []
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" and cls is not None:
+                lid = self.class_locks.get((rel, cls), {}).get(expr.attr)
+            elif isinstance(expr, ast.Name):
+                lid = self.module_locks.get(rel, {}).get(expr.id)
+            else:
+                lid = None
+            if lid is not None:
+                out.append((lid, node.lineno))
+        return out
+
+    def iter_held(self, fd: FuncDef) -> Iterator[
+            Tuple[ast.AST, FrozenSet[str]]]:
+        """Yield (node, held-locks) for every node in a function body,
+        tracking lexical ``with <lock>:`` regions. Nested function and
+        lambda bodies inherit the enclosing held set — matching the call
+        graph's nested-def merge (a closure created under a lock is
+        almost always invoked there)."""
+        def walk(node: ast.AST, held: FrozenSet[str]):
+            yield node, held
+            if isinstance(node, ast.With):
+                acquired = frozenset(
+                    lid for lid, _ in self.with_locks(node, fd.rel, fd.cls))
+                for item in node.items:
+                    yield from walk(item.context_expr, held)
+                inner = held | acquired
+                for child in node.body:
+                    yield from walk(child, inner)
+                return
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, held)
+
+        for stmt in fd.node.body:
+            yield from walk(stmt, frozenset())
+
+    def direct_acquires(self, fd: FuncDef) -> List[
+            Tuple[str, int, FrozenSet[str]]]:
+        """(lock_id, line, locks-already-held) for every lexical
+        acquisition in a function."""
+        out = []
+        for node, held in self.iter_held(fd):
+            if isinstance(node, ast.With):
+                cur = set(held)
+                for lid, line in self.with_locks(node, fd.rel, fd.cls):
+                    out.append((lid, line, frozenset(cur)))
+                    cur.add(lid)
+        return out
+
+    def transitive_acquires(self, qualname: str) -> Dict[
+            str, Tuple[int, Tuple[str, ...]]]:
+        """Every lock acquired anywhere in a function's call closure:
+        lock_id -> (acquisition line, shortest call chain)."""
+        memo = self._acq_memo.get(qualname)
+        if memo is not None:
+            return memo
+        out: Dict[str, Tuple[int, Tuple[str, ...]]] = {}
+        for qn, chain in self.graph.reachable_from([qualname]).items():
+            fd = self.graph.defs.get(qn)
+            if fd is None:
+                continue
+            for lid, line, _held in self.direct_acquires(fd):
+                if lid not in out or len(chain) < len(out[lid][1]):
+                    out[lid] = (line, chain)
+        self._acq_memo[qualname] = out
+        return out
+
+    def resolve_call(self, call: ast.Call, fd: FuncDef) -> Optional[str]:
+        sf = self.project.by_rel[fd.rel]
+        return self.graph._resolve(call.func, _module_name(fd.rel), fd,
+                                   sf.imports)
+
+
+def get_model(project: Project) -> ThreadModel:
+    """The project's (cached) thread model — all four concurrency rules
+    share one call graph and one role propagation."""
+    model = getattr(project, "_thread_model", None)
+    if model is None:
+        model = ThreadModel(project)
+        project._thread_model = model    # cache keyed to project lifetime
+    return model

@@ -1,0 +1,313 @@
+"""Runtime lock witness: the dynamic half of the concurrency rules.
+
+The static rules (:mod:`rules_concurrency`) reason about lexical lock
+regions; this module checks the *real* acquisition order. It wraps
+``threading.Lock``/``RLock`` so every acquire records, per thread, the
+edge "lock B acquired while lock A was held". At session end,
+:meth:`LockWitness.check` asserts the resulting order graph is acyclic
+— a cycle is a deadlock that merely hasn't hit its interleaving yet —
+and dumps ``postmortem_lock_cycle.json`` (the flight-recorder
+postmortem shape, so :mod:`tools.dla_doctor` ranks it next to
+``watchdog_hang``) when it isn't.
+
+:func:`install_witness` monkeypatches ``threading.Lock``/``RLock``.
+Only locks created *from this repo's own files* are instrumented — a
+lock allocated inside the stdlib (every ``Event``/``Condition``/
+``Queue``) or inside jax gets the raw primitive back, so the patch adds
+zero overhead and zero false edges outside the code under test. Lock
+identity is the creation site (``file.py:line``): two instances from
+one site share a node, which is exactly the granularity lock-ordering
+discipline is stated at.
+
+``tests/conftest.py`` installs this for the whole tier-1 suite, so
+every chaos/fleet/rollout test doubles as a lock-order probe. The
+witness also records per-attribute accessor threads for explicitly
+flagged classes (:func:`watch_attributes`) — the runtime analogue of
+the ``unsynchronized-shared-state`` rule.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+# raw primitives, captured before any patching can rebind them
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def _creation_site(depth: int = 2) -> Tuple[str, str]:
+    """(display name, absolute file) of the frame creating a lock."""
+    f = sys._getframe(depth)
+    fn = f.f_code.co_filename
+    return f"{os.path.basename(fn)}:{f.f_lineno}", fn
+
+
+class LockWitness:
+    """Acquisition-order graph + per-attribute accessor threads.
+
+    Thread-safety: per-thread held stacks are only touched by their
+    owning thread; the shared edge/attr tables are guarded by a raw
+    (uninstrumented) mutex taken only on first sight of an edge."""
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()
+        self._held: Dict[int, List[str]] = {}
+        self.edges: Dict[Tuple[str, str], Dict] = {}
+        self.attr_threads: Dict[str, Dict[str, Set[str]]] = {}
+
+    # ------------------------------------------------------------ recording
+
+    def note_acquire(self, name: str) -> None:
+        ident = threading.get_ident()
+        stack = self._held.get(ident)
+        if stack is None:
+            stack = self._held.setdefault(ident, [])
+        if name not in stack:            # re-entrant RLock: no new edges
+            for h in stack:
+                key = (h, name)
+                if key not in self.edges:
+                    with self._mu:
+                        self.edges.setdefault(key, {
+                            "thread": threading.current_thread().name,
+                            "at": time.monotonic()})
+        stack.append(name)
+
+    def note_release(self, name: str) -> None:
+        stack = self._held.get(threading.get_ident())
+        if stack:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == name:
+                    del stack[i]
+                    break
+
+    def note_attr(self, cls: str, attr: str, kind: str) -> None:
+        key = f"{kind}:{threading.current_thread().name}"
+        table = self.attr_threads.setdefault(cls, {})
+        accessors = table.get(attr)
+        if accessors is None:
+            with self._mu:
+                accessors = table.setdefault(attr, set())
+        accessors.add(key)
+
+    # ------------------------------------------------------------- checking
+
+    def cycles(self) -> List[List[str]]:
+        """Simple cycles in the observed order graph, as closed rings."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        for outs in adj.values():
+            outs.sort()
+        seen: Set[Tuple[str, ...]] = set()
+        found: List[List[str]] = []
+
+        def dfs(start: str, cur: str, path: List[str],
+                on_path: Set[str]) -> None:
+            for nxt in adj.get(cur, ()):
+                if nxt == start and len(path) > 1:
+                    pivot = path.index(min(path))
+                    canon = tuple(path[pivot:] + path[:pivot])
+                    if canon not in seen:
+                        seen.add(canon)
+                        found.append(list(canon) + [canon[0]])
+                elif nxt not in on_path and nxt > start:
+                    path.append(nxt)
+                    on_path.add(nxt)
+                    dfs(start, nxt, path, on_path)
+                    on_path.discard(nxt)
+                    path.pop()
+
+        for node in sorted(adj):
+            dfs(node, node, [node], {node})
+        return found
+
+    def check(self, out_dir: Optional[str] = None) -> List[List[str]]:
+        """Cycles observed so far; a non-empty result also writes
+        ``postmortem_lock_cycle.json`` into ``out_dir`` (default cwd)."""
+        cycles = self.cycles()
+        if cycles:
+            self.dump(out_dir or ".", cycles)
+        return cycles
+
+    def dump(self, out_dir: str, cycles: List[List[str]]) -> Optional[Path]:
+        """Flight-recorder-shaped postmortem; never raises (the witness
+        must not be able to fail the run twice)."""
+        try:
+            doc = {
+                "reason": "lock_cycle",
+                "written_at": time.time(),
+                "last_completed_step": None,
+                "num_events": len(self.edges),
+                "cycles": cycles,
+                "events": [
+                    {"kind": "lock_edge", "frm": a, "to": b,
+                     "thread": w["thread"]}
+                    for (a, b), w in sorted(self.edges.items())],
+                "attr_threads": {
+                    cls: {attr: sorted(v) for attr, v in table.items()}
+                    for cls, table in self.attr_threads.items()},
+            }
+            path = Path(out_dir) / "postmortem_lock_cycle.json"
+            tmp = path.with_name(path.name + ".tmp")
+            tmp.write_text(json.dumps(doc, indent=2))
+            tmp.rename(path)
+            return path
+        except Exception:  # noqa: BLE001 — diagnostics only
+            return None
+
+    def reset(self) -> None:
+        with self._mu:
+            self.edges.clear()
+            self._held.clear()
+            self.attr_threads.clear()
+
+
+# ------------------------------------------------------------- lock wrappers
+
+class WitnessedLock:
+    """``threading.Lock`` stand-in that reports acquire/release order to
+    a :class:`LockWitness`. Duck-types everything ``Condition`` needs
+    from a plain lock (its fallback ``_is_owned`` probe uses
+    ``acquire(False)``/``release`` — both routed through here)."""
+
+    _factory = staticmethod(_REAL_LOCK)
+
+    def __init__(self, witness: LockWitness, name: Optional[str] = None):
+        self._witness = witness
+        self._inner = self._factory()
+        self.name = name or _creation_site()[0]
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._witness.note_acquire(self.name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._witness.note_release(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name} inner={self._inner!r}>"
+
+
+class WitnessedRLock(WitnessedLock):
+    """RLock variant: re-entrant acquires stack in the witness (no
+    self-edges) and unwind on matching releases."""
+
+    _factory = staticmethod(_REAL_RLOCK)
+
+    def locked(self) -> bool:  # RLock has no .locked() before 3.12
+        if self._inner.acquire(blocking=False):
+            self._inner.release()
+            return False
+        return True
+
+
+# ------------------------------------------------------- install/uninstall
+
+_installed: Optional[LockWitness] = None
+_scope_roots: Tuple[str, ...] = ()
+
+
+def _in_scope(filename: str) -> bool:
+    return any(filename.startswith(root) for root in _scope_roots)
+
+
+def _lock_factory(witness: LockWitness, rlock: bool):
+    wrapper = WitnessedRLock if rlock else WitnessedLock
+    real = _REAL_RLOCK if rlock else _REAL_LOCK
+
+    def factory():
+        name, fn = _creation_site()
+        if not _in_scope(fn):
+            # stdlib/third-party lock: hand back the raw primitive —
+            # zero overhead, zero false edges outside the repo
+            return real()
+        return wrapper(witness, name)
+
+    return factory
+
+
+def install_witness(scope_roots: Optional[List[str]] = None) -> LockWitness:
+    """Patch ``threading.Lock``/``RLock`` so locks created from files
+    under ``scope_roots`` (default: this repo) are witnessed. Idempotent
+    — a second install returns the live witness."""
+    global _installed, _scope_roots
+    if _installed is not None:
+        return _installed
+    if scope_roots is None:
+        # dla_tpu/analysis/witness.py -> the repo root two levels up
+        scope_roots = [str(Path(__file__).resolve().parents[2])]
+    _scope_roots = tuple(os.path.abspath(r) for r in scope_roots)
+    _installed = LockWitness()
+    threading.Lock = _lock_factory(_installed, rlock=False)
+    threading.RLock = _lock_factory(_installed, rlock=True)
+    return _installed
+
+
+def uninstall_witness() -> None:
+    """Restore the raw primitives. Already-created witnessed locks keep
+    working (they hold their own inner lock)."""
+    global _installed
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _installed = None
+
+
+def get_witness() -> Optional[LockWitness]:
+    return _installed
+
+
+# ------------------------------------------------------ attribute watching
+
+_watched: Dict[type, Tuple] = {}
+
+
+def watch_attributes(cls: type, attrs: List[str],
+                     witness: Optional[LockWitness] = None) -> None:
+    """Record which threads read/write ``attrs`` on instances of
+    ``cls`` — the runtime analogue of ``unsynchronized-shared-state``.
+    Results land in :attr:`LockWitness.attr_threads` (and the
+    postmortem). Idempotent per class; :func:`unwatch_all` restores."""
+    w = witness or _installed
+    if w is None or cls in _watched:
+        return
+    names = frozenset(attrs)
+    orig_set = cls.__setattr__
+    orig_get = cls.__getattribute__
+
+    def _set(self, name, value):
+        if name in names:
+            w.note_attr(cls.__name__, name, "write")
+        orig_set(self, name, value)
+
+    def _get(self, name):
+        if name in names:
+            w.note_attr(cls.__name__, name, "read")
+        return orig_get(self, name)
+
+    _watched[cls] = (orig_set, orig_get)
+    cls.__setattr__ = _set
+    cls.__getattribute__ = _get
+
+
+def unwatch_all() -> None:
+    for cls, (orig_set, orig_get) in _watched.items():
+        cls.__setattr__ = orig_set
+        cls.__getattribute__ = orig_get
+    _watched.clear()

@@ -31,6 +31,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
+        # dla: disable=blocking-under-lock -- one-time lazy build: the lock exists precisely so a single caller pays the compile while the rest wait for the cached handle
         path = ensure_built()
         if path is None:
             return None

@@ -121,6 +121,7 @@ class AsyncCheckpointer(Checkpointer):
             self._thread.join()
             self._thread = None
         if self._error is not None:
+            # dla: disable=unsynchronized-shared-state -- read strictly after join(): the writer thread is dead, its _error store is ordered before join() returns
             err, self._error = self._error, None
             raise err
 
@@ -191,6 +192,7 @@ class AsyncCheckpointer(Checkpointer):
                 return
             except OSError as exc:
                 self.last_error = f"{type(exc).__name__}: {exc}"
+                # dla: disable=unsynchronized-shared-state -- advisory gauge: a float store is GIL-atomic and last_error_age_s only feeds a metric
                 self.last_error_time = time.monotonic()
                 if n >= self.max_retries:
                     raise

@@ -91,6 +91,7 @@ class PreemptionHandler:
 
     def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
         self._flag.set()
+        # dla: disable=unsynchronized-shared-state -- CPython signal handlers run on the main thread between bytecodes and must not take locks; the advisory counter tolerates a lost increment
         self.requests_total += 1
         if self.recorder is not None:
             # deque.append is async-signal-safe enough (atomic under the

@@ -64,6 +64,7 @@ class Watchdog:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # dla: disable=unsynchronized-shared-state -- deliberately lock-free: the hang monitor must never take locks; a raced monotonic store only shifts one poll deadline
         self._last_beat = time.monotonic()
         self._stop.clear()
         self._thread = threading.Thread(
@@ -84,10 +85,13 @@ class Watchdog:
         wants hang coverage INSIDE a step (the serving Supervisor —
         the engine may legitimately sit idle between open-loop
         arrivals) brackets the step with resume()/pause()."""
+        # dla: disable=unsynchronized-shared-state -- lock-free by design: a bool flip is GIL-atomic and the monitor re-reads it every poll
         self._armed = False
 
     def resume(self) -> None:
+        # dla: disable=unsynchronized-shared-state -- lock-free by design: a stale beat or armed flag costs at most one poll interval of coverage
         self._last_beat = time.monotonic()
+        # dla: disable=unsynchronized-shared-state -- lock-free by design: a bool flip is GIL-atomic and the monitor re-reads it every poll
         self._armed = True
 
     def _run(self) -> None:

@@ -26,8 +26,18 @@ current counter is the rollout's *staleness* in optimizer updates.
 
 One lock serializes all engine access (generator thread vs. the
 consumer's discard-regenerate path); the depth-1 queue is the
-backpressure that keeps the generator at most one rollout ahead.
+backpressure that keeps the generator at most one rollout ahead. A
+second, inner lock (``_state_lock``) guards the small cross-thread
+state — update/version counters, the pending-params handoff, the
+sample cache, the relayed error. Lock order is always ``_lock`` then
+``_state_lock``, never the reverse (the runtime lock witness checks
+this during the test suite). Params crossing the thread boundary are
+snapshotted (:meth:`RolloutPipeline._snapshot`): the learner's donated
+train step deletes the buffers a by-reference handoff would share.
 """
+# dla: disable-file=blocking-under-lock -- the engine lock exists to
+# serialize the slow refit+generate work (module docstring); the
+# consumer's wait point is the depth-1 queue, not the lock
 from __future__ import annotations
 
 import queue
@@ -78,6 +88,9 @@ class RolloutPipeline:
         # one lock for ALL engine access: the generator thread's
         # refit+generate vs. the consumer's discard-regenerate
         self._lock = threading.Lock()
+        # inner lock for the cross-thread counters/handoff below; always
+        # taken AFTER _lock (witnessed order), held only for field flips
+        self._state_lock = threading.Lock()
         self._q: "queue.Queue" = queue.Queue(maxsize=1)
         self._samples: Dict[int, Tuple] = {}
         self._updates = 0            # learner optimizer updates so far
@@ -95,12 +108,16 @@ class RolloutPipeline:
         optimizer update, or once per epoch loop with the count). In
         async mode optionally hand over the matching rollout params;
         the generator thread refits them before its NEXT generation."""
-        self._updates += int(n)
         if params is not None and self.mode == "async":
-            # sync mode refits inside get(); holding params here would
-            # just pin a dead tree
-            self._pending = (params, self._updates)
-        self.metrics.staleness.set(self._updates - self._version)
+            params = self._snapshot(params)
+        with self._state_lock:
+            self._updates += int(n)
+            if params is not None and self.mode == "async":
+                # sync mode refits inside get(); holding params here
+                # would just pin a dead tree
+                self._pending = (params, self._updates)
+            gap = self._updates - self._version
+        self.metrics.staleness.set(gap)
 
     def get(self, idx: int, params=None
             ) -> Tuple[Dict[str, jnp.ndarray], int]:
@@ -114,21 +131,25 @@ class RolloutPipeline:
             if params is not None:
                 with self._lock:
                     self._refitter.refit(params)
-                    self._version = self._updates
+                    with self._state_lock:
+                        self._version = self._updates
             return self._generate(sample), 0
 
         self._ensure_thread()
         if params is not None:
-            self._pending = (params, self._updates)
+            params = self._snapshot(params)
+            with self._state_lock:
+                self._pending = (params, self._updates)
         got_idx, out, version = self._q.get()
-        if self._error is not None:
-            raise RuntimeError("rollout generator thread failed") \
-                from self._error
+        with self._state_lock:
+            err = self._error
+            staleness = self._updates - version
+        if err is not None:
+            raise RuntimeError("rollout generator thread failed") from err
         if got_idx != idx:
             raise RuntimeError(
                 f"rollouts must be consumed in order: expected {idx}, "
                 f"generated {got_idx}")
-        staleness = self._updates - version
         self.metrics.staleness.set(staleness)
         if staleness > self.max_staleness_updates:
             # too far behind any correction we trust: drop it, refit the
@@ -138,8 +159,9 @@ class RolloutPipeline:
                 pend = self._take_pending()
                 if pend is not None:
                     self._refitter.refit(pend[0])
-                    self._version = pend[1]
-                out = self._generate(self._samples[idx])
+                    with self._state_lock:
+                        self._version = pend[1]
+                out = self._generate(self._sample(idx))
             return out, 0
         if staleness > 0:
             self.metrics.stale_rollouts.inc()
@@ -158,12 +180,31 @@ class RolloutPipeline:
             self._thread = None
         self.rollout.close()
 
+    @staticmethod
+    def _snapshot(params):
+        """Owned copy of a learner-shared tree for the async handoff.
+        The learner's train step donates its input params
+        (``donate_argnums``), deleting the old buffers in place — which
+        are exactly the buffers a by-reference handoff would leave the
+        generator thread reading through the engine mid-generation
+        ("Array has been deleted"). A per-leaf device copy (sharding-
+        preserving, so the refit jit fingerprints hold) makes the
+        pipeline the sole owner; it also makes ``donate_refit`` safe in
+        async mode, since the engine's old tree is never the learner's."""
+        return jax.tree.map(jnp.copy, params)
+
     # ------------------------------------------------------- generator side
 
     def _sample(self, idx: int) -> Tuple:
-        if idx not in self._samples:
-            self._samples[idx] = self.sample_fn(idx)
-        return self._samples[idx]
+        with self._state_lock:
+            if idx in self._samples:
+                return self._samples[idx]
+        # draw outside the lock: sample_fn is always reached from the
+        # single generating thread (class docstring), only the cache
+        # dict itself is shared
+        sample = self.sample_fn(idx)
+        with self._state_lock:
+            return self._samples.setdefault(idx, sample)
 
     def _generate(self, sample: Tuple) -> Dict[str, jnp.ndarray]:
         ids, mask, seeds = sample[:3]
@@ -171,14 +212,15 @@ class RolloutPipeline:
         return self.rollout.generate(ids, mask, seeds, max_new=max_new)
 
     def _take_pending(self) -> Optional[Tuple]:
-        pend, self._pending = self._pending, None
+        with self._state_lock:
+            pend, self._pending = self._pending, None
         return pend
 
     def _ensure_thread(self) -> None:
         if self._thread is not None:
             return
         self._thread = threading.Thread(
-            target=self._run, name="rollout-generator", daemon=True)
+            target=self._run, name="dla-rollout-generator", daemon=True)
         self._thread.start()
 
     def _run(self) -> None:
@@ -189,8 +231,10 @@ class RolloutPipeline:
                     pend = self._take_pending()
                     if pend is not None:
                         self._refitter.refit(pend[0])
-                        self._version = pend[1]
-                    version = self._version
+                    with self._state_lock:
+                        if pend is not None:
+                            self._version = pend[1]
+                        version = self._version
                     sample = self._sample(idx)
                     out = self._generate(sample)
                 self._next_idx += 1
@@ -201,7 +245,8 @@ class RolloutPipeline:
                     except queue.Full:
                         continue
         except BaseException as exc:       # surfaced at the next get()
-            self._error = exc
+            with self._state_lock:
+                self._error = exc
             try:
                 self._q.put_nowait((-1, None, 0))
             except queue.Full:
@@ -291,6 +336,12 @@ def build_rollout_pipeline(model, params, gen, sample_fn, *,
         over["prefix_cache"] = True
     cfg = ServingConfig(page_size=page, num_pages=num_pages,
                         num_slots=slots, max_model_len=max_len, **over)
+    if mode == "async":
+        # the engine's INITIAL tree has the same lifetime hazard as the
+        # per-update handoff (see RolloutPipeline._snapshot): the
+        # learner's first donated update deletes these buffers while
+        # the generator thread may still be decoding with them
+        params = RolloutPipeline._snapshot(params)
     rollout = RolloutEngine(model, params, gen, cfg,
                             samples_per_prompt=samples_per_prompt,
                             supervisor=supervisor, metrics=metrics)

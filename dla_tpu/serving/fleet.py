@@ -225,7 +225,7 @@ class _Member:
         self.sup = sup
         self.role = role               # prefill | decode | mixed
         self.pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"fleet-engine-{slot}")
+            max_workers=1, thread_name_prefix=f"dla-fleet-engine-{slot}")
         self.retiring = False          # scale-down in progress
 
     @property

@@ -16,6 +16,17 @@
 #   python -m tools.dla_lint --write-baseline tools/lint_baseline.json
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# the concurrency rules (docs/ANALYSIS.md) are part of the gate: fail
+# loudly if a refactor drops them from the registry instead of silently
+# linting without them
+rules="$(python -m tools.dla_lint --list-rules)"
+for rule in unsynchronized-shared-state lock-order-inversion \
+            blocking-under-lock conditional-collective; do
+    grep -q "^${rule} " <<<"$rules" || {
+        echo "lint.sh: rule '${rule}' missing from the registry" >&2
+        exit 1
+    }
+done
 python -m tools.dla_lint --format json \
     --baseline tools/lint_baseline.json --root . "$@"
 python tools/dla_doctor.py --self-check >&2

@@ -51,6 +51,28 @@ def pytest_configure(config):
         "the tier-1 `-m 'not slow'` run")
 
 
+@pytest.fixture(scope="session", autouse=True)
+def lock_witness(tmp_path_factory):
+    """Install the runtime lock witness (docs/ANALYSIS.md) for the whole
+    tier-1 run: every repo-created threading.Lock/RLock reports its
+    acquisition order, so the concurrency-heavy tests double as
+    lock-order probes. A cycle in the observed graph fails the session
+    and leaves postmortem_lock_cycle.json for tools/dla_doctor.py.
+    Disable with DLA_WITNESS=0."""
+    if os.environ.get("DLA_WITNESS", "1") == "0":
+        yield None
+        return
+    from dla_tpu.analysis.witness import install_witness, uninstall_witness
+    witness = install_witness()
+    yield witness
+    out = str(tmp_path_factory.mktemp("lock-witness"))
+    cycles = witness.check(out)
+    uninstall_witness()
+    assert not cycles, (
+        "runtime lock-order cycle observed during the test session "
+        f"(postmortem in {out}/postmortem_lock_cycle.json): {cycles}")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     import jax

@@ -36,7 +36,9 @@ from dla_tpu.analysis.report import (  # noqa: E402
 ALL_RULE_NAMES = {
     "retrace-hazard", "trace-side-effect", "host-sync-in-hot-loop",
     "donation-misuse", "pallas-tiling", "config-schema-drift",
-    "metric-name-drift",
+    "metric-name-drift", "unsynchronized-shared-state",
+    "lock-order-inversion", "blocking-under-lock",
+    "conditional-collective",
 }
 
 
@@ -453,6 +455,208 @@ def test_cli_write_then_apply_baseline(tmp_path, capsys):
                       "--baseline", str(base)]) == 0
     assert lint_main([str(bad), "--root", str(tmp_path),
                       "--baseline", str(tmp_path / "nope.json")]) == 2
+
+
+# ------------------------------------------ unsynchronized-shared-state
+
+def test_shared_state_fires_across_thread_roles(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._t = threading.Thread(
+                    target=self._worker, name="dla-pipe-worker", daemon=True)
+                self._t.start()
+
+            def _worker(self):
+                while True:
+                    self._count += 1
+
+            def read(self):
+                return self._count
+        """)
+    assert "unsynchronized-shared-state" in fired(r)
+
+
+def test_shared_state_silent_with_common_lock(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._t = threading.Thread(
+                    target=self._worker, name="dla-pipe-worker", daemon=True)
+                self._t.start()
+
+            def _worker(self):
+                while True:
+                    with self._lock:
+                        self._count += 1
+
+            def read(self):
+                with self._lock:
+                    return self._count
+        """)
+    assert "unsynchronized-shared-state" not in fired(r)
+
+
+def test_thread_roles_propagate_to_spawn_targets(tmp_path):
+    from dla_tpu.analysis.core import collect_files
+    from dla_tpu.analysis.threads import get_model
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent("""
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._t = threading.Thread(
+                    target=self._worker, name="dla-pipe-worker")
+
+            def _worker(self):
+                self._tick()
+
+            def _tick(self):
+                pass
+
+            def read(self):
+                return 1
+        """))
+    model = get_model(collect_files([p], root=tmp_path))
+    assert model.roles_of("m.py::Pipe._worker") == {"dla-pipe-worker"}
+    assert model.roles_of("m.py::Pipe._tick") == {"dla-pipe-worker"}
+    assert "main" in model.roles_of("m.py::Pipe.read")
+
+
+# ----------------------------------------------- lock-order-inversion
+
+def test_lock_order_inversion_fires_on_cycle_via_call_chain(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    self._locked_a()
+
+            def _locked_a(self):
+                with self._a:
+                    pass
+        """)
+    assert "lock-order-inversion" in fired(r)
+
+
+def test_lock_order_silent_with_consistent_order(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """)
+    assert "lock-order-inversion" not in fired(r)
+
+
+# ----------------------------------------------- blocking-under-lock
+
+def test_blocking_under_lock_fires_on_sleep(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def heartbeat():
+            with _lock:
+                time.sleep(0.5)
+        """)
+    assert "blocking-under-lock" in fired(r)
+
+
+def test_blocking_under_lock_silent_outside_region(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+        _beats = []
+
+        def heartbeat():
+            time.sleep(0.5)
+            with _lock:
+                _beats.append(1)
+        """)
+    assert "blocking-under-lock" not in fired(r)
+
+
+# --------------------------------------------- conditional-collective
+
+def test_conditional_collective_fires_on_rank_gated_barrier(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def publish(step):
+            if jax.process_index() == 0:
+                multihost_utils.sync_global_devices("publish")
+        """)
+    assert "conditional-collective" in fired(r)
+
+
+def test_conditional_collective_silent_when_hoisted(tmp_path):
+    r = lint_src(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def publish(step, manifest):
+            if jax.process_index() == 0:
+                manifest.write_text("ok")
+            multihost_utils.sync_global_devices("publish")
+        """)
+    assert "conditional-collective" not in fired(r)
+
+
+# ---------------------------------------------------- thread naming policy
+
+def test_every_repo_spawn_site_is_dla_named():
+    """Every thread/timer/executor the repo spawns carries an explicit
+    dla- prefixed name, so `py-spy`/`gdb` dumps and the lock witness
+    attribute work to a subsystem by name alone."""
+    from dla_tpu.analysis.core import collect_files
+    from dla_tpu.analysis.threads import get_model
+    model = get_model(collect_files(["dla_tpu", "tools"], root=REPO))
+    spawns = [s for s in model.spawns
+              if s.kind in ("thread", "timer", "executor")]
+    assert len(spawns) >= 7, "expected the repo's known spawn sites"
+    bad = sorted(f"{s.rel}:{s.line} name={s.name_source!r}"
+                 for s in spawns
+                 if not (s.name_source or "").startswith("dla-"))
+    assert not bad, "spawn sites without a dla- thread name:\n" \
+        + "\n".join(bad)
 
 
 # ----------------------------------------------------- the repo lints clean

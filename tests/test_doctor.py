@@ -124,6 +124,49 @@ def test_missing_capture_trace_is_called_out(tmp_path):
     assert "MISSING" in findings[0]["message"]
 
 
+def _lock_pm(tmp_path, cycles, step=None):
+    (tmp_path / "postmortem_lock_cycle.json").write_text(json.dumps({
+        "reason": "lock_cycle", "written_at": 0.0,
+        "last_completed_step": step, "num_events": 2, "cycles": cycles,
+        "events": [
+            {"kind": "lock_edge", "frm": "pipeline.py:88",
+             "to": "pipeline.py:91", "thread": "MainThread"},
+            {"kind": "lock_edge", "frm": "pipeline.py:91",
+             "to": "pipeline.py:88", "thread": "dla-rollout-generator"}],
+        "attr_threads": {}}))
+
+
+def test_lock_cycle_postmortem_is_an_error_finding(tmp_path):
+    _lock_pm(tmp_path,
+             [["pipeline.py:88", "pipeline.py:91", "pipeline.py:88"]])
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    top = findings[0]
+    assert top["rule"] == "lock-cycle" and top["severity"] == "error"
+    assert ("pipeline.py:88 -> pipeline.py:91 -> pipeline.py:88"
+            in top["message"])
+    assert top["data"]["edges"]    # the observed edges ride along
+
+
+def test_lock_cycle_explains_a_watchdog_hang(tmp_path):
+    _lock_pm(tmp_path, [["a", "b", "a"]])
+    _pm(tmp_path, events=[{"t": 1.0, "kind": "watchdog_hang", "step": 7}],
+        name="postmortem_hang.json")
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    assert findings[0]["rule"] == "lock-cycle"
+    assert "watchdog hang at step 7" in findings[0]["message"]
+
+
+def test_lock_cycle_with_step_is_a_correlatable_cause(tmp_path):
+    _lock_pm(tmp_path, [["a", "b", "a"]], step=7)
+    _pm(tmp_path, events=[],
+        anomaly={"trigger": "metric", "metric": "step_ms",
+                 "trigger_step": 7, "value": 900.0, "median": 12.0,
+                 "z": 50.0})
+    findings = diagnose(load_run(tmp_path), tmp_path)
+    corr = [f for f in findings if f["rule"] == "anomaly-correlated"]
+    assert corr and "runtime lock-order cycle" in corr[0]["message"]
+
+
 def test_unattributed_recompile_outranks_attributed(tmp_path):
     _pm(tmp_path, events=[
         {"t": 1.0, "kind": "compile", "step": 3, "fn": "decode",

@@ -324,6 +324,51 @@ def test_pipeline_async_staleness_bound(model_and_params, prompt_batch):
         pipe.close()
 
 
+def test_async_handoff_survives_learner_donation(model_and_params,
+                                                 prompt_batch):
+    """The trainer's jitted update donates its input params
+    (``donate_argnums=(0, 1)``), deleting the old buffers in place —
+    the very buffers a by-reference async handoff would leave the
+    generator thread reading mid-generation ("Array has been
+    deleted", reproduced via train_rlhf with ``mode: async``). Pin:
+    the pipeline snapshots every tree crossing the thread boundary,
+    so deleting the learner's copy after handoff changes nothing."""
+    model, params = model_and_params
+    ids, mask = prompt_batch
+    gen = GenerationConfig(max_new_tokens=MAX_NEW, do_sample=False,
+                           eos_token_id=2, pad_token_id=0)
+
+    def sample_fn(idx):
+        return ids, mask, derive_rollout_seeds(3000 + idx, len(ids))
+
+    # the learner's live tree: handed over, then "donated" (deleted)
+    learner_tree = jax.tree.map(jnp.copy, params)
+    pipe = build_rollout_pipeline(model, learner_tree, gen, sample_fn,
+                                  rows=len(ids),
+                                  prompt_width=ids.shape[1],
+                                  mode="async",
+                                  max_staleness_updates=1,
+                                  serving={"page_size": 4})
+    try:
+        out0, _ = pipe.get(0, params=learner_tree)
+        assert np.asarray(out0["response_tokens"]).shape[0] == len(ids)
+        _wait_queue_full(pipe)
+        pipe.notify_updates(1, params=learner_tree)
+        # the donated update step: the learner's old buffers die NOW,
+        # possibly while the generator is still decoding rollout 2
+        for leaf in jax.tree_util.tree_leaves(learner_tree):
+            leaf.delete()
+        out1, st1 = pipe.get(1)          # generated pre-update: stale 1
+        assert st1 == 1
+        # rollout 2's version snapshot races the notify (0 or 1, both in
+        # bound) — the pin is that generation proceeds on owned buffers
+        out2, st2 = pipe.get(2)
+        assert st2 <= 1
+        assert np.asarray(out2["response_mask"]).sum() > 0
+    finally:
+        pipe.close()
+
+
 def test_pipeline_rejects_unknown_mode(model_and_params, prompt_batch):
     model, params = model_and_params
     ids, mask = prompt_batch

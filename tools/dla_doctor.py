@@ -51,6 +51,7 @@ CAUSE_KINDS: Dict[str, Tuple[str, float]] = {
     "collective_timeout": ("collective deadline timeout", 3.5),
     "ckpt_save_start": ("checkpoint save", 3.0),
     "watchdog_hang": ("watchdog hang", 3.0),
+    "lock_cycle": ("runtime lock-order cycle", 3.2),
     "compile": ("XLA recompile", 2.5),
     "preempt_requested": ("preemption request", 2.5),
     "guard_bad_step": ("non-finite guard step", 2.5),
@@ -126,11 +127,17 @@ def _all_events(run: Dict[str, Any]) -> List[Dict]:
             if not isinstance(evt, dict):
                 continue
             key = (evt.get("t"), evt.get("kind"), evt.get("step"),
-                   evt.get("fn"))
+                   evt.get("fn"), evt.get("frm"), evt.get("to"))
             if key in seen:
                 continue
             seen.add(key)
             out.append(evt)
+        # a lock-witness postmortem that knows its step participates in
+        # cause correlation like any ring event (CAUSE_KINDS lock_cycle)
+        if pm.get("reason") == "lock_cycle" \
+                and pm.get("last_completed_step") is not None:
+            out.append({"kind": "lock_cycle",
+                        "step": pm["last_completed_step"]})
     return out
 
 
@@ -216,6 +223,7 @@ def diagnose(run: Dict[str, Any], run_dir: Path,
                 "anomaly-uncorrelated", block["_path"], 0, msg,
                 severity="warning", data={"anomaly": _public(block)})))
 
+    rows.extend(_lock_cycle_rows(run, events))
     rows.extend(_recompile_rows(events))
     rows.extend(_metric_rows(run))
     rows.extend(_bench_rows(run))
@@ -248,6 +256,35 @@ def _trace_note(block: Dict, run: Dict, run_dir: Path) -> str:
     if n < 0:
         return f"capture trace {name} unreadable"
     return f"capture trace {name} loadable ({n} events)"
+
+
+def _lock_cycle_rows(run: Dict[str, Any],
+                     events: List[Dict]) -> List[Tuple[float, Dict]]:
+    """Lock-witness postmortems (``postmortem_lock_cycle.json``): an
+    observed acquisition-order cycle is a deadlock waiting for its
+    interleaving. Ranked adjacent to ``watchdog_hang`` — and above it
+    when a hang is actually present, since the cycle explains it."""
+    out = []
+    hangs = [e for e in events if e.get("kind") == "watchdog_hang"
+             and e.get("step") is not None]
+    for pm in run["postmortems"]:
+        if pm.get("reason") != "lock_cycle":
+            continue
+        for cycle in pm.get("cycles") or [["?"]]:
+            msg = ("runtime lock witness observed acquisition-order "
+                   "cycle " + " -> ".join(cycle))
+            score = 9.5
+            if hangs:
+                msg += (" — likely cause of the watchdog hang at step "
+                        f"{hangs[0]['step']}")
+                score = 12.0
+            out.append((score, finding_row(
+                "lock-cycle", pm["_path"], 0, msg, severity="error",
+                data={"cycle": cycle,
+                      "edges": [e for e in pm.get("events", ())
+                                if isinstance(e, dict)
+                                and e.get("kind") == "lock_edge"][:20]})))
+    return out
 
 
 def _recompile_rows(events: List[Dict]) -> List[Tuple[float, Dict]]:
