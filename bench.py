@@ -1139,6 +1139,170 @@ def run_serving_resilience_bench() -> dict:
     }
 
 
+def run_serving_gateway_bench() -> dict:
+    """Gateway wire-overhead + federation chaos bench (serving.gateway
+    / serving.federation). Two passes on the same greedy trace:
+
+      1. retention — the trace in-process vs over localhost HTTP
+         through one streaming gateway (SSE per-token events); the
+         headline is wire tokens/s as a fraction of in-process
+         (>= 0.9 expected: serialization + loopback must not dominate
+         a CPU-sized decode)
+      2. chaos — the trace through a TWO-gateway federation with a
+         ``net=`` fault plan (delay, drop, disconnect mid-stream);
+         requests_lost MUST be 0 (dropped / disconnected streams are
+         replayed bit-identically from the router journal) and outputs
+         stay identical to in-process
+
+    Deterministic, CPU-sized, in-process (sockets on loopback only)."""
+    import http.client
+    import tempfile
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+    from dla_tpu.generation.engine import GenerationConfig
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.resilience.faults import FaultPlan
+    from dla_tpu.serving import (
+        FederatedRouter,
+        FederationConfig,
+        GossipBeater,
+        ServingConfig,
+        ServingEngine,
+        ServingGateway,
+    )
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=128, remat="none", dtype="float32",
+        param_dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    new_tokens = 8
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)
+    kw = dict(page_size=4, num_pages=64, num_slots=2, max_model_len=32,
+              max_prefill_batch=2, prefill_chunk=4, prefix_cache=True,
+              fault_plan="")
+
+    def make_engine():
+        return ServingEngine(model, params, gen, ServingConfig(**kw))
+
+    rs = np.random.RandomState(0)
+    prompts = [[int(t) for t in rs.randint(3, 500, (6,))]
+               for _ in range(8)]
+
+    def http_generate(port, prompt):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=300)
+        try:
+            conn.request("POST", "/v1/generate", json.dumps(
+                {"prompt": prompt, "max_new_tokens": new_tokens}
+            ).encode(), {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            toks = []
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                ev = json.loads(line[len(b"data: "):])
+                if ev.get("done"):
+                    break
+                toks.append(int(ev["token"]))
+            return toks
+        finally:
+            conn.close()
+
+    # compile-warm prompts: same length/count as the measured trace
+    # (covers the full prefill batch + both-slots decode shapes) but
+    # disjoint tokens, so the prefix cache stays cold for the clock
+    warm_prompts = [[1 + (i + j) % 2 for i in range(6)]
+                    for j in range(len(prompts))]
+
+    def drive_wire(port, batch):
+        """The trace over the wire with one concurrent client per
+        request — the engine batches exactly as the in-process arm."""
+        out = [None] * len(batch)
+
+        def client(i):
+            out[i] = http_generate(port, batch[i])
+        ts = [threading.Thread(target=client, args=(i,),
+                               name=f"dla-bench-gwclient-{i}",
+                               daemon=True)
+              for i in range(len(batch))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        return out
+
+    # pass 1: retention ------------------------------------------------
+    eng = make_engine()
+    for p in warm_prompts:             # compile warm, off the clock
+        eng.submit(p, new_tokens)
+    eng.run_until_drained()
+    t0 = time.perf_counter()
+    rids = [eng.submit(p, new_tokens) for p in prompts]
+    results = eng.run_until_drained(max_steps=5000)
+    dt_in = time.perf_counter() - t0
+    ref = [list(results[r].generated) for r in rids]
+    tokens = sum(len(o) for o in ref)
+
+    gw = ServingGateway(make_engine())
+    drive_wire(gw.port, warm_prompts)      # wire + compile warm
+    t0 = time.perf_counter()
+    wire = [list(o) for o in drive_wire(gw.port, prompts)]
+    dt_wire = time.perf_counter() - t0
+    gw.close()
+    retention = (tokens / dt_wire) / (tokens / dt_in)
+
+    # pass 2: federation chaos ----------------------------------------
+    gdir = tempfile.mkdtemp(prefix="dla-gw-bench-")
+    gws = [ServingGateway(make_engine()) for _ in range(2)]
+    beats = [GossipBeater(g, gdir, n) for g, n in zip(gws, "ab")]
+    plan = FaultPlan.parse(
+        "net=3:delay:0.01;net=5:drop;net=8:disconnect")
+    fed = FederatedRouter(gdir, FederationConfig(),
+                          fault_plan=plan)
+    deadline = time.monotonic() + 10
+    while len(fed.live_peers()) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    fids = [fed.submit(p, new_tokens) for p in prompts]
+    out = fed.results(timeout_s=300)
+    chaos = [out[f].tokens for f in fids]
+    lost = fed.requests_lost
+    for b in beats:
+        b.stop()
+    for g in gws:
+        g.close()
+
+    return {
+        "metric": "serving_gateway_wire_retention",
+        "value": round(retention, 4),
+        "unit": "x",
+        "detail": {
+            "tokens_per_s_in_process": round(tokens / dt_in, 1),
+            "tokens_per_s_wire": round(tokens / dt_wire, 1),
+            "wire_overhead_ms_per_token": round(
+                1e3 * (dt_wire - dt_in) / max(tokens, 1), 3),
+            "requests_lost": lost,
+            "requests_total": len(prompts),
+            "replayed_requests": fed.replayed,
+            "faults_injected": 3,
+            "outputs_identical_wire": bool(wire == ref),
+            "outputs_identical_chaos": bool(chaos == ref),
+            "new_tokens": new_tokens,
+            "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def run_resilience_bench() -> dict:
     """Recovery-overhead microbench for the fault-tolerance stack
     (dla_tpu/resilience): one tiny SFT run with an injected checkpoint
@@ -1673,7 +1837,7 @@ def _emit_and_maybe_extra() -> None:
     for fn in (run_ppo_bench, run_decode_bench, run_serving_bench,
                run_serving_prefix_bench, run_serving_spec_bench,
                run_serving_fleet_bench, run_serving_disagg_bench,
-               run_elastic_resilience_bench):
+               run_serving_gateway_bench, run_elastic_resilience_bench):
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — extras must not kill the line
@@ -1748,6 +1912,15 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_serving_disagg_bench()))
+        return 0
+    if "serving-gateway" in sys.argv[1:]:
+        # gateway wire-overhead + federation chaos target: same
+        # in-process forced-CPU pattern (loopback sockets only);
+        # headline is wire tokens/s retention (higher better), detail
+        # pins requests_lost to 0 under net= disconnect chaos
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_serving_gateway_bench()))
         return 0
     if "serving-resilience" in sys.argv[1:]:
         # supervised-serving chaos target: same in-process forced-CPU

@@ -66,6 +66,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "a prefill+decode role split with KV page "
                         "migration (equivalent to "
                         "latency.serving.disagg.enabled: true)")
+    p.add_argument("--gateway", action="store_true",
+                   help="also run the gateway wire A/B: the SAME "
+                        "Poisson trace in-process vs over localhost "
+                        "HTTP through the streaming gateway (SSE "
+                        "per-token events; equivalent to "
+                        "latency.serving.gateway.enabled: true)")
     return p.parse_args(argv)
 
 
@@ -743,6 +749,160 @@ def measure_overload(model, params, srv: Dict) -> Dict[str, object]:
     }
 
 
+def measure_gateway(model, params, srv: Dict) -> Dict[str, object]:
+    """Gateway A/B: the SAME Poisson trace driven in-process (arm A,
+    the engine stepped directly) and over localhost HTTP through the
+    streaming gateway (arm B, per-token SSE events read by client
+    threads). Reports both arms' client-observed TTFT/ITL percentiles,
+    the wire overhead per token, greedy bit-identity across arms, and
+    exercises a mid-trace client disconnect (the gateway must cancel
+    the orphaned request and count it)."""
+    import http.client
+    import threading
+
+    from dla_tpu.serving import ServingEngine, ServingGateway
+    from dla_tpu.serving.metrics import ServingMetrics
+
+    gwc = srv.get("gateway") or {}
+    n = int(gwc.get("num_requests", srv.get("num_requests", 16)))
+    rate = float(gwc.get("arrival_rate", srv.get("arrival_rate", 16.0)))
+    new_tokens = int(gwc.get("new_tokens", srv.get("new_tokens", 16)))
+    pmin = int(srv.get("prompt_len_min", 8))
+    pmax = int(srv.get("prompt_len_max", 64))
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)          # run to length
+    rs = np.random.RandomState(int(srv.get("seed", 0)))
+    vocab = model.cfg.vocab_size
+    prompts = [[int(t) for t in rs.randint(3, vocab - 1,
+                                           (rs.randint(pmin, pmax + 1),))]
+               for _ in range(n)]
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n))
+
+    def warm(eng):
+        slot_w = eng.cache.geom.slot_window
+        for width in sorted({eng.scheduler.bucket_width(len(p))
+                             for p in prompts}):
+            eng.submit([3 + (i % 251)
+                        for i in range(min(width, slot_w - 1))], 1)
+        eng.run_until_drained()
+        eng.metrics = ServingMetrics()
+
+    # ---- arm A: in-process (the measure_serving drive) --------------
+    eng = ServingEngine(model, params, gen, _serving_config(srv))
+    warm(eng)
+    dt_in, out_in = _drive_open_loop(eng, prompts, arrivals, new_tokens)
+    snap = eng.metrics.snapshot()
+
+    # ---- arm B: the same trace over localhost HTTP ------------------
+    gw = ServingGateway(ServingEngine(model, params, gen,
+                                      _serving_config(srv)))
+
+    def http_generate(prompt, events_out=None, stop_after=None):
+        """POST /v1/generate and read the SSE stream; returns the token
+        list, appending a perf_counter stamp per event to events_out.
+        ``stop_after=k`` closes the socket after k events (the
+        disconnect probe)."""
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                          timeout=300)
+        try:
+            conn.request("POST", "/v1/generate", json.dumps(
+                {"prompt": prompt, "max_new_tokens": new_tokens}
+            ).encode(), {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RuntimeError(f"generate -> {resp.status}")
+            toks = []
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                ev = json.loads(line[len(b"data: "):])
+                if ev.get("done"):
+                    break
+                toks.append(int(ev["token"]))
+                if events_out is not None:
+                    events_out.append(time.perf_counter())
+                if stop_after is not None and len(toks) >= stop_after:
+                    break               # hang up mid-stream
+            return toks
+        finally:
+            conn.close()
+
+    # warm every prefill bucket THROUGH the wire, off the clock (arm A
+    # was warmed the same way in-process)
+    slot_w = eng.cache.geom.slot_window
+    for width in sorted({eng.scheduler.bucket_width(len(p))
+                         for p in prompts}):
+        http_generate([3 + (i % 251)
+                       for i in range(min(width, slot_w - 1))])
+
+    out_wire: List[List[int]] = [None] * n
+    stamps: List[List[float]] = [[] for _ in range(n)]
+    t0 = time.perf_counter()
+
+    def client(i):
+        delay = t0 + arrivals[i] - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        out_wire[i] = http_generate(prompts[i], events_out=stamps[i])
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"dla-gwclient-{i}", daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    dt_wire = time.perf_counter() - t0
+
+    ttft = [1e3 * (stamps[i][0] - (t0 + arrivals[i]))
+            for i in range(n) if stamps[i]]
+    itl = [1e3 * (b - a) for ev in stamps
+           for a, b in zip(ev, ev[1:])]
+    total_tokens = sum(len(o or []) for o in out_wire)
+
+    # ---- disconnect probe: hang up mid-stream, gateway must cancel --
+    before = gw.metrics.registry.snapshot()[
+        "serving/gateway/disconnect_cancels"]
+    http_generate(prompts[0], stop_after=1)
+    deadline = time.perf_counter() + 30
+    cancels = before
+    while cancels <= before and time.perf_counter() < deadline:
+        time.sleep(0.05)
+        cancels = gw.metrics.registry.snapshot()[
+            "serving/gateway/disconnect_cancels"]
+    gw.close()
+
+    return {
+        "num_requests": n,
+        "arrival_rate": rate,
+        "new_tokens": new_tokens,
+        "duration_s_in_process": dt_in,
+        "duration_s_wire": dt_wire,
+        "tokens_per_s_in_process": total_tokens / dt_in,
+        "tokens_per_s_wire": total_tokens / dt_wire,
+        "ttft_ms_p50_in_process": snap["serving/ttft_ms_p50"],
+        "ttft_ms_p95_in_process": snap["serving/ttft_ms_p95"],
+        "ttft_ms_p99_in_process": snap["serving/ttft_ms_p99"],
+        "itl_ms_p50_in_process": snap["serving/itl_ms_p50"],
+        "itl_ms_p95_in_process": snap["serving/itl_ms_p95"],
+        "itl_ms_p99_in_process": snap["serving/itl_ms_p99"],
+        "ttft_ms_p50_wire": percentile(ttft, 50),
+        "ttft_ms_p95_wire": percentile(ttft, 95),
+        "ttft_ms_p99_wire": percentile(ttft, 99),
+        "itl_ms_p50_wire": percentile(itl, 50),
+        "itl_ms_p95_wire": percentile(itl, 95),
+        "itl_ms_p99_wire": percentile(itl, 99),
+        "wire_overhead_ms_per_token":
+            1e3 * (dt_wire - dt_in) / max(total_tokens, 1),
+        "outputs_identical": out_wire == out_in,
+        "disconnect_cancelled": cancels > before,
+    }
+
+
 def main(argv=None) -> None:
     args = parse_args(argv)
     config = load_config(args.config)
@@ -854,6 +1014,23 @@ def main(argv=None) -> None:
                     f"{dsg['fleet_disagg']['migration']['migrated_pages']:.0f}"
                     f" pages, outputs identical: "
                     f"{dsg['outputs_identical']}")
+            if args.gateway or \
+                    (srv.get("gateway") or {}).get("enabled", False):
+                entry["gateway"] = measure_gateway(
+                    bundle.model, bundle.params, srv)
+                gwr = entry["gateway"]
+                log_rank_zero(
+                    f"[dla_tpu][latency] gateway: ttft p95 "
+                    f"{gwr['ttft_ms_p95_wire']:.1f} ms wire vs "
+                    f"{gwr['ttft_ms_p95_in_process']:.1f} ms "
+                    f"in-process, itl p50 "
+                    f"{gwr['itl_ms_p50_wire']:.2f} vs "
+                    f"{gwr['itl_ms_p50_in_process']:.2f} ms, wire "
+                    f"overhead "
+                    f"{gwr['wire_overhead_ms_per_token']:.3f} "
+                    f"ms/token, outputs identical: "
+                    f"{gwr['outputs_identical']}, disconnect "
+                    f"cancelled: {gwr['disconnect_cancelled']}")
             if args.speculative or \
                     (srv.get("speculative") or {}).get("enabled", False):
                 entry["speculative"] = measure_speculative(

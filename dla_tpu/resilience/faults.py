@@ -88,11 +88,29 @@ slow            host H's lease step lags by ``arg`` (a one-shot
                 unless the lag reaches the TTL)
 ==============  ===================================================
 
-The four scopes are disjoint: ``take(kind, step)`` only matches
+Network scope: entries prefixed ``net=`` arm against the federation
+wire client's monotone HTTP-operation counter (serving.federation) —
+one poll per wire op, so ``net=3:disconnect`` fires on the third
+network operation the client performs::
+
+    DLA_FAULT_PLAN="net=3:disconnect;net=5:delay:0.05"
+
+==============  ===================================================
+drop            the wire op is never sent: the client raises as if
+                the peer were unreachable (exercises re-placement)
+delay           the wire op sleeps ``arg`` seconds (default 0.05)
+                before sending — injected network latency
+disconnect      the connection closes mid-stream after the op
+                starts (a half-received token stream), exercising
+                the zero-loss replay path
+==============  ===================================================
+
+The five scopes are disjoint: ``take(kind, step)`` only matches
 ``step=`` entries, ``take(kind, step, site="engine_step")`` only
-matches ``engine_step=`` entries, and likewise ``site="rollout_step"``
-and ``site="host"`` — so a co-located trainer, engine, rollout loop,
-and gang monitor can share one plan string.
+matches ``engine_step=`` entries, and likewise ``site="rollout_step"``,
+``site="host"``, and ``site="net"`` — so a co-located trainer, engine,
+rollout loop, gang monitor, and federation client can share one plan
+string.
 """
 from __future__ import annotations
 
@@ -117,8 +135,14 @@ ROLLOUT_KINDS = ("device_error", "nan_logits", "wedge")
 # polled by the elastic GangMonitor's simulated-pod beat
 HOST_KINDS = ("lost", "slow")
 
+# network-scoped kinds, legal only behind a ``net=`` prefix: polled by
+# the federation wire client (serving.federation) once per HTTP
+# operation, armed against its monotone wire-op counter
+NET_KINDS = ("drop", "delay", "disconnect")
+
 _SITE_KINDS = {"step": KNOWN_KINDS, "engine_step": SERVING_KINDS,
-               "rollout_step": ROLLOUT_KINDS, "host": HOST_KINDS}
+               "rollout_step": ROLLOUT_KINDS, "host": HOST_KINDS,
+               "net": NET_KINDS}
 
 
 @dataclasses.dataclass

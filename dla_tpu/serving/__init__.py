@@ -26,12 +26,31 @@ Modules:
              MigrationTicket, install on another engine, resume
              bit-identically (docs/SERVING.md "Disaggregated
              prefill/decode")
+  gateway    ServingGateway: stdlib HTTP front door — POST /v1/generate
+             with per-token SSE streaming, disconnect -> cancel,
+             shed -> 429 / deadline -> 408 / draining -> 503
+             (docs/SERVING.md "Gateway & federation")
+  federation GossipBeater + FederatedRouter: cross-host placement over
+             N gateway-fronted fleets with the FleetRouter score,
+             replay-on-failure zero loss, MigrationTicket wire handoff
 """
+from dla_tpu.serving.federation import (
+    FederatedRouter,
+    FederationConfig,
+    FederationError,
+    FederationMetrics,
+    GossipBeater,
+)
 from dla_tpu.serving.fleet import (
     Autoscaler,
     FleetConfig,
     FleetMetrics,
     FleetRouter,
+)
+from dla_tpu.serving.gateway import (
+    GatewayConfig,
+    GatewayMetrics,
+    ServingGateway,
 )
 from dla_tpu.serving.kv_blocks import (
     PageAllocator,
@@ -75,9 +94,16 @@ __all__ = [
     "CircuitBreaker",
     "DegradationLadder",
     "DeviceStepError",
+    "FederatedRouter",
+    "FederationConfig",
+    "FederationError",
+    "FederationMetrics",
     "FleetConfig",
     "FleetMetrics",
     "FleetRouter",
+    "GatewayConfig",
+    "GatewayMetrics",
+    "GossipBeater",
     "KVMigrator",
     "MigrationConfig",
     "MigrationError",
@@ -93,6 +119,7 @@ __all__ = [
     "SchedulerConfig",
     "ServingConfig",
     "ServingEngine",
+    "ServingGateway",
     "ServingMetrics",
     "ShedConfig",
     "Supervisor",

@@ -1,0 +1,507 @@
+"""Cross-host fleet federation: place requests onto N remote
+gateway-fronted fleets with the same cache-aware score ``FleetRouter``
+uses locally.
+
+Topology: each serving pod runs a ``ServingGateway`` (serving.gateway)
+plus a :class:`GossipBeater` that heartbeats into a shared gossip
+directory — the lease-file idiom from ``resilience/elastic.py``
+(write-aside + atomic rename, monotone sequence numbers). A
+:class:`FederatedRouter` on any host scans the directory to discover
+peers, treats a peer whose beat went quiet past the TTL as stale
+(counted on ``serving/federation/stale_peers``, never placed on), and
+scores live peers per request with the SAME inputs the in-process
+router uses: peeked prefix-cache hit fraction (``POST /v1/peek``, the
+per-prompt signal gossip cannot ship) and pressure, combined as
+``prefix_weight * hit - load_weight * pressure`` with sticky family
+affinity.
+
+Zero-loss contract: the router journals every submission (prompt +
+sampling — exactly the Supervisor's replay state, because per-request
+``fold_in(seed, k)`` sampling is history-free). A fleet that dies
+mid-stream just costs a replay: the request is re-placed on a live
+peer and regenerates the IDENTICAL token stream, so nothing a client
+was promised is ever lost. Mid-decode requests can also move without
+recompute: ``migrate()`` ships the serialized ``MigrationTicket``
+(``/v1/migrate_out`` -> ``/v1/migrate_in``, counted on
+``serving/federation/handoff_bytes``) and the stream re-attaches on
+the target, bit-identical.
+
+Fault injection: every wire operation polls the ``net=`` scope of a
+:class:`~dla_tpu.resilience.faults.FaultPlan` (drop / delay /
+disconnect) against a monotone wire-op counter, so chaos benches and
+tests drive the replay machinery deterministically.
+"""
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from dla_tpu.resilience.faults import FaultPlan
+from dla_tpu.telemetry.registry import MetricRegistry
+
+
+class FederationError(RuntimeError):
+    """A wire operation against a peer fleet failed or was refused."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FederationConfig:
+    """Cross-fleet routing knobs. The score weights default to the
+    in-process ``FleetConfig`` values — federation is the same policy
+    one network hop up."""
+
+    prefix_weight: float = 2.0
+    load_weight: float = 1.0
+    sticky_bonus: float = 0.5
+    lease_ttl_s: float = 3.0           # beat older than this -> stale
+    beat_interval_s: float = 0.25
+    wire_timeout_s: float = 120.0      # per-op socket timeout
+    place_timeout_s: float = 60.0      # total wait for any live peer
+    max_replays: int = 4               # per-request re-placements
+
+
+class FederationMetrics:
+    """The ``serving/federation/*`` panel, owned by the router's own
+    registry (which outlives every remote fleet)."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        r = self.registry = registry or MetricRegistry()
+        self.gossip_beats = r.counter("serving/federation/gossip_beats")
+        self.routed_remote = r.counter(
+            "serving/federation/routed_remote")
+        self.handoff_bytes = r.counter(
+            "serving/federation/handoff_bytes")
+        self.stale_peers = r.counter("serving/federation/stale_peers")
+
+    def snapshot(self) -> Dict[str, float]:
+        return self.registry.snapshot()
+
+
+def write_beat(gossip_dir, name: str, url: str, seq: int,
+               pressure: float, draining: bool) -> None:
+    """One gossip heartbeat, atomically (write-aside + ``os.replace``,
+    the elastic lease idiom): readers never see a torn beat."""
+    gossip_dir = Path(gossip_dir)
+    gossip_dir.mkdir(parents=True, exist_ok=True)
+    path = gossip_dir / f"peer_{name}.json"
+    tmp = gossip_dir / f".peer_{name}.tmp"
+    tmp.write_text(json.dumps({
+        "name": name, "url": url, "seq": int(seq),
+        "time": time.time(), "pressure": float(pressure),
+        "draining": bool(draining)}))
+    os.replace(tmp, path)
+
+
+class GossipBeater:
+    """Background heartbeat for one gateway: advertises its URL and
+    pressure into the gossip directory every ``beat_interval_s`` until
+    stopped (or the process dies — which is exactly what the TTL
+    detects on the reader side)."""
+
+    def __init__(self, gateway, gossip_dir, name: str,
+                 cfg: Optional[FederationConfig] = None):
+        self.gateway = gateway
+        self.gossip_dir = Path(gossip_dir)
+        self.name = name
+        self.cfg = cfg or FederationConfig()
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="dla-federation-beat", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            gw = self.gateway
+            try:
+                with gw._lock:
+                    _, pressure = gw.peek([])
+                self._seq += 1
+                write_beat(self.gossip_dir, self.name, gw.url,
+                           self._seq, pressure, gw.draining)
+            except Exception:  # noqa: BLE001 — a failed beat is a
+                pass           # missed heartbeat, not a crash
+            self._stop.wait(self.cfg.beat_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+@dataclasses.dataclass
+class FedRequest:
+    """One federated request: the journaled replay state plus the
+    stream collected so far."""
+    fid: int
+    prompt_tokens: List[int]
+    max_new_tokens: int
+    sampling: Optional[dict]           # SamplingParams fields or None
+    priority: int
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    logprobs: List[float] = dataclasses.field(default_factory=list)
+    state: str = "pending"
+    peer: Optional[str] = None         # current serving peer name
+    remote_rid: Optional[int] = None
+    replays: int = 0
+    handoff: Optional[Tuple[str, int]] = None   # (peer name, new rid)
+    handoff_event: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+
+class FederatedRouter:
+    """Top-level request placement across gateway-fronted fleets.
+
+    Each ``submit`` runs on its own reader thread: place -> stream ->
+    (replay on wire failure | re-attach after a migrate) -> terminal.
+    ``results()`` joins every thread and returns the collected
+    streams. ``requests_lost`` MUST be 0 after any chaos run — that is
+    the acceptance bar this class exists to clear."""
+
+    def __init__(self, gossip_dir,
+                 cfg: Optional[FederationConfig] = None,
+                 registry: Optional[MetricRegistry] = None,
+                 fault_plan: Optional[FaultPlan] = None):
+        self.gossip_dir = Path(gossip_dir)
+        self.cfg = cfg or FederationConfig()
+        self.metrics = FederationMetrics(registry)
+        self.plan = fault_plan or FaultPlan()
+        self.replayed = 0
+        self._lock = threading.Lock()
+        self._op_lock = threading.Lock()
+        self._wire_ops = 0
+        self._peers: Dict[str, dict] = {}
+        self._affinity: Dict[Tuple[int, ...], str] = {}
+        self._requests: Dict[int, FedRequest] = {}
+        self._threads: Dict[int, threading.Thread] = {}
+        self._next_fid = 0
+
+    # ------------------------------------------------------------- gossip
+
+    def refresh_peers(self) -> None:
+        """Scan the gossip directory; a beat with a new sequence number
+        re-stamps the peer's local freshness clock (cross-process wall
+        clocks are not comparable; monotone seqs + a local monotonic
+        stamp are)."""
+        now = time.monotonic()
+        docs = []                          # read beats OUTSIDE the lock
+        for path in sorted(self.gossip_dir.glob("peer_*.json")):
+            try:
+                docs.append(json.loads(path.read_text()))
+            except (OSError, ValueError):
+                pass                       # torn/unlinked beat: skip
+        with self._lock:
+            for doc in docs:
+                name = doc.get("name")
+                if not name:
+                    continue
+                prev = self._peers.get(name)
+                if prev is None or doc["seq"] > prev["seq"]:
+                    doc["_seen"] = now
+                    self._peers[name] = doc
+                    self.metrics.gossip_beats.inc()
+
+    def live_peers(self) -> List[dict]:
+        """Fresh, non-draining peers; stale ones are counted and
+        skipped (never placed on)."""
+        self.refresh_peers()
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for name in sorted(self._peers):
+                doc = self._peers[name]
+                if now - doc["_seen"] > self.cfg.lease_ttl_s:
+                    self.metrics.stale_peers.inc()
+                    continue
+                if doc.get("draining"):
+                    continue
+                out.append(dict(doc))
+        return out
+
+    # --------------------------------------------------------- wire layer
+
+    def _net_op(self) -> int:
+        """One wire operation: poll the ``net=`` fault scope against
+        the monotone op counter (drop raises here; delay sleeps;
+        disconnect is polled separately mid-stream). Returns the op
+        number so callers never re-read the counter unsynchronized."""
+        with self._op_lock:
+            self._wire_ops += 1
+            op = self._wire_ops
+        if self.plan.take("drop", op, site="net") is not None:
+            raise FederationError(f"injected net drop at op {op}")
+        delay = self.plan.take("delay", op, site="net")
+        if delay is not None:
+            time.sleep(delay.arg if delay.arg is not None else 0.05)
+        return op
+
+    def _connect(self, url: str) -> http.client.HTTPConnection:
+        u = urlparse(url)
+        return http.client.HTTPConnection(
+            u.hostname, u.port, timeout=self.cfg.wire_timeout_s)
+
+    def _post_json(self, url: str, path: str, obj) -> dict:
+        self._net_op()
+        conn = self._connect(url)
+        try:
+            conn.request("POST", path, json.dumps(obj).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise FederationError(
+                    f"POST {path} -> {resp.status}: {body[:200]!r}")
+            return json.loads(body)
+        finally:
+            conn.close()
+
+    def _post_raw(self, url: str, path: str, obj) -> bytes:
+        self._net_op()
+        conn = self._connect(url)
+        try:
+            body = (obj if isinstance(obj, (bytes, bytearray))
+                    else json.dumps(obj).encode())
+            ctype = ("application/octet-stream"
+                     if isinstance(obj, (bytes, bytearray))
+                     else "application/json")
+            conn.request("POST", path, body, {"Content-Type": ctype})
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status != 200:
+                raise FederationError(
+                    f"POST {path} -> {resp.status}: {raw[:200]!r}")
+            return raw
+        finally:
+            conn.close()
+
+    # ---------------------------------------------------------- placement
+
+    def _family(self, prompt: List[int]) -> Tuple[int, ...]:
+        return tuple(prompt[:16])
+
+    def _place(self, fr: FedRequest) -> Optional[dict]:
+        """Best live peer for this prompt: the FleetRouter score over
+        peeked hit-frac and pressure, sticky family affinity, name
+        tie-break. None when no live peer answers."""
+        peers = self.live_peers()
+        with self._lock:
+            sticky = self._affinity.get(self._family(fr.prompt_tokens))
+        scored = []
+        for doc in peers:
+            try:
+                pk = self._post_json(doc["url"], "/v1/peek",
+                                     {"prompt": fr.prompt_tokens})
+            except (OSError, http.client.HTTPException,
+                    FederationError):
+                continue               # unreachable despite a fresh
+            if pk.get("draining"):     # beat: treat as dead this pass
+                continue
+            hit = float(pk.get("hit_frac") or 0.0)
+            if doc["name"] == sticky:
+                hit = max(hit, self.cfg.sticky_bonus)
+            score = (self.cfg.prefix_weight * hit
+                     - self.cfg.load_weight
+                     * float(pk.get("pressure") or 0.0))
+            scored.append((score, doc))
+        if not scored:
+            return None
+        scored.sort(key=lambda t: (-t[0], t[1]["name"]))
+        best = scored[0][1]
+        with self._lock:
+            self._affinity[self._family(fr.prompt_tokens)] = \
+                best["name"]
+        self.metrics.routed_remote.inc()
+        return best
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, prompt_tokens: List[int], max_new_tokens: int,
+               sampling: Optional[dict] = None,
+               priority: int = 0) -> int:
+        """Journal + launch one federated request; returns its fid.
+        ``sampling`` is the SamplingParams field dict (an explicit seed
+        makes the stream peer-independent; greedy always is)."""
+        with self._lock:
+            fid = self._next_fid
+            self._next_fid += 1
+            fr = FedRequest(
+                fid=fid, prompt_tokens=[int(t) for t in prompt_tokens],
+                max_new_tokens=int(max_new_tokens),
+                sampling=dict(sampling) if sampling else None,
+                priority=int(priority))
+            self._requests[fid] = fr
+            t = threading.Thread(target=self._serve_request, args=(fr,),
+                                 name=f"dla-federation-req-{fid}",
+                                 daemon=True)
+            self._threads[fid] = t
+        t.start()
+        return fid
+
+    # --------------------------------------------------------- the reader
+
+    def _serve_request(self, fr: FedRequest) -> None:
+        deadline = time.monotonic() + self.cfg.place_timeout_s
+        while True:
+            peer = self._place(fr)
+            if peer is None:
+                if time.monotonic() > deadline:
+                    fr.state = "lost"
+                    return
+                time.sleep(0.1)
+                continue
+            try:
+                final = self._stream_generate(peer, fr)
+                while final == "migrated":
+                    final = self._resume_after_handoff(fr)
+            except (OSError, http.client.HTTPException,
+                    FederationError):
+                # the peer died (or chaos said it did) mid-request:
+                # drop the partial stream and replay from the journal —
+                # fold_in(seed, k) sampling regenerates the identical
+                # tokens on any peer
+                with self._lock:
+                    fr.tokens, fr.logprobs = [], []
+                    fr.peer = fr.remote_rid = None
+                    fr.replays += 1
+                    self.replayed += 1
+                if fr.replays > self.cfg.max_replays:
+                    fr.state = "lost"
+                    return
+                deadline = time.monotonic() + self.cfg.place_timeout_s
+                continue
+            fr.state = final
+            return
+
+    def _read_events(self, resp, fr: FedRequest,
+                     disconnect_after: Optional[int]) -> str:
+        """Append streamed token events to ``fr`` until the done event;
+        returns its state. A closed/injured socket raises."""
+        n_events = 0
+        while True:
+            line = resp.readline()
+            if not line:
+                raise FederationError("stream closed before done event")
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            try:
+                ev = json.loads(line[len(b"data: "):])
+            except ValueError as exc:   # half-written line: the peer
+                raise FederationError(  # died mid-event -> replay
+                    f"torn event line: {exc}") from exc
+            if ev.get("done"):
+                return str(ev.get("state"))
+            with self._lock:
+                fr.tokens.append(int(ev["token"]))
+                fr.logprobs.append(float(ev["logprob"]))
+            n_events += 1
+            if disconnect_after is not None \
+                    and n_events >= disconnect_after:
+                raise FederationError(
+                    "injected net disconnect mid-stream")
+
+    def _stream_generate(self, peer: dict, fr: FedRequest) -> str:
+        op = self._net_op()
+        disconnect = self.plan.take("disconnect", op, site="net")
+        conn = self._connect(peer["url"])
+        try:
+            conn.request("POST", "/v1/generate", json.dumps({
+                "prompt": fr.prompt_tokens,
+                "max_new_tokens": fr.max_new_tokens,
+                "sampling": fr.sampling,
+                "priority": fr.priority,
+            }).encode(), {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise FederationError(
+                    f"generate on {peer['name']} -> {resp.status}: "
+                    f"{resp.read()[:200]!r}")
+            with self._lock:
+                fr.peer = peer["name"]
+                rid = resp.headers.get("X-DLA-Rid")
+                fr.remote_rid = int(rid) if rid is not None else None
+            return self._read_events(
+                resp, fr,
+                disconnect_after=1 if disconnect is not None else None)
+        finally:
+            conn.close()
+
+    def _resume_after_handoff(self, fr: FedRequest) -> str:
+        """The source stream ended with ``migrated``: wait for
+        ``migrate()`` to publish the target, then re-attach with a
+        catch-up from the tokens we already hold."""
+        if not fr.handoff_event.wait(timeout=self.cfg.wire_timeout_s):
+            raise FederationError(
+                f"fid {fr.fid}: stream migrated away but no handoff "
+                "target was published")
+        with self._lock:
+            peer_name, rid = fr.handoff
+            fr.handoff = None
+            fr.handoff_event.clear()
+            fr.peer, fr.remote_rid = peer_name, rid
+            have = len(fr.tokens)
+            url = self._peers[peer_name]["url"]
+        self._net_op()
+        conn = self._connect(url)
+        try:
+            conn.request("GET", f"/v1/stream?rid={rid}&have={have}")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise FederationError(
+                    f"stream attach on {peer_name} -> {resp.status}")
+            return self._read_events(resp, fr, disconnect_after=None)
+        finally:
+            conn.close()
+
+    # ------------------------------------------------------------ handoff
+
+    def migrate(self, fid: int, target_name: str) -> int:
+        """Move a mid-decode request to ``target_name`` via the
+        serialized MigrationTicket wire format; the reader thread
+        re-attaches on the target. Returns the new remote rid."""
+        with self._lock:
+            fr = self._requests[fid]
+            src_name, rid = fr.peer, fr.remote_rid
+            if src_name is None or rid is None:
+                raise FederationError(f"fid {fid} is not streaming yet")
+            src_url = self._peers[src_name]["url"]
+            dst_url = self._peers[target_name]["url"]
+        blob = self._post_raw(src_url, "/v1/migrate_out", {"rid": rid})
+        self.metrics.handoff_bytes.inc(len(blob))
+        ack = json.loads(self._post_raw(dst_url, "/v1/migrate_in", blob))
+        with self._lock:
+            fr.handoff = (target_name, int(ack["rid"]))
+            fr.handoff_event.set()
+        return int(ack["rid"])
+
+    # ------------------------------------------------------------ results
+
+    @property
+    def requests_lost(self) -> int:
+        with self._lock:
+            return sum(1 for fr in self._requests.values()
+                       if fr.state == "lost")
+
+    def results(self, timeout_s: float = 600.0) -> Dict[int, FedRequest]:
+        """Join every reader thread; returns fid -> FedRequest."""
+        deadline = time.monotonic() + timeout_s
+        for fid, t in list(self._threads.items()):
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                raise FederationError(
+                    f"fid {fid} still streaming after {timeout_s}s")
+        with self._lock:
+            return dict(self._requests)
+
+    def drain_peer(self, name: str) -> None:
+        """Ask one peer to drain (its /healthz flips to 503 and its
+        gossip beats start carrying draining=True)."""
+        with self._lock:
+            url = self._peers[name]["url"]
+        self._post_json(url, "/admin/drain", {})

@@ -459,6 +459,82 @@ class FleetRouter:
                 return m.sup.result(rid)
         return self._archive[rid]
 
+    def cancel(self, rid: int, reason: str = "cancelled") -> Request:
+        """Client-initiated cancellation, routed to whichever member
+        currently owns the request (handoffs move ownership)."""
+        member = self._placement.get(rid)
+        if member is None or rid not in member.sup.journal:
+            member = next((m for m in self.members()
+                           if rid in m.sup.journal), None)
+        if member is None:
+            return self._archive[rid]
+        return member.sup.cancel(rid, reason)
+
+    def export_request(self, rid: int):
+        """Export ``rid``'s resumable state for a CROSS-FLEET handoff
+        (the gateway's ``/v1/migrate_out``): the owning member's journal
+        entry is popped and its engine copy released — from here the
+        serialized ticket IS the request, and the shipper owns replay
+        if the remote install fails (FederatedRouter journals prompts
+        for exactly that). Raises :class:`MigrationError` when the
+        request is not resumable in place."""
+        member = self._placement.get(rid)
+        if member is None or rid not in member.sup.journal:
+            member = next((m for m in self.members()
+                           if rid in m.sup.journal), None)
+        if member is None:
+            raise MigrationError(f"request {rid} is not on this fleet")
+        ticket = self.migrator.export_ticket(
+            member.engine, rid, src_slot=member.slot)
+        entry = member.sup.journal.pop(rid, None)
+        member.engine.release_migrated(rid)
+        if entry is not None:
+            self._archive[rid] = entry.request
+        self._placement.pop(rid, None)
+        return ticket
+
+    def import_request(self, ticket) -> Request:
+        """Install a cross-fleet ticket onto the least-pressured
+        decode-capable member, journaled for replay like any local
+        submission (the exactly-once discipline of
+        ``_migrate_request``, with the source on another host)."""
+        from dla_tpu.serving.resilience import JournalEntry
+        candidates = [m for m in self.members()
+                      if m.accepting() and m.role != "prefill"]
+        if self._draining or not candidates:
+            raise MigrationError(
+                "fleet is draining: no member accepts an import")
+        dst = min(candidates,
+                  key=lambda m: (self.member_pressure(m), m.slot))
+        req = self.migrator.install(dst.engine, ticket)
+        dst.sup.journal[req.rid] = JournalEntry(
+            prompt_tokens=list(req.prompt_tokens),
+            max_new_tokens=int(req.max_new_tokens),
+            priority=req.priority, arrival_time=req.arrival_time,
+            deadline=req.deadline, streamed=list(req.generated),
+            done=req.state in TERMINAL_STATES, request=req,
+            sampling=req.sampling,
+            streamed_logps=list(req.generated_logprobs),
+            migrated_from=ticket.src_slot, migrations=1)
+        self._placement[req.rid] = dst
+        self._affinity[self._family(list(req.prompt_tokens))] = dst.slot
+        return req
+
+    def peek_score(self, prompt_tokens: List[int]) -> Tuple[float, float]:
+        """-> (best peeked hit-frac, mean member pressure) over the
+        accepting members — the gateway's ``/v1/peek`` surface, so a
+        FederatedRouter scores this fleet with the same inputs
+        ``_choose`` uses locally."""
+        candidates = [m for m in self.members()
+                      if m.accepting() and m.role != "decode"]
+        if self._draining or not candidates:
+            return 0.0, 1.0
+        n = max(1, len(prompt_tokens))
+        hit = max(self._peek(m, prompt_tokens) / n for m in candidates)
+        pressure = float(np.mean(
+            [self.member_pressure(m) for m in candidates]))
+        return hit, pressure
+
     def results(self) -> Dict[int, Request]:
         out = dict(self._archive)
         for m in self.members():

@@ -1,0 +1,608 @@
+"""Streaming HTTP front door for a serving engine or fleet.
+
+``ServingGateway`` turns the in-process engine-shaped API (``submit`` /
+``step`` / ``result``) into a network service — the ``MetricsHTTPServer``
+idiom grown up: one stdlib ``ThreadingHTTPServer`` (no new deps, handler
+threads carry the ``dla-`` name prefix) in front of ONE engine-stepping
+thread, so the engine's single-threaded discipline is preserved while
+any number of HTTP clients stream concurrently.
+
+Routes:
+
+- ``POST /v1/generate`` — submit + per-token streaming (SSE-style
+  ``data: {json}\\n\\n`` events carrying token, logprob, and index; the
+  final event carries the finish state). Backpressure maps onto the
+  engine's existing admission machinery: shed at the gate or displaced
+  from a full queue -> **429** with ``Retry-After``; a per-request
+  deadline that expires before the first token -> **408**; draining ->
+  **503** (load balancers stop routing via ``/healthz`` first). A
+  broken pipe on an event write means the client hung up: the request
+  is cancelled through ``scheduler.cancel`` and counted on
+  ``serving/gateway/disconnect_cancels`` — slots and pages go back to
+  the pool instead of decoding for nobody.
+- ``GET /v1/stream?rid=N&have=K`` — re-attach to a live request's
+  stream (the cross-fleet handoff consumer): events ``K..`` replay from
+  the result surface, then the live stream continues.
+- ``POST /v1/peek`` — the federation scoring surface: peeked prefix-
+  cache hit fraction + pressure for a prompt, the same inputs
+  ``FleetRouter._choose`` uses locally.
+- ``POST /v1/migrate_out`` / ``POST /v1/migrate_in`` — a mid-decode
+  request leaves/enters as a versioned ``MigrationTicket.to_bytes``
+  wire payload (serving.migration), the cross-host handoff format.
+- ``GET /healthz`` — readiness: 503 body ``draining`` while the
+  owner refuses new work, the exporter's contract.
+- ``GET /metrics`` — the gateway registry's Prometheus text.
+
+Determinism: the gateway adds NO sampling state. A request's token
+stream is the engine's ``fold_in(seed, k)`` stream — a pure function of
+(sampling seed, token index) — so the same seeded trace through an
+in-process router and through gateway-fronted fleets yields bit-
+identical tokens (the federation acceptance test pins this).
+
+Locking: ``_lock`` serializes every engine touch (handler submits vs
+the step loop) and the stream table; ``_stats_lock`` guards the plain-
+int handler counters and is only ever taken alone or inside ``_lock``
+(one fixed order — the runtime lock witness sees no cycle). Handlers
+never hold ``_lock`` while writing to a socket: a slow client must not
+stall the engine.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from dla_tpu.ops.sampling import SamplingParams
+from dla_tpu.serving.migration import MigrationError, MigrationTicket
+from dla_tpu.serving.scheduler import TERMINAL_STATES, RequestState
+from dla_tpu.telemetry.exporter import DlaThreadingHTTPServer, ReadinessProbe
+from dla_tpu.telemetry.registry import MetricRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class GatewayConfig:
+    """Front-door knobs (``latency.serving.gateway`` in config)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0                      # 0 -> ephemeral; .port reports it
+    retry_after_s: float = 1.0         # Retry-After on 429/503
+    idle_poll_s: float = 0.001         # engine-loop sleep when drained
+    first_event_timeout_s: float = 300.0   # covers the first XLA compile
+    event_timeout_s: float = 120.0
+    max_body_bytes: int = 64 << 20
+
+
+class GatewayMetrics:
+    """The ``serving/gateway/*`` panel. Instruments live in the
+    gateway's own registry, which outlives the engines behind it (the
+    FleetMetrics idiom); handler threads bump plain ints and the engine
+    loop delta-mirrors them in, so totals stay monotone across engine
+    swaps and supervisor rebuilds."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        r = self.registry = registry or MetricRegistry()
+        self.connections = r.counter("serving/gateway/connections")
+        self.streamed_tokens = r.counter(
+            "serving/gateway/streamed_tokens")
+        self.disconnect_cancels = r.counter(
+            "serving/gateway/disconnect_cancels")
+        self.http_429 = r.counter("serving/gateway/http_429")
+        self.http_408 = r.counter("serving/gateway/http_408")
+
+    def snapshot(self) -> Dict[str, float]:
+        return self.registry.snapshot()
+
+
+class _Stream:
+    """Per-request event mailbox between the engine loop (producer)
+    and one handler thread (consumer)."""
+
+    def __init__(self, rid: int, sent: int):
+        self.rid = rid
+        self.sent = sent               # tokens already delivered/owned
+        self.q: "queue.Queue" = queue.Queue()
+
+
+class ServingGateway:
+    """One HTTP front door around anything engine-shaped: a
+    ``ServingEngine``, a ``Supervisor``, or a ``FleetRouter``."""
+
+    def __init__(self, engine, cfg: Optional[GatewayConfig] = None,
+                 registry: Optional[MetricRegistry] = None):
+        self.engine = engine
+        self.cfg = cfg or GatewayConfig()
+        self.metrics = GatewayMetrics(registry)
+        self.readiness = ReadinessProbe()
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._stats = {"connections": 0, "streamed_tokens": 0,
+                       "disconnect_cancels": 0, "http_429": 0,
+                       "http_408": 0}
+        self._mirrored = dict.fromkeys(self._stats, 0)
+        self._streams: Dict[int, _Stream] = {}
+        self._stop = threading.Event()
+        self.loop_error: Optional[str] = None
+        handler = _make_handler(self)
+        self._httpd = DlaThreadingHTTPServer(
+            (self.cfg.host, self.cfg.port), handler)
+        self._httpd.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dla-gateway-http",
+            daemon=True)
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, name="dla-gateway-engine",
+            daemon=True)
+        self._http_thread.start()
+        self._engine_thread.start()
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._httpd.bound_port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._httpd.server_address[0]}:{self.port}"
+
+    def begin_drain(self) -> None:
+        """Refuse new work: /healthz flips to 503 ``draining`` (load
+        balancers stop routing) and admission starts answering 503."""
+        self.readiness.set_draining("draining")
+        with self._lock:
+            self.engine.begin_drain()
+
+    @property
+    def draining(self) -> bool:
+        return bool(getattr(self.engine, "draining", False)) \
+            or self.readiness.drain_reason is not None
+
+    def close(self, timeout: Optional[float] = 5.0) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._http_thread.join(timeout=timeout)
+        self._engine_thread.join(timeout=timeout)
+
+    # -------------------------------------------------------- engine loop
+
+    def _engine_loop(self) -> None:
+        """The ONLY thread that steps the engine. Each iteration:
+        step when there is work, fan the emitted (rid, token) stream
+        out to registered per-request mailboxes, finalize terminal
+        requests, mirror the handler counters."""
+        while not self._stop.is_set():
+            worked = False
+            with self._lock:
+                try:
+                    if self.engine.has_work():
+                        worked = True
+                        self._dispatch(self.engine.step())
+                    self._finalize()
+                except Exception as exc:  # noqa: BLE001 — a dead loop
+                    # must surface, not hang every stream forever
+                    self.loop_error = repr(exc)
+                    self._fail_streams(repr(exc))
+                self._mirror_gateway_counters()
+            self.readiness.beat()
+            if not worked:
+                self._stop.wait(self.cfg.idle_poll_s)
+
+    def _dispatch(self, events) -> None:
+        for rid, tok in events:
+            st = self._streams.get(rid)
+            if st is None:
+                continue
+            req = self.engine.result(rid)
+            logp = (req.generated_logprobs[st.sent]
+                    if st.sent < len(req.generated_logprobs) else 0.0)
+            st.q.put(("tok", st.sent, int(tok), float(logp)))
+            st.sent += 1
+
+    def _finalize(self) -> None:
+        for rid, st in list(self._streams.items()):
+            try:
+                req = self.engine.result(rid)
+            except KeyError:
+                # released after a migrate_out: the serialized ticket
+                # owns the request now — tell the consumer to re-attach
+                st.q.put(("done", "migrated", "migrated", st.sent))
+                # dla: disable=unsynchronized-shared-state -- _finalize runs only inside the engine loop's `with self._lock` block; register_stream documents the same caller-holds-_lock contract
+                del self._streams[rid]
+                continue
+            if req.state in TERMINAL_STATES:
+                reason = req.finish_reason or req.state.name.lower()
+                st.q.put(("done", req.state.name.lower(), reason,
+                          len(req.generated)))
+                del self._streams[rid]
+
+    def _fail_streams(self, err: str) -> None:
+        for rid, st in list(self._streams.items()):
+            st.q.put(("done", "error", err, st.sent))
+            del self._streams[rid]
+
+    def _mirror_gateway_counters(self) -> None:
+        """Delta-mirror the handler-thread stats into the registry
+        instruments (the speculative-counter idiom: plain ints are the
+        source of truth, the registry copy stays monotone)."""
+        m = self.metrics
+        with self._stats_lock:
+            s, seen = self._stats, self._mirrored
+            m.connections.inc(s["connections"] - seen["connections"])
+            m.streamed_tokens.inc(
+                s["streamed_tokens"] - seen["streamed_tokens"])
+            m.disconnect_cancels.inc(
+                s["disconnect_cancels"] - seen["disconnect_cancels"])
+            m.http_429.inc(s["http_429"] - seen["http_429"])
+            m.http_408.inc(s["http_408"] - seen["http_408"])
+            seen.update(s)
+
+    def _bump(self, name: str, by: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[name] += by
+
+    # ------------------------------------------------- handler-side hooks
+
+    def register_stream(self, rid: int, sent: int) -> _Stream:
+        """Caller must hold ``_lock`` (registration must be atomic with
+        the submit/result read that produced ``rid``)."""
+        st = _Stream(rid, sent)
+        self._streams[rid] = st
+        return st
+
+    def unregister_stream(self, rid: int) -> None:
+        with self._lock:
+            self._streams.pop(rid, None)
+
+    def cancel_disconnected(self, rid: int) -> None:
+        """Broken pipe on an event write: the client is gone — give the
+        slot and pages back and count it."""
+        with self._lock:
+            self._streams.pop(rid, None)
+            try:
+                self.engine.cancel(rid, "client_disconnect")
+            except KeyError:
+                pass
+        self._bump("disconnect_cancels")
+
+    def peek(self, prompt_tokens) -> Tuple[float, float]:
+        """(hit_frac, pressure) for a prompt — the federation scoring
+        inputs. Caller must hold ``_lock``."""
+        eng = self.engine
+        if hasattr(eng, "peek_score"):          # FleetRouter
+            return eng.peek_score(list(prompt_tokens))
+        n = max(1, len(prompt_tokens))
+        hit = 0.0
+        if getattr(eng, "prefix_cache", None) is not None:
+            hit = eng.prefix_cache.peek(
+                list(prompt_tokens), eng.cfg.prefill_chunk) / n
+        occ = eng.cache.allocator.occupancy
+        qcap = (eng.admission.cfg.max_queue_depth
+                if eng.admission is not None
+                else max(8, 2 * eng.cfg.num_slots))
+        return hit, max(occ, eng.scheduler.queue_depth / max(1, qcap))
+
+
+def _make_handler(outer: ServingGateway):
+    """Build the request-handler class closed over one gateway."""
+
+    class _Handler(BaseHTTPRequestHandler):
+
+        # ------------------------------------------------------ plumbing
+
+        def log_message(self, *args):   # requests are metrics, not logs
+            pass
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > outer.cfg.max_body_bytes:
+                raise ValueError(f"body of {length} bytes over the "
+                                 f"{outer.cfg.max_body_bytes} cap")
+            return self.rfile.read(length)
+
+        def _json(self, status: int, obj,
+                  retry_after: bool = False) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if retry_after:
+                self.send_header(
+                    "Retry-After", f"{outer.cfg.retry_after_s:g}")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _event(self, obj) -> None:
+            self.wfile.write(b"data: " + json.dumps(obj).encode()
+                             + b"\n\n")
+            self.wfile.flush()
+
+        # -------------------------------------------------------- routes
+
+        def do_GET(self):           # noqa: N802 (http.server API)
+            outer._bump("connections")
+            path = self.path.split("?")[0]
+            if path == "/healthz":
+                self._healthz()
+            elif path == "/metrics":
+                self._metrics()
+            elif path == "/v1/stream":
+                self._stream_attach()
+            elif path == "/v1/result":
+                self._result()
+            else:
+                self.send_error(404)
+
+        def do_POST(self):          # noqa: N802 (http.server API)
+            outer._bump("connections")
+            path = self.path.split("?")[0]
+            try:
+                if path == "/v1/generate":
+                    self._generate()
+                elif path == "/v1/peek":
+                    self._peek()
+                elif path == "/v1/migrate_out":
+                    self._migrate_out()
+                elif path == "/v1/migrate_in":
+                    self._migrate_in()
+                elif path == "/admin/drain":
+                    outer.begin_drain()
+                    self._json(200, {"draining": True})
+                else:
+                    self.send_error(404)
+            except ValueError as exc:
+                self._json(400, {"error": str(exc)})
+
+        def _healthz(self):
+            probe = outer.readiness
+            if probe.drain_reason is not None or outer.draining:
+                status = 503
+                body = (probe.drain_reason or "draining") + "\n"
+            elif probe.ready:
+                status, body = 200, f"ok age_s={probe.age_s:.1f}\n"
+            else:
+                status = 503
+                body = f"stale age_s={probe.age_s:.1f}\n"
+            raw = body.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def _metrics(self):
+            try:
+                body = outer.metrics.registry.prometheus_text().encode()
+            except Exception as exc:  # noqa: BLE001 — 500 > dead thread
+                self.send_error(500, str(exc))
+                return
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # ------------------------------------------------- generate path
+
+        def _generate(self):
+            spec = json.loads(self._body() or b"{}")
+            prompt = [int(t) for t in spec.get("prompt") or ()]
+            if not prompt:
+                raise ValueError("generate wants a non-empty 'prompt'")
+            sampling = spec.get("sampling")
+            if sampling is not None:
+                sampling = SamplingParams(**sampling)
+            with outer._lock:
+                try:
+                    rid = outer.engine.submit(
+                        prompt,
+                        int(spec.get("max_new_tokens") or 16),
+                        deadline_s=spec.get("deadline_s"),
+                        priority=int(spec.get("priority") or 0),
+                        sampling=sampling)
+                except RuntimeError as exc:     # draining: admission shut
+                    self._json(503, {"error": str(exc)},
+                               retry_after=True)
+                    return
+                req = outer.engine.result(rid)
+                if req.state is RequestState.SHED:
+                    outer._bump("http_429")
+                    self._json(429, {"error": "shed", "rid": rid},
+                               retry_after=True)
+                    return
+                st = outer.register_stream(rid, sent=len(req.generated))
+            self._pump(rid, st, first_decides_status=True)
+
+        def _stream_attach(self):
+            q = parse_qs(urlparse(self.path).query)
+            rid = int(q.get("rid", ["-1"])[0])
+            have = int(q.get("have", ["0"])[0])
+            catchup, done_ev, st = [], None, None
+            with outer._lock:
+                try:
+                    req = outer.engine.result(rid)
+                except KeyError:
+                    self._json(404, {"error": f"unknown rid {rid}"})
+                    return
+                toks = list(req.generated)
+                logps = list(req.generated_logprobs)
+                catchup = [("tok", i, int(toks[i]),
+                            float(logps[i]) if i < len(logps) else 0.0)
+                           for i in range(have, len(toks))]
+                if req.state in TERMINAL_STATES:
+                    reason = req.finish_reason or req.state.name.lower()
+                    done_ev = ("done", req.state.name.lower(), reason,
+                               len(toks))
+                else:
+                    st = outer.register_stream(rid, sent=len(toks))
+            self._send_sse_headers(rid)
+            try:
+                for ev in catchup:
+                    self._write_tok(ev)
+                if done_ev is not None:
+                    self._event({"done": True, "state": done_ev[1],
+                                 "reason": done_ev[2], "n": done_ev[3]})
+                    return
+                self._pump_events(rid, st)
+            except OSError:
+                outer.cancel_disconnected(rid)
+
+        def _send_sse_headers(self, rid: int) -> None:
+            # the rid rides a response header so a federation client
+            # can later migrate the request it is still streaming
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-store")
+            self.send_header("Connection", "close")
+            self.send_header("X-DLA-Rid", str(rid))
+            self.end_headers()
+
+        def _write_tok(self, ev):
+            _, idx, tok, logp = ev
+            self._event({"i": idx, "token": tok, "logprob": logp})
+            outer._bump("streamed_tokens")
+
+        def _pump(self, rid: int, st: _Stream,
+                  first_decides_status: bool) -> None:
+            """Wait for the first event; it picks the HTTP status (a
+            deadline that beat the first token -> 408, a mid-queue shed
+            -> 429, anything streamed -> 200). Then stream until the
+            done event."""
+            try:
+                ev = st.q.get(timeout=outer.cfg.first_event_timeout_s)
+            except queue.Empty:
+                outer.unregister_stream(rid)
+                self._json(504, {"error": "no first event before "
+                                 "timeout", "rid": rid})
+                return
+            if ev[0] == "done" and first_decides_status:
+                state = ev[1]
+                if state == "timeout" and ev[3] == 0:
+                    outer._bump("http_408")
+                    self._json(408, {"error": "deadline expired before "
+                                     "first token", "rid": rid})
+                    return
+                if state == "shed":
+                    outer._bump("http_429")
+                    self._json(429, {"error": "shed", "rid": rid},
+                               retry_after=True)
+                    return
+            self._send_sse_headers(rid)
+            try:
+                if ev[0] == "tok":
+                    self._write_tok(ev)
+                    self._pump_events(rid, st)
+                else:
+                    self._event({"done": True, "state": ev[1],
+                                 "reason": ev[2], "n": ev[3]})
+            except OSError:
+                outer.cancel_disconnected(rid)
+
+        def _pump_events(self, rid: int, st: _Stream) -> None:
+            """Stream mailbox events to the socket until done. OSError
+            propagates to the caller's disconnect handler."""
+            while True:
+                try:
+                    ev = st.q.get(timeout=outer.cfg.event_timeout_s)
+                except queue.Empty:
+                    outer.unregister_stream(rid)
+                    self._event({"done": True, "state": "error",
+                                 "reason": "event timeout", "n": -1})
+                    return
+                if ev[0] == "tok":
+                    self._write_tok(ev)
+                else:
+                    self._event({"done": True, "state": ev[1],
+                                 "reason": ev[2], "n": ev[3],
+                                 "rid": rid})
+                    return
+
+        # ----------------------------------------------- federation path
+
+        def _peek(self):
+            spec = json.loads(self._body() or b"{}")
+            prompt = [int(t) for t in spec.get("prompt") or ()]
+            with outer._lock:
+                hit, pressure = outer.peek(prompt)
+                draining = outer.draining
+            self._json(200, {"hit_frac": hit, "pressure": pressure,
+                             "draining": draining})
+
+        def _migrate_out(self):
+            spec = json.loads(self._body() or b"{}")
+            rid = int(spec.get("rid", -1))
+            with outer._lock:
+                try:
+                    ticket = outer.engine.export_request(rid)
+                except KeyError:
+                    self._json(404, {"error": f"unknown rid {rid}"})
+                    return
+                except MigrationError as exc:
+                    self._json(409, {"error": str(exc)})
+                    return
+                # two-phase engines (ServingEngine) still hold the
+                # source copy; FleetRouter.export_request has already
+                # released it and owns no release_migrated
+                release = getattr(outer.engine, "release_migrated", None)
+                if release is not None:
+                    release(rid)
+                # the ticket owns the request now: close the source
+                # stream with the re-attach signal here (FleetRouter
+                # archives the exported rid, so the engine-loop's
+                # KeyError path would never see it go away)
+                st = outer._streams.pop(rid, None)
+                if st is not None:
+                    st.q.put(("done", "migrated", "migrated", st.sent))
+            blob = ticket.to_bytes()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def _migrate_in(self):
+            blob = self._body()
+            try:
+                ticket = MigrationTicket.from_bytes(blob)
+            except MigrationError as exc:
+                self._json(400, {"error": str(exc)})
+                return
+            with outer._lock:
+                try:
+                    existing = outer.engine.result(ticket.rid)
+                    if existing.state not in TERMINAL_STATES:
+                        self._json(409, {"error": f"rid {ticket.rid} "
+                                         "is live on this fleet"})
+                        return
+                except KeyError:
+                    pass
+                try:
+                    req = outer.engine.import_request(ticket)
+                except MigrationError as exc:
+                    self._json(409, {"error": str(exc)})
+                    return
+            self._json(200, {"rid": req.rid,
+                             "generated": len(req.generated)})
+
+        def _result(self):
+            q = parse_qs(urlparse(self.path).query)
+            rid = int(q.get("rid", ["-1"])[0])
+            with outer._lock:
+                try:
+                    req = outer.engine.result(rid)
+                except KeyError:
+                    self._json(404, {"error": f"unknown rid {rid}"})
+                    return
+                doc = {"rid": rid, "state": req.state.name.lower(),
+                       "reason": req.finish_reason,
+                       "tokens": list(req.generated),
+                       "logprobs": list(req.generated_logprobs)}
+            self._json(200, doc)
+
+    return _Handler

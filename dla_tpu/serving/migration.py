@@ -40,12 +40,39 @@ exactly one engine (docs/SERVING.md "Disaggregated prefill/decode").
 from __future__ import annotations
 
 import dataclasses
+import json
+import struct
 from typing import List, Optional
 
 import jax
 import numpy as np
 
 TRANSPORTS = ("auto", "device", "host")
+
+#: Wire format version for ``MigrationTicket.to_bytes``. Bump on any
+#: header-field or payload-layout change; ``from_bytes`` rejects other
+#: versions with :class:`MigrationError` rather than misparsing.
+WIRE_VERSION = 1
+
+_WIRE_MAGIC = b"DLAT"
+# magic(4) | version u16 | header-json length u32, little-endian
+_WIRE_HEAD = struct.Struct("<4sHI")
+
+
+def _wire_dtype(name: str) -> np.dtype:
+    """Resolve a serialized dtype name, including the ml_dtypes families
+    (bfloat16, float8_*) jax payloads use that numpy does not register
+    under their string names."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise MigrationError(
+                f"ticket payload dtype {name!r} is not resolvable on "
+                f"this host") from None
 
 
 class MigrationError(RuntimeError):
@@ -123,6 +150,111 @@ class MigrationTicket:
     def payload_bytes(self) -> int:
         k, v = self.k_payload, self.v_payload
         return int(getattr(k, "nbytes", 0)) + int(getattr(v, "nbytes", 0))
+
+    # ------------------------------------------------------- wire format
+
+    def to_bytes(self) -> bytes:
+        """Serialize for a cross-host handoff: a versioned header
+        (magic, :data:`WIRE_VERSION`, JSON metadata with payload
+        dtype/shape) followed by the raw KV page bytes. The payload is
+        host-bounced first (one D2H, same contract as ``transport:
+        host``), and the round trip is bit-exact: ``from_bytes`` yields
+        payload arrays whose bytes equal the originals, and float
+        metadata (arrival clocks, logprobs) survives via JSON's
+        shortest-roundtrip float repr."""
+        # dla: disable=host-sync-in-hot-loop -- designed wire export: one D2H per shipped ticket, counted by the caller on serving/federation/handoff_bytes
+        k = np.ascontiguousarray(np.asarray(self.k_payload))
+        v = np.ascontiguousarray(np.asarray(self.v_payload))
+        sampling = (None if self.sampling is None
+                    else dataclasses.asdict(self.sampling))
+        meta = {
+            "rid": int(self.rid),
+            "prompt_tokens": [int(t) for t in self.prompt_tokens],
+            "max_new_tokens": int(self.max_new_tokens),
+            "generated": [int(t) for t in self.generated],
+            "generated_logprobs": [float(p)
+                                   for p in self.generated_logprobs],
+            "sampling": sampling,
+            "arrival_time": float(self.arrival_time),
+            "deadline": self.deadline,
+            "priority": int(self.priority),
+            "committed_len": int(self.committed_len),
+            "page_size": int(self.page_size),
+            "n_pages": int(self.n_pages),
+            "src_slot": self.src_slot,
+            "admitted_time": self.admitted_time,
+            "first_token_time": self.first_token_time,
+            "last_token_time": self.last_token_time,
+            "k_dtype": str(k.dtype), "k_shape": list(k.shape),
+            "v_dtype": str(v.dtype), "v_shape": list(v.shape),
+        }
+        header = json.dumps(meta, separators=(",", ":")).encode()
+        return (_WIRE_HEAD.pack(_WIRE_MAGIC, WIRE_VERSION, len(header))
+                + header + k.tobytes() + v.tobytes())
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "MigrationTicket":
+        """Parse a :meth:`to_bytes` payload. Rejects a wrong magic,
+        a version mismatch, and truncation at any layer (header or
+        payload bytes) with :class:`MigrationError` — a half-received
+        ticket must never install."""
+        if len(blob) < _WIRE_HEAD.size:
+            raise MigrationError(
+                f"truncated ticket: {len(blob)} bytes is shorter than "
+                f"the {_WIRE_HEAD.size}-byte wire header")
+        magic, version, hlen = _WIRE_HEAD.unpack_from(blob)
+        if magic != _WIRE_MAGIC:
+            raise MigrationError(
+                f"bad ticket magic {magic!r} (expected {_WIRE_MAGIC!r})")
+        if version != WIRE_VERSION:
+            raise MigrationError(
+                f"ticket wire version {version} does not match this "
+                f"host's {WIRE_VERSION}")
+        if len(blob) < _WIRE_HEAD.size + hlen:
+            raise MigrationError(
+                f"truncated ticket header: need {hlen} bytes, have "
+                f"{len(blob) - _WIRE_HEAD.size}")
+        try:
+            meta = json.loads(blob[_WIRE_HEAD.size:_WIRE_HEAD.size + hlen])
+        except ValueError as exc:
+            raise MigrationError(
+                f"corrupt ticket header: {exc}") from exc
+        k_dtype = _wire_dtype(meta["k_dtype"])
+        v_dtype = _wire_dtype(meta["v_dtype"])
+        k_shape = tuple(int(d) for d in meta["k_shape"])
+        v_shape = tuple(int(d) for d in meta["v_shape"])
+        k_bytes = int(np.prod(k_shape, dtype=np.int64)) * k_dtype.itemsize
+        v_bytes = int(np.prod(v_shape, dtype=np.int64)) * v_dtype.itemsize
+        off = _WIRE_HEAD.size + hlen
+        if len(blob) != off + k_bytes + v_bytes:
+            raise MigrationError(
+                f"truncated ticket payload: header declares "
+                f"{k_bytes + v_bytes} payload bytes, have "
+                f"{len(blob) - off}")
+        k = np.frombuffer(blob, dtype=k_dtype, count=int(
+            np.prod(k_shape, dtype=np.int64)), offset=off
+        ).reshape(k_shape).copy()
+        v = np.frombuffer(blob, dtype=v_dtype, count=int(
+            np.prod(v_shape, dtype=np.int64)), offset=off + k_bytes
+        ).reshape(v_shape).copy()
+        sampling = meta["sampling"]
+        if sampling is not None:
+            from dla_tpu.ops.sampling import SamplingParams
+            sampling = SamplingParams(**sampling)
+        return cls(
+            rid=meta["rid"], prompt_tokens=meta["prompt_tokens"],
+            max_new_tokens=meta["max_new_tokens"],
+            generated=meta["generated"],
+            generated_logprobs=meta["generated_logprobs"],
+            sampling=sampling, arrival_time=meta["arrival_time"],
+            deadline=meta["deadline"], priority=meta["priority"],
+            committed_len=meta["committed_len"],
+            page_size=meta["page_size"], n_pages=meta["n_pages"],
+            k_payload=k, v_payload=v, transport="host",
+            src_slot=meta["src_slot"],
+            admitted_time=meta["admitted_time"],
+            first_token_time=meta["first_token_time"],
+            last_token_time=meta["last_token_time"])
 
 
 class KVMigrator:

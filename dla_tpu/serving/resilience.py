@@ -466,6 +466,16 @@ class Supervisor:
     def result(self, rid: int) -> Request:
         return self.journal[rid].request
 
+    def cancel(self, rid: int, reason: str = "cancelled") -> Request:
+        """Client-initiated cancellation through the journal: the entry
+        is marked done so a later engine rebuild does NOT replay the
+        request the client already walked away from."""
+        req = self.engine.cancel(rid, reason)
+        entry = self.journal.get(rid)
+        if entry is not None:
+            entry.done = True
+        return req
+
     def results(self) -> Dict[int, Request]:
         return {rid: e.request for rid, e in self.journal.items()}
 

@@ -56,6 +56,7 @@ from dla_tpu.serving.resilience import (
     ShedConfig,
 )
 from dla_tpu.serving.scheduler import (
+    TERMINAL_STATES,
     Request,
     RequestState,
     Scheduler,
@@ -768,6 +769,26 @@ class ServingEngine:
 
     def result(self, rid: int) -> Request:
         return self._results[rid]
+
+    def cancel(self, rid: int, reason: str = "cancelled") -> Request:
+        """Client-initiated terminal cancellation — the gateway's
+        broken-pipe-on-write path. Wherever the request currently lives
+        (queued, prefilling, or mid-decode) its resources go back to
+        the pool; generated-so-far tokens stay on the result. A no-op
+        on already-terminal requests."""
+        req = self._results[rid]
+        if req.state in TERMINAL_STATES:
+            return req
+        self.scheduler.cancel(req, reason)
+        self.metrics.requests_cancelled.inc()
+        self.recorder.record("request_cancelled",
+                             step=self.engine_steps, rid=rid,
+                             reason=reason)
+        if self.tracer.enabled:
+            self.tracer.async_end("request", "request", req.rid,
+                                  status="cancelled",
+                                  tokens=len(req.generated))
+        return req
 
     def publish_params(self, new_params, donate: bool = False) -> None:
         """In-place weight refit: swap the param tree the jitted steps

@@ -28,6 +28,48 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
+def _handler_threads():
+    """The join-able thread list ``ThreadingMixIn.server_close`` expects
+    (stdlib-private; a behavior-equivalent shim if it ever moves)."""
+    try:
+        from socketserver import _Threads
+        return _Threads()
+    except ImportError:      # pragma: no cover — future-stdlib fallback
+
+        class _Joinable(list):
+            def join(self):
+                for t in self:
+                    t.join()
+
+        return _Joinable()
+
+
+class DlaThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-connection handler threads carry
+    the repo's ``dla-`` name prefix (thread naming policy,
+    docs/ANALYSIS.md) — the stock mixin leaves them as ``Thread-N``,
+    invisible to py-spy/lock-witness attribution. Shared by the metrics
+    endpoint and the serving gateway; ``port=0`` binds an ephemeral
+    port and ``.bound_port`` reports the real one (the federation
+    gossip advertises it to peers)."""
+
+    def process_request(self, request, client_address):
+        # stdlib ThreadingMixIn.process_request, plus the thread name
+        if self.block_on_close:
+            vars(self).setdefault("_threads", _handler_threads())
+        t = threading.Thread(
+            target=self.process_request_thread,
+            args=(request, client_address),
+            name=f"dla-http-{client_address[1]}")
+        t.daemon = self.daemon_threads
+        self._threads.append(t)
+        t.start()
+
+    @property
+    def bound_port(self) -> int:
+        return self.server_address[1]
+
+
 class ReadinessProbe:
     """Last-heartbeat tracker behind ``/healthz``. The loop calls
     ``beat()`` once per completed step (or engine tick); the handler
@@ -112,7 +154,7 @@ class MetricsHTTPServer:
             def log_message(self, *args):  # scrapes are not log events
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = DlaThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="dla-metrics-http",

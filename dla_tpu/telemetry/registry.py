@@ -312,6 +312,40 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "source's last emitted token -> target install (the stream gap "
        "a migrated request's first post-handoff ITL sample includes)",
        "step"),
+    # -- serving gateway (serving.gateway): the HTTP front door. Handler
+    #    threads bump plain-int stats; the gateway's engine loop delta-
+    #    mirrors them into the gateway-owned registry (speculative-
+    #    counter idiom), so totals stay monotone across engine swaps
+    #    and supervisor rebuilds behind the same gateway.
+    _s("serving/gateway/connections", "counter", "requests",
+       "HTTP requests accepted by the gateway (all routes)", "step"),
+    _s("serving/gateway/streamed_tokens", "counter", "tokens",
+       "tokens written to clients as SSE stream events", "step"),
+    _s("serving/gateway/disconnect_cancels", "counter", "requests",
+       "in-flight requests cancelled because the client hung up "
+       "mid-stream (broken pipe on an event write)", "step"),
+    _s("serving/gateway/http_429", "counter", "responses",
+       "generate calls refused by admission control (shed at the "
+       "gate or displaced from a full queue) -> 429 + Retry-After",
+       "step"),
+    _s("serving/gateway/http_408", "counter", "responses",
+       "generate calls whose per-request deadline expired before the "
+       "first token -> 408", "step"),
+    # -- fleet federation (serving.federation): cross-host placement
+    #    over gossiped peer beats; counters live on the FederatedRouter's
+    #    own registry, which outlives every remote fleet.
+    _s("serving/federation/gossip_beats", "counter", "beats",
+       "fresh peer heartbeat sequence numbers observed in the gossip "
+       "directory", "step"),
+    _s("serving/federation/routed_remote", "counter", "requests",
+       "requests placed onto a remote fleet (cache-aware score over "
+       "peeked hit-frac and gossiped pressure)", "step"),
+    _s("serving/federation/handoff_bytes", "counter", "bytes",
+       "serialized MigrationTicket bytes shipped between fleets "
+       "(cross-host mid-decode handoffs)", "step"),
+    _s("serving/federation/stale_peers", "counter", "peers",
+       "placement passes that skipped a peer whose gossip lease had "
+       "gone stale (no beat within the TTL)", "step"),
     # -- RLHF rollout subsystem (dla_tpu/rollout): serving-backed
     #    generation for train_rlhf (docs/RLHF.md)
     _s("rollout/rollouts", "counter", "rollouts",

@@ -549,6 +549,16 @@ class MigrationSchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class GatewaySchema:
+    """eval_latency --gateway: wire-vs-in-process serving A/B through
+    the HTTP streaming gateway (serving.gateway)."""
+    enabled: Any = None
+    num_requests: Any = None
+    arrival_rate: Any = None
+    new_tokens: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ServingLatencySchema:
     enabled: Any = None
     arrival_rate: Any = None
@@ -573,6 +583,7 @@ class ServingLatencySchema:
     fleet: Optional[FleetSchema] = None
     disagg: Optional[DisaggSchema] = None
     migration: Optional[MigrationSchema] = None
+    gateway: Optional[GatewaySchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -45,7 +45,7 @@ from dla_tpu.telemetry.registry import parse_prometheus_text  # noqa: E402
 
 LOWER_IS_BETTER = ("_ms", "latency", "stall", "badput", "overhead",
                    "wait", "steps_per_token", "steps_lost", "gap_s",
-                   "failed_handoffs")
+                   "failed_handoffs", "requests_lost")
 HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
                     "samples_per_sec", "_per_second", "saved_frac",
                     "hit_rate", "tokens_per_s", "padding_waste_recovered",
