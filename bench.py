@@ -655,6 +655,125 @@ def run_rollout_bench() -> dict:
     }
 
 
+def run_rollout_fleet_bench() -> dict:
+    """Elastic sampler-fleet A/B (dla_tpu/rollout/actor_fleet), three
+    measurements in one row: (1) refit fanout at N=4 — every member
+    publish costs a fixed ``refit_delay_s``, so the serial baseline
+    pays ~N delays while the broadcast tree pays ~wave-count (2 at
+    branch 2); the headline is that wall-time ratio (higher is
+    better). (2) Rollout throughput N=1 vs N=4 on the same prompts —
+    trajectories/s per fleet size, outputs pinned bit-identical across
+    fleet sizes. (3) Chaos: ``sampler=1:rollout_step=1:lost`` kills a
+    member mid-run over 3 rollouts; ``steps_lost_to_sampler_death``
+    must be 0 (lose a sampler, not the run — orphaned groups are
+    reassigned and regenerate bit-identically from the journal).
+    Deterministic, CPU-sized, in-process."""
+    import time
+    import jax
+    import numpy as np
+    from dla_tpu.generation.engine import GenerationConfig
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.ops.sampling import derive_rollout_seeds
+    from dla_tpu.rollout import SamplerFleet, SamplerFleetConfig
+    from dla_tpu.serving import ServingConfig
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=128, remat="none", dtype="float32",
+        param_dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    gen = GenerationConfig(max_new_tokens=6, do_sample=True,
+                           temperature=1.0, eos_token_id=-1,
+                           pad_token_id=0)
+    rows = 8
+    rs = np.random.RandomState(7)
+    lens = rs.randint(4, 11, (rows,))
+    width = int(lens.max())
+    ids = np.zeros((rows, width), np.int32)
+    mask = np.zeros_like(ids)
+    for i, n in enumerate(lens):
+        ids[i, :n] = rs.randint(3, 500, (n,))
+        mask[i, :n] = 1
+    seeds = derive_rollout_seeds(11, rows)
+    scfg = ServingConfig(page_size=4, num_pages=96, num_slots=4,
+                         max_model_len=48, max_prefill_batch=2,
+                         fault_plan="")
+    delay_s, branch = 0.05, 2
+
+    # --- (1) refit fanout serial vs broadcast at N=4, (2) N=4 rollout
+    fleet4 = SamplerFleet(
+        model, params, gen, scfg,
+        SamplerFleetConfig(samplers=4, fanout_branch=branch,
+                           refit_delay_s=delay_s))
+    t0 = time.perf_counter()
+    fleet4.publish_params_serial(params, version=1)
+    serial_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fleet4.publish_params(params, version=2)
+    bcast_s = time.perf_counter() - t0
+    fanout_speedup = serial_s / bcast_s
+    fleet4.generate(ids, mask, seeds)          # warm-up: compiles
+    t0 = time.perf_counter()
+    out4 = fleet4.generate(ids, mask, seeds)
+    n4_s = time.perf_counter() - t0
+    fleet4.close()
+
+    fleet1 = SamplerFleet(model, params, gen, scfg,
+                          SamplerFleetConfig(samplers=1))
+    fleet1.generate(ids, mask, seeds)          # warm-up
+    t0 = time.perf_counter()
+    out1 = fleet1.generate(ids, mask, seeds)
+    n1_s = time.perf_counter() - t0
+    fleet1.close()
+    identical = bool(np.array_equal(np.asarray(out1["response_tokens"]),
+                                    np.asarray(out4["response_tokens"])))
+
+    # --- (3) lose a sampler mid-run: zero learner steps lost
+    chaos = SamplerFleet(
+        model, params, gen,
+        ServingConfig(page_size=4, num_pages=96, num_slots=4,
+                      max_model_len=48, max_prefill_batch=2,
+                      fault_plan="sampler=1:rollout_step=1:lost"),
+        SamplerFleetConfig(samplers=2, lease_ttl_s=0.3))
+    steps_lost = 0
+    for _ in range(3):
+        try:
+            ck = chaos.generate(ids, mask, seeds)
+            if np.asarray(ck["response_tokens"]).shape[0] != rows:
+                steps_lost += 1
+        except Exception:  # noqa: BLE001 — a lost run IS the metric
+            steps_lost += 1
+    snap = chaos.fleet_metrics.snapshot()
+    chaos.close()
+
+    return {
+        "metric": "rollout_fleet_fanout_speedup",
+        "value": round(fanout_speedup, 2),
+        "unit": "x",
+        "detail": {
+            "fanout_speedup": round(fanout_speedup, 2),
+            "serial_refit_ms": round(serial_s * 1e3, 1),
+            "broadcast_refit_ms": round(bcast_s * 1e3, 1),
+            "refit_delay_ms": delay_s * 1e3,
+            "samplers": 4,
+            "fanout_branch": branch,
+            "fanout_waves": 2,
+            "trajectories_per_s_n1": round(rows / n1_s, 2),
+            "trajectories_per_s_n4": round(rows / n4_s, 2),
+            "fleet_scaling": round(n1_s / n4_s, 2),
+            "outputs_identical_n1_n4": identical,
+            "steps_lost_to_sampler_death": steps_lost,
+            "retired_samplers": int(
+                snap["rollout/fleet/retired_samplers"]),
+            "reassigned_rollouts": int(
+                snap["rollout/fleet/reassigned_rollouts"]),
+            "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def run_serving_spec_bench() -> dict:
     """Speculative-serving A/B on the long-tail response-length mix:
     the SAME prompts and per-row budgets through two serving engines —
@@ -1837,7 +1956,8 @@ def _emit_and_maybe_extra() -> None:
     for fn in (run_ppo_bench, run_decode_bench, run_serving_bench,
                run_serving_prefix_bench, run_serving_spec_bench,
                run_serving_fleet_bench, run_serving_disagg_bench,
-               run_serving_gateway_bench, run_elastic_resilience_bench):
+               run_serving_gateway_bench, run_elastic_resilience_bench,
+               run_rollout_fleet_bench):
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — extras must not kill the line
@@ -1890,6 +2010,14 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_rollout_bench()))
+        return 0
+    if "rollout-fleet" in sys.argv[1:]:
+        # elastic sampler-fleet target: serial-vs-broadcast refit
+        # fanout at N=4 (headline, higher better), trajectories/s N=1
+        # vs N=4, and steps-lost-to-sampler-death chaos (must be 0)
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_rollout_fleet_bench()))
         return 0
     if "serving-spec" in sys.argv[1:]:
         # speculative-serving A/B target: same in-process forced-CPU

@@ -88,6 +88,29 @@ slow            host H's lease step lags by ``arg`` (a one-shot
                 unless the lag reaches the TTL)
 ==============  ===================================================
 
+Sampler scope: entries of the form
+``sampler=I:rollout_step=N:lost|slow[:LAG]`` target one member of the
+RLHF sampler fleet (rollout.actor_fleet), armed against the fleet's
+rollout counter. The fleet polls them at each rollout's start; the
+member index rides the entry's ``host`` field (same rider the ``host=``
+scope uses)::
+
+    DLA_FAULT_PLAN="sampler=1:rollout_step=2:lost"
+
+==============  ===================================================
+lost            member I completes at most one more trajectory
+                group this rollout, then goes silent — no further
+                lease beats, no further groups; the fleet's lease
+                monitor detects it within one TTL, retires the
+                member, and reassigns its unfinished prompt indices
+                to survivors (regenerated bit-identically from the
+                journaled (prompt, seed) pairs)
+slow            member I sleeps ``arg`` seconds (default 0.05)
+                before each engine step this rollout — a one-shot
+                ``sampler_slow`` flight-recorder event; no retire
+                unless the lag outlives the lease TTL
+==============  ===================================================
+
 Network scope: entries prefixed ``net=`` arm against the federation
 wire client's monotone HTTP-operation counter (serving.federation) —
 one poll per wire op, so ``net=3:disconnect`` fires on the third
@@ -105,12 +128,12 @@ disconnect      the connection closes mid-stream after the op
                 the zero-loss replay path
 ==============  ===================================================
 
-The five scopes are disjoint: ``take(kind, step)`` only matches
+The six scopes are disjoint: ``take(kind, step)`` only matches
 ``step=`` entries, ``take(kind, step, site="engine_step")`` only
 matches ``engine_step=`` entries, and likewise ``site="rollout_step"``,
-``site="host"``, and ``site="net"`` — so a co-located trainer, engine,
-rollout loop, gang monitor, and federation client can share one plan
-string.
+``site="host"``, ``site="sampler"``, and ``site="net"`` — so a
+co-located trainer, engine, rollout loop, sampler fleet, gang monitor,
+and federation client can share one plan string.
 """
 from __future__ import annotations
 
@@ -135,6 +158,12 @@ ROLLOUT_KINDS = ("device_error", "nan_logits", "wedge")
 # polled by the elastic GangMonitor's simulated-pod beat
 HOST_KINDS = ("lost", "slow")
 
+# sampler-scoped kinds, legal only in the
+# ``sampler=I:rollout_step=N:<kind>`` form: polled by the RLHF sampler
+# fleet (rollout.actor_fleet) at each rollout's start, targeting one
+# fleet member by index
+SAMPLER_KINDS = ("lost", "slow")
+
 # network-scoped kinds, legal only behind a ``net=`` prefix: polled by
 # the federation wire client (serving.federation) once per HTTP
 # operation, armed against its monotone wire-op counter
@@ -142,7 +171,7 @@ NET_KINDS = ("drop", "delay", "disconnect")
 
 _SITE_KINDS = {"step": KNOWN_KINDS, "engine_step": SERVING_KINDS,
                "rollout_step": ROLLOUT_KINDS, "host": HOST_KINDS,
-               "net": NET_KINDS}
+               "sampler": SAMPLER_KINDS, "net": NET_KINDS}
 
 
 @dataclasses.dataclass
@@ -153,7 +182,8 @@ class Fault:
     arg: Optional[float] = None
     fired: bool = False
     site: str = "step"           # "step" (training) | "engine_step" | ...
-    host: Optional[int] = None   # which host, for the ``host=`` scope
+    host: Optional[int] = None   # which host (``host=`` scope) or fleet
+                                 # member index (``sampler=`` scope)
 
 
 class FaultPlan:
@@ -172,9 +202,12 @@ class FaultPlan:
 
     def spec(self) -> str:
         def one(f: Fault) -> str:
-            head = (f"host={f.host}:step={f.step}:{f.kind}"
-                    if f.site == "host"
-                    else f"{f.site}={f.step}:{f.kind}")
+            if f.site == "host":
+                head = f"host={f.host}:step={f.step}:{f.kind}"
+            elif f.site == "sampler":
+                head = f"sampler={f.host}:rollout_step={f.step}:{f.kind}"
+            else:
+                head = f"{f.site}={f.step}:{f.kind}"
             return head + ("" if f.arg is None else f":{f.arg:g}")
         return ";".join(one(f) for f in self.entries)
 
@@ -210,6 +243,27 @@ class FaultPlan:
                     kind=kind,
                     arg=float(fields[3]) if len(fields) == 4 else None,
                     site="host", host=int(fields[0][len("host="):])))
+                continue
+            if site == "sampler":
+                # sampler=I:rollout_step=N:lost|slow[:arg] — the fleet
+                # scope names WHICH member on top of the rollout counter
+                if len(fields) not in (3, 4) or not \
+                        fields[1].strip().startswith("rollout_step="):
+                    raise ValueError(
+                        f"bad fault entry {part!r}; expected "
+                        f"'sampler=<I>:rollout_step=<N>:<kind>[:<arg>]' "
+                        f"with kind one of {SAMPLER_KINDS}")
+                kind = fields[2].strip()
+                if kind not in SAMPLER_KINDS:
+                    raise ValueError(
+                        f"unknown fault kind {kind!r} in {part!r}; "
+                        f"known for sampler=: {SAMPLER_KINDS}")
+                entries.append(Fault(
+                    step=int(fields[1].strip()[len("rollout_step="):]),
+                    kind=kind,
+                    arg=float(fields[3]) if len(fields) == 4 else None,
+                    site="sampler",
+                    host=int(fields[0][len("sampler="):])))
                 continue
             if len(fields) not in (2, 3) or site is None:
                 raise ValueError(

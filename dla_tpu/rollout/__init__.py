@@ -16,8 +16,23 @@ already consumes. See docs/RLHF.md.
 - :class:`RolloutPipeline` — sync (bit-identical to the batch path)
   or async (generate k+1 while the learner updates on k) pacing with
   a staleness bound and truncated importance correction.
+- :class:`SamplerFleet` — N rollout engines behind one ``generate()``:
+  broadcast-tree refit fanout, staleness-tagged trajectory streaming,
+  and lease-based lose-a-sampler-not-the-run elasticity.
 """
-from dla_tpu.rollout.engine import RolloutEngine, RolloutMetrics
+from dla_tpu.rollout.actor_fleet import (
+    SamplerFleet,
+    SamplerFleetConfig,
+    SamplerFleetMetrics,
+    TrajectoryGroup,
+    shard_trajectory_groups,
+)
+from dla_tpu.rollout.engine import (
+    RolloutEngine,
+    RolloutMetrics,
+    RolloutStopped,
+    assemble_rows,
+)
 from dla_tpu.rollout.pipeline import (
     RolloutPipeline,
     apply_staleness_correction,
@@ -30,8 +45,15 @@ __all__ = [
     "RolloutEngine",
     "RolloutMetrics",
     "RolloutPipeline",
+    "RolloutStopped",
+    "SamplerFleet",
+    "SamplerFleetConfig",
+    "SamplerFleetMetrics",
+    "TrajectoryGroup",
     "WeightRefitter",
     "apply_staleness_correction",
+    "assemble_rows",
     "build_rollout_pipeline",
     "make_staleness_corrector",
+    "shard_trajectory_groups",
 ]

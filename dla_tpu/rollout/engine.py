@@ -19,7 +19,8 @@ seeded ``build_generate_fn`` path (pinned by test).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -68,6 +69,55 @@ class RolloutMetrics:
         }
 
 
+class RolloutStopped(RuntimeError):
+    """Raised out of an in-flight drain when ``request_stop()`` fired:
+    the pipeline is closing and the partially generated rollout will
+    never be consumed."""
+
+
+def assemble_rows(fetch: Callable[[int], object], order: Sequence[int],
+                  p_width: int, n: int, pad: int) -> Dict[str, np.ndarray]:
+    """Reassemble finished requests into the ``build_generate_fn``
+    output contract as host arrays: right-padded ``[B, P+N]`` sequences
+    (prompt immediately followed by response — what left_align produces
+    for right-padded prompts) and ``[B, N]`` response arrays.
+    ``fetch(rid)`` returns the request record; every request must have
+    FINISHED (rollouts admit no other terminal state). Shared by the
+    single-engine RolloutEngine and the per-group assembly in
+    rollout.actor_fleet."""
+    rows = len(order)
+    seq = np.full((rows, p_width + n), pad, np.int32)
+    seq_mask = np.zeros((rows, p_width + n), np.int32)
+    resp = np.full((rows, n), pad, np.int32)
+    resp_mask = np.zeros((rows, n), np.int32)
+    lps = np.zeros((rows, n), np.float32)
+    prompt_lens = np.zeros((rows,), np.int32)
+    for row, rid in enumerate(order):
+        req = fetch(rid)
+        if req.state is not RequestState.FINISHED:
+            raise RuntimeError(
+                f"rollout request {rid} ended {req.state.value!r} "
+                f"({req.finish_reason!r}); rollouts require every "
+                "request to finish — disable deadlines/shedding on "
+                "the rollout engine")
+        p = req.prompt_tokens
+        g = req.generated
+        gl = req.generated_logprobs
+        prompt_lens[row] = len(p)
+        seq[row, :len(p)] = p
+        seq_mask[row, :len(p)] = 1
+        seq[row, len(p):len(p) + len(g)] = g
+        seq_mask[row, len(p):len(p) + len(g)] = 1
+        resp[row, :len(g)] = g
+        resp_mask[row, :len(g)] = 1
+        lps[row, :len(g)] = gl
+    lengths = prompt_lens + resp_mask.sum(axis=1).astype(np.int32)
+    return {"sequences": seq, "sequence_mask": seq_mask,
+            "response_tokens": resp, "response_mask": resp_mask,
+            "response_logps": lps, "lengths": lengths,
+            "prompt_lens": prompt_lens}
+
+
 class RolloutEngine:
     """The ServingEngine as RLHF rollout actor.
 
@@ -103,6 +153,8 @@ class RolloutEngine:
         self._engines: List[ServingEngine] = []
         self.metrics = metrics or RolloutMetrics()
         self.rollouts_started = 0
+        self.version = 0             # learner-update stamp of _params
+        self._stop_requested = threading.Event()
 
         def factory() -> ServingEngine:
             eng = ServingEngine(model, self._params, gen, cfg)
@@ -128,15 +180,30 @@ class RolloutEngine:
             return self.supervisor.engine
         return self._engines[-1]
 
-    def publish_params(self, params, donate: bool = False) -> None:
+    def publish_params(self, params, donate: bool = False,
+                       version: Optional[int] = None) -> None:
         """Swap the live engine's param tree in place (structure/shape/
         dtype-validated — zero recompiles) AND the factory's source, so
         a supervisor rebuild mid-rollout comes back with the refitted
         weights, not the originals. With speculative decoding on, the
         engine re-quantizes the int8 self-draft from the published tree
-        in the same call — the draft never serves stale weights."""
+        in the same call — the draft never serves stale weights.
+        ``version`` optionally stamps the tree with the learner update
+        count it came from (the staleness tag the fleet pipeline reads
+        per trajectory)."""
         self.engine.publish_params(params, donate=donate)
         self._params = params
+        if version is not None:
+            self.version = int(version)
+
+    def request_stop(self) -> None:
+        """Abort an in-flight drain promptly: the next ``_drain`` loop
+        iteration raises :class:`RolloutStopped` instead of stepping the
+        engine again. Called by ``RolloutPipeline.close()`` so a
+        generator thread mid-generation releases the engine before the
+        supervisor is torn down, instead of close() waiting out the
+        whole rollout (or forever, on a wedged engine)."""
+        self._stop_requested.set()
 
     def close(self) -> None:
         if self.supervisor is not None:
@@ -248,6 +315,8 @@ class RolloutEngine:
 
     def _drain(self, driver, max_steps: int = 100000) -> None:
         for _ in range(max_steps):
+            if self._stop_requested.is_set():
+                raise RolloutStopped("rollout aborted: pipeline closing")
             if not driver.has_work():
                 return
             driver.step()
@@ -257,46 +326,8 @@ class RolloutEngine:
     def _assemble(self, driver, order: List[int], p_width: int,
                   max_new: Optional[Sequence[int]]
                   ) -> Dict[str, jnp.ndarray]:
-        """Reassemble per-request results into the ``build_generate_fn``
-        output contract: right-padded ``[B, P+N]`` sequences (prompt
-        immediately followed by response — what left_align produces for
-        right-padded prompts) and ``[B, N]`` response arrays."""
         n = int(self.gen.max_new_tokens) if max_new is None \
             else max(int(x) for x in max_new)
-        pad = int(self.gen.pad_token_id)
-        rows = len(order)
-        seq = np.full((rows, p_width + n), pad, np.int32)
-        seq_mask = np.zeros((rows, p_width + n), np.int32)
-        resp = np.full((rows, n), pad, np.int32)
-        resp_mask = np.zeros((rows, n), np.int32)
-        lps = np.zeros((rows, n), np.float32)
-        prompt_lens = np.zeros((rows,), np.int32)
-        for row, rid in enumerate(order):
-            req = driver.result(rid)
-            if req.state is not RequestState.FINISHED:
-                raise RuntimeError(
-                    f"rollout request {rid} ended {req.state.value!r} "
-                    f"({req.finish_reason!r}); rollouts require every "
-                    "request to finish — disable deadlines/shedding on "
-                    "the rollout engine")
-            p = req.prompt_tokens
-            g = req.generated
-            gl = req.generated_logprobs
-            prompt_lens[row] = len(p)
-            seq[row, :len(p)] = p
-            seq_mask[row, :len(p)] = 1
-            seq[row, len(p):len(p) + len(g)] = g
-            seq_mask[row, len(p):len(p) + len(g)] = 1
-            resp[row, :len(g)] = g
-            resp_mask[row, :len(g)] = 1
-            lps[row, :len(g)] = gl
-        lengths = prompt_lens + resp_mask.sum(axis=1).astype(np.int32)
-        return {
-            "sequences": jnp.asarray(seq),
-            "sequence_mask": jnp.asarray(seq_mask),
-            "response_tokens": jnp.asarray(resp),
-            "response_mask": jnp.asarray(resp_mask),
-            "response_logps": jnp.asarray(lps),
-            "lengths": jnp.asarray(lengths),
-            "prompt_lens": jnp.asarray(prompt_lens),
-        }
+        host = assemble_rows(driver.result, order, p_width, n,
+                             int(self.gen.pad_token_id))
+        return {k: jnp.asarray(v) for k, v in host.items()}

@@ -42,12 +42,14 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from dla_tpu.ops.fused_ce import fused_token_logprobs
+from dla_tpu.rollout.actor_fleet import SamplerFleet, SamplerFleetConfig
 from dla_tpu.rollout.engine import RolloutEngine, RolloutMetrics
 from dla_tpu.rollout.refit import WeightRefitter
 from dla_tpu.serving.server import ServingConfig
@@ -74,6 +76,7 @@ class RolloutPipeline:
                  mode: str = "sync",
                  max_staleness_updates: int = 1,
                  donate_refit: bool = False,
+                 deterministic_refit: bool = False,
                  metrics: Optional[RolloutMetrics] = None):
         if mode not in ("sync", "async"):
             raise ValueError(f"rollout mode must be sync|async, got {mode!r}")
@@ -81,6 +84,14 @@ class RolloutPipeline:
         self.sample_fn = sample_fn
         self.mode = mode
         self.max_staleness_updates = int(max_staleness_updates)
+        # deterministic refit schedule: rollout j is ALWAYS generated
+        # from the params of notify j-1 (seq 0 := the initial params) —
+        # the generator waits for that handoff instead of racing for
+        # whatever _pending holds. Overlap survives (gen(j) runs during
+        # update j-1's epochs) and staleness becomes a constant
+        # updates-per-rollout, which is what makes an elastic-fleet run
+        # bit-reproducible against its planned-topology twin.
+        self.deterministic_refit = bool(deterministic_refit)
         self.metrics = metrics or rollout.metrics
         self._refitter = WeightRefitter(
             rollout, lambda: None, donate=donate_refit,
@@ -91,11 +102,14 @@ class RolloutPipeline:
         # inner lock for the cross-thread counters/handoff below; always
         # taken AFTER _lock (witnessed order), held only for field flips
         self._state_lock = threading.Lock()
+        self._cond = threading.Condition(self._state_lock)
         self._q: "queue.Queue" = queue.Queue(maxsize=1)
         self._samples: Dict[int, Tuple] = {}
         self._updates = 0            # learner optimizer updates so far
         self._version = 0            # updates snapshot at last refit
         self._pending: Optional[Tuple] = None   # (params, version)
+        self._notify_seq = 0         # notify-with-params calls so far
+        self._handoffs: Dict[int, Tuple] = {}   # seq -> (params, ver)
         self._next_idx = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -116,6 +130,18 @@ class RolloutPipeline:
                 # sync mode refits inside get(); holding params here
                 # would just pin a dead tree
                 self._pending = (params, self._updates)
+                if self.deterministic_refit:
+                    self._notify_seq += 1
+                    self._handoffs[self._notify_seq] = (params,
+                                                        self._updates)
+                    self._cond.notify_all()
+            elif (self.deterministic_refit and self.mode == "async"
+                  and int(n) > 0):
+                raise ValueError(
+                    "deterministic_refit pipelines need params on "
+                    "every notify_updates: rollout j is generated from "
+                    "notify j-1's params, so a params-less notify "
+                    "would wedge the generator")
             gap = self._updates - self._version
         self.metrics.staleness.set(gap)
 
@@ -130,10 +156,15 @@ class RolloutPipeline:
             sample = self._sample(idx)
             if params is not None:
                 with self._lock:
-                    self._refitter.refit(params)
                     with self._state_lock:
-                        self._version = self._updates
-            return self._generate(sample), 0
+                        upd = self._updates
+                    self._refitter.refit(params, version=upd)
+                    with self._state_lock:
+                        self._version = upd
+            out = self._generate(sample)
+            # a fleet rollout can be stale even in sync mode: a member
+            # that failed the refit fanout kept its old weights
+            return out, (self._attach_row_staleness(out) or 0)
 
         self._ensure_thread()
         if params is not None:
@@ -150,6 +181,11 @@ class RolloutPipeline:
             raise RuntimeError(
                 f"rollouts must be consumed in order: expected {idx}, "
                 f"generated {got_idx}")
+        row_stale = self._attach_row_staleness(out)
+        if row_stale is not None:
+            # members refit independently: the batch's effective
+            # staleness (discard bound) is its WORST trajectory's
+            staleness = max(staleness, row_stale)
         self.metrics.staleness.set(staleness)
         if staleness > self.max_staleness_updates:
             # too far behind any correction we trust: drop it, refit the
@@ -158,27 +194,56 @@ class RolloutPipeline:
             with self._lock:
                 pend = self._take_pending()
                 if pend is not None:
-                    self._refitter.refit(pend[0])
+                    self._refitter.refit(pend[0], version=pend[1])
                     with self._state_lock:
                         self._version = pend[1]
                 out = self._generate(self._sample(idx))
-            return out, 0
+            return out, (self._attach_row_staleness(out) or 0)
         if staleness > 0:
             self.metrics.stale_rollouts.inc()
         return out, staleness
 
-    def close(self) -> None:
-        """Stop the generator thread and close the rollout engine."""
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the generator thread, then close the rollout engine —
+        strictly in that order. The generator may be (a) blocked on the
+        depth-1 queue's put, (b) waiting for a deterministic-refit
+        handoff, or (c) mid-generation inside the engine; ``_stop``
+        unblocks (a) and (b), and ``request_stop()`` makes (c) raise
+        :class:`~dla_tpu.rollout.engine.RolloutStopped` at its next
+        drain step. Only once the thread has exited (or the bounded
+        deadline passed) is the engine torn down — closing the
+        supervisor under a live generator was the deadlock this
+        ordering fixes."""
         self._stop.set()
+        stop = getattr(self.rollout, "request_stop", None)
+        if stop is not None:
+            stop()
         if self._thread is not None:
-            while self._thread.is_alive():
+            deadline = time.monotonic() + float(timeout)
+            while self._thread.is_alive() \
+                    and time.monotonic() < deadline:
                 try:                 # unwedge a blocked put
                     self._q.get_nowait()
                 except queue.Empty:
                     pass
-                self._thread.join(timeout=0.1)
+                self._thread.join(timeout=0.05)
             self._thread = None
         self.rollout.close()
+
+    def _attach_row_staleness(self, out) -> Optional[int]:
+        """Fleet outputs carry ``row_versions`` (the per-trajectory
+        behavior-param version tags); attach the per-trajectory
+        staleness vector ``staleness_updates = updates_now -
+        row_versions`` and return its max (None for single-engine
+        outputs, which stay on the scalar path)."""
+        if not isinstance(out, dict) or "row_versions" not in out:
+            return None
+        with self._state_lock:
+            upd = self._updates
+        vec = jnp.maximum(
+            jnp.int32(upd) - out["row_versions"].astype(jnp.int32), 0)
+        out["staleness_updates"] = vec
+        return int(jnp.max(vec)) if vec.size else 0
 
     @staticmethod
     def _snapshot(params):
@@ -223,14 +288,40 @@ class RolloutPipeline:
             target=self._run, name="dla-rollout-generator", daemon=True)
         self._thread.start()
 
+    def _wait_handoff(self, idx: int) -> Optional[Tuple]:
+        """Deterministic-refit schedule: block until notify ``idx - 1``
+        has posted its params and return that handoff (None for
+        idx <= 1 — those rollouts use the initial params, seq 0). Runs
+        WITHOUT ``_lock`` held, so the consumer's discard-regenerate
+        path can take the engine while the generator waits."""
+        if idx < 1:
+            return None
+        # _cond wraps _state_lock; enter via the lock itself so the
+        # write side (notify_updates) and this wait visibly share it
+        with self._state_lock:
+            while self._notify_seq < idx - 1 \
+                    and not self._stop.is_set():
+                self._cond.wait(timeout=0.1)
+            if self._stop.is_set():
+                return None
+            pend = self._handoffs.get(idx - 1)
+            for k in [k for k in self._handoffs if k < idx - 1]:
+                del self._handoffs[k]
+            return pend
+
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
                 idx = self._next_idx
+                pend = (self._wait_handoff(idx)
+                        if self.deterministic_refit else None)
+                if self._stop.is_set():
+                    return
                 with self._lock:
-                    pend = self._take_pending()
+                    if not self.deterministic_refit:
+                        pend = self._take_pending()
                     if pend is not None:
-                        self._refitter.refit(pend[0])
+                        self._refitter.refit(pend[0], version=pend[1])
                     with self._state_lock:
                         if pend is not None:
                             self._version = pend[1]
@@ -314,6 +405,7 @@ def build_rollout_pipeline(model, params, gen, sample_fn, *,
                            donate_refit: bool = False,
                            supervisor=None,
                            serving: Optional[Dict] = None,
+                           fleet: Optional[Dict] = None,
                            metrics: Optional[RolloutMetrics] = None
                            ) -> RolloutPipeline:
     """Wire a RolloutPipeline from trainer-level quantities, deriving a
@@ -322,7 +414,13 @@ def build_rollout_pipeline(model, params, gen, sample_fn, *,
     whole pages) and the page pool covers all slots plus the reserved
     trash page. ``serving`` overrides any ServingConfig field; G > 1
     defaults the prefix cache ON (chunked prefill at page granularity)
-    so the G seeded copies of each prompt alias their prompt pages."""
+    so the G seeded copies of each prompt alias their prompt pages.
+
+    ``fleet`` (SamplerFleetConfig fields) swaps the single
+    RolloutEngine for an elastic :class:`SamplerFleet` of N of them;
+    async fleet pipelines run the deterministic refit schedule, the
+    piece that makes an elastic run bit-reproducible against its
+    planned-topology twin."""
     over = dict(serving or {})
     page = int(over.pop("page_size", 16))
     need = prompt_width + int(gen.max_new_tokens)
@@ -342,10 +440,19 @@ def build_rollout_pipeline(model, params, gen, sample_fn, *,
         # learner's first donated update deletes these buffers while
         # the generator thread may still be decoding with them
         params = RolloutPipeline._snapshot(params)
-    rollout = RolloutEngine(model, params, gen, cfg,
-                            samples_per_prompt=samples_per_prompt,
-                            supervisor=supervisor, metrics=metrics)
+    if fleet is not None:
+        fleet_cfg = SamplerFleetConfig.from_config(fleet)
+        rollout = SamplerFleet(model, params, gen, cfg, fleet_cfg,
+                               samples_per_prompt=samples_per_prompt,
+                               supervisor=supervisor or True,
+                               metrics=metrics)
+    else:
+        rollout = RolloutEngine(model, params, gen, cfg,
+                                samples_per_prompt=samples_per_prompt,
+                                supervisor=supervisor, metrics=metrics)
     return RolloutPipeline(rollout, sample_fn, mode=mode,
                            max_staleness_updates=max_staleness_updates,
                            donate_refit=donate_refit,
+                           deterministic_refit=(fleet is not None
+                                                and mode == "async"),
                            metrics=rollout.metrics)

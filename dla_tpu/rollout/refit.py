@@ -45,15 +45,21 @@ class WeightRefitter:
         self.donate = donate
         self.metrics = metrics or rollout.metrics
 
-    def refit(self, params=None) -> float:
+    def refit(self, params=None, version: Optional[int] = None) -> float:
         """Build (or take) the new tree and publish it. Returns the
         refit wall time in ms (param build + validation + swap;
         ``block_until_ready`` so queued merge/quantize work is charged
-        here, not to the first decode)."""
+        here, not to the first decode). ``version`` stamps the tree
+        with the learner update count it came from — the staleness tag
+        fleet members carry per trajectory. Against a
+        :class:`~dla_tpu.rollout.actor_fleet.SamplerFleet` the publish
+        is the broadcast-tree fanout, so this one call refits every
+        member in tree-depth (not N) wall time."""
         t0 = time.perf_counter()
         new = self.param_fn() if params is None else params
         new = jax.block_until_ready(new)
-        self.rollout.publish_params(new, donate=self.donate)
+        self.rollout.publish_params(new, donate=self.donate,
+                                    version=version)
         ms = (time.perf_counter() - t0) * 1000.0
         self.metrics.refits.inc()
         self.metrics.refit_ms.set(ms)

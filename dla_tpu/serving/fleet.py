@@ -73,6 +73,29 @@ PLACEMENTS = ("cache_aware", "random", "round_robin")
 ROLES = ("prefill", "decode", "mixed")
 
 
+def broadcast_waves(n: int, branch: int) -> List[List[int]]:
+    """Partition member indices ``0..n-1`` into broadcast-tree waves:
+    the root (the caller — learner or router) sends to ``branch``
+    members in wave 0, then every member that already holds the payload
+    forwards to ``branch`` more per wave, so coverage multiplies by
+    ``1 + branch`` each wave and the wave count — the wall-clock bound
+    when each wave runs concurrently on the target members' executors —
+    is ``ceil(log_{1+branch}(n/branch + 1))``, not ``n``. Shared by the
+    sampler-fleet refit fanout (rollout.actor_fleet) and
+    :meth:`FleetRouter.publish_params`."""
+    if branch < 1:
+        raise ValueError(f"broadcast branch must be >= 1, got {branch}")
+    waves: List[List[int]] = []
+    holders = 1                     # the root already has the payload
+    nxt = 0
+    while nxt < n:
+        wave = list(range(nxt, min(n, nxt + holders * branch)))
+        waves.append(wave)
+        nxt += len(wave)
+        holders += len(wave)
+    return waves
+
+
 @dataclass(frozen=True)
 class FleetConfig:
     """Router + autoscaler knobs (``latency.serving.fleet`` in config).
@@ -446,6 +469,27 @@ class FleetRouter:
 
     # ``poll`` is the streaming-consumer name for the same operation
     poll = step
+
+    def publish_params(self, params, donate: bool = False,
+                       branch: int = 2) -> None:
+        """Fleet-wide weight refit: publish ``params`` into every live
+        member's engine via the broadcast-tree wave schedule
+        (:func:`broadcast_waves`) — each wave's publishes run
+        concurrently on the target members' own executors, so wall time
+        is bounded by the tree depth, not the member count. The swap is
+        the usual zero-recompile pointer update per member. Note:
+        publishes reach the LIVE engines only; a later supervisor
+        rebuild re-reads the caller's factory tree, so callers that
+        refit must also update whatever their factory closes over (the
+        RolloutEngine-per-member sampler fleet does; see
+        rollout.actor_fleet)."""
+        members = self.members()
+        for wave in broadcast_waves(len(members), branch):
+            futures = [members[i].pool.submit(
+                members[i].engine.publish_params, params, donate=donate)
+                for i in wave]
+            for fut in futures:
+                fut.result()
 
     def has_work(self) -> bool:
         return any(m.sup.has_work() for m in self.members())

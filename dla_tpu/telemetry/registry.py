@@ -370,6 +370,25 @@ CATALOG: Tuple[MetricSpec, ...] = (
     _s("rollout/discarded_rollouts", "counter", "rollouts",
        "async rollouts discarded for exceeding max_staleness_updates "
        "and regenerated fresh"),
+    # -- elastic sampler fleet (rollout.actor_fleet): fleet-level panel,
+    #    delta-mirrored on the SamplerFleet's own registry so totals
+    #    survive member retirement and respawn
+    _s("rollout/fleet/samplers_active", "gauge", "samplers",
+       "fleet members currently accepting rollout work (target size "
+       "minus retired, plus regrown)"),
+    _s("rollout/fleet/refit_fanout_ms", "gauge", "ms",
+       "wall time of the last broadcast-tree refit fanout across all "
+       "active members (bounded by tree depth, not N)"),
+    _s("rollout/fleet/retired_samplers", "counter", "samplers",
+       "members removed from the fleet (lease expiry, repeated refit "
+       "failure, drive crash, or injected sampler=lost)"),
+    _s("rollout/fleet/reassigned_rollouts", "counter", "groups",
+       "trajectory groups reassigned from a lost member to survivors "
+       "and regenerated bit-identically from journaled (prompt, seed) "
+       "pairs"),
+    _s("rollout/fleet/trajectory_queue_depth", "gauge", "groups",
+       "staleness-tagged trajectory groups waiting in the bounded "
+       "multi-producer queue at last observation"),
     # -- XLA introspection (telemetry.xla_introspect); per-fn series
     #    (telemetry/xla/<fn>/flops, .../recompiles, ...) ride the
     #    telemetry/xla/ dynamic prefix below

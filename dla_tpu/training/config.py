@@ -357,6 +357,27 @@ class RolloutServingSchema:
 
 
 @dataclasses.dataclass(frozen=True)
+class RolloutFleetSchema:
+    """ppo.rollout.fleet: elastic sampler fleet
+    (rollout.actor_fleet.SamplerFleetConfig; docs/RLHF.md
+    "Disaggregated sampler fleet"). N supervised rollout engines with
+    broadcast-tree refit fanout, lease-based member loss detection,
+    and journaled-seed reassignment."""
+    samplers: Any = None
+    fanout_branch: Any = None
+    refit_timeout_s: Any = None
+    refit_retries: Any = None
+    retire_after_failures: Any = None
+    lease_ttl_s: Any = None
+    step_wedge_s: Any = None
+    collect_poll_s: Any = None
+    traj_queue_cap: Any = None
+    regrow: Any = None
+    min_samplers: Any = None
+    refit_delay_s: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class RolloutSchema:
     """ppo.rollout: disaggregated rollouts through the serving engine
     (dla_tpu.rollout; docs/RLHF.md). donate_refit frees the previous
@@ -370,6 +391,7 @@ class RolloutSchema:
     supervised: Any = None
     donate_refit: Any = None
     serving: Optional[RolloutServingSchema] = None
+    fleet: Optional[RolloutFleetSchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -525,6 +525,7 @@ def main(argv=None) -> None:
                     base_seed * 100_003 + idx, local_bs)
                 return ids, mask, seeds
 
+            fleet_cfg = rollout_cfg.get("fleet")
             pipeline = build_rollout_pipeline(
                 policy.model, rollout_params(), gen, sample_rollout,
                 rows=local_bs, prompt_width=prompt_width,
@@ -535,13 +536,16 @@ def main(argv=None) -> None:
                 donate_refit=bool(rollout_cfg.get("donate_refit", False)),
                 supervisor=bool(rollout_cfg.get("supervised", False))
                 or None,
-                serving=rollout_cfg.get("serving"))
+                serving=rollout_cfg.get("serving"),
+                fleet=fleet_cfg)
             staleness_corrector = make_staleness_corrector(
                 policy.model, is_clip=float(rollout_cfg.get("is_clip", 2.0)))
             log_rank_zero(
                 f"[dla_tpu] rollout backend: serving "
                 f"(mode={pipeline.mode}, G={samples_per_prompt}, "
-                f"slots={pipeline.rollout.cfg.num_slots})")
+                f"slots={pipeline.rollout.cfg.num_slots}"
+                + (f", fleet={pipeline.rollout.fleet_cfg.samplers}"
+                   if fleet_cfg is not None else "") + ")")
 
         rollout_idx = 0
         if args.resume:
@@ -619,6 +623,15 @@ def main(argv=None) -> None:
                     # clipped at ppo.rollout.is_clip) reweight the
                     # advantages — the standard bounded-lag correction
                     w = staleness_corrector(rp, out)
+                    if isinstance(out, dict) \
+                            and "staleness_updates" in out:
+                        # fleet rollouts are stale per TRAJECTORY (fleet
+                        # members refit at different learner versions):
+                        # rows generated at the current version stay
+                        # exactly on-policy (weight 1); only laggard
+                        # members' rows are reweighted
+                        w = jnp.where(out["staleness_updates"] > 0,
+                                      w, jnp.float32(1.0))
                     scores = {**scores,
                               "advantages": apply_staleness_correction(
                                   scores["advantages"], w)}
@@ -708,6 +721,12 @@ def main(argv=None) -> None:
                     trainer.save(extra_aux=model_aux(
                         policy, model_cfg.get("tokenizer")))
 
+            # the chaos acceptance compares an elastic run against its
+            # planned-topology twin, compile counters included — put
+            # the learner's on the record at loop exit
+            log_rank_zero(
+                f"[dla_tpu] rollout loop done "
+                f"(train_step_compiles={trainer.train_step_compiles})")
         finally:
             # the rollout loop drives step_on_batch directly (no
             # fit()), so it owns closing an in-flight

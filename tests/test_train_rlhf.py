@@ -243,6 +243,55 @@ def test_local_rollout_shape_host_and_group_edge_cases(capsys):
         compute_local_rollout_shape(64, 4, 3)
 
 
+def test_rlhf_fleet_chaos_equals_planned_e2e(tmp_path, capsys):
+    """The fleet chaos acceptance, end to end through train_rlhf: an
+    async sampler-fleet run (N=2) that loses member 1 mid-run via a
+    ``sampler=`` fault plan produces the SAME loss trajectory and a
+    bit-identical final checkpoint as a planned N=1 run, with the
+    learner's train_step compiled exactly once in both."""
+    import yaml as _yaml
+
+    from dla_tpu.training.train_rlhf import main
+
+    def run(tag, samplers, fault_plan):
+        root = tmp_path / tag
+        root.mkdir()
+        cfgp = _rlhf_cfg(root, "reinforce", steps=2)
+        cfg = _yaml.safe_load(cfgp.read_text())
+        cfg["logging"]["log_every_steps"] = 1
+        cfg["ppo"]["rollout"] = {
+            "backend": "serving", "mode": "async",
+            "max_staleness_updates": 2,
+            "serving": {"page_size": 4, "fault_plan": fault_plan},
+            "fleet": {"samplers": samplers, "lease_ttl_s": 0.5},
+        }
+        cfgp.write_text(_yaml.safe_dump(cfg))
+        main(["--config", str(cfgp)])
+        assert "train_step_compiles=1" in capsys.readouterr().out
+        recs = []
+        with open(root / "logs" / "metrics.jsonl") as fh:
+            for line in fh:
+                recs.append(json.loads(line))
+        return root, recs
+
+    chaos_root, chaos_recs = run(
+        "chaos", 2, "sampler=1:rollout_step=1:lost")
+    plan_root, plan_recs = run("planned", 1, "")
+
+    assert len(chaos_recs) == len(plan_recs) == 2
+    for cr, pr in zip(chaos_recs, plan_recs):
+        assert cr["train/loss"] == pr["train/loss"]
+        assert cr["train/reward_mean"] == pr["train/reward_mean"]
+    c_final = chaos_root / "ckpt" / "final"
+    p_final = plan_root / "ckpt" / "final"
+    c_files = sorted(f.name for f in c_final.glob("*.npy"))
+    assert c_files == sorted(f.name for f in p_final.glob("*.npy"))
+    assert c_files, "final checkpoint wrote no arrays"
+    for name in c_files:
+        assert np.array_equal(np.load(c_final / name),
+                              np.load(p_final / name)), name
+
+
 def test_rlhf_serving_rollout_backend_e2e(tmp_path):
     """End-to-end smoke: the full RLHF loop with ppo.rollout.backend:
     serving — rollouts come from the serving engine (sync mode, refit

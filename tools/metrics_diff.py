@@ -50,7 +50,7 @@ HIGHER_IS_BETTER = ("tokens_per_sec", "goodput", "mfu", "throughput",
                     "samples_per_sec", "_per_second", "saved_frac",
                     "hit_rate", "tokens_per_s", "padding_waste_recovered",
                     "acceptance_rate", "speedup", "retention", "scaling",
-                    "pages_per_s")
+                    "pages_per_s", "trajectories_per_s")
 
 
 def direction(name: str) -> int:
