@@ -675,9 +675,13 @@ def run_rollout_fleet_bench() -> dict:
     from dla_tpu.models.config import ModelConfig
     from dla_tpu.models.transformer import Transformer
     from dla_tpu.ops.sampling import derive_rollout_seeds
-    from dla_tpu.rollout import SamplerFleet, SamplerFleetConfig
+    from dla_tpu.rollout import (SamplerFleet, SamplerFleetConfig,
+                                 ensure_cpu_sync_dispatch)
     from dla_tpu.serving import ServingConfig
 
+    # must precede the first jax computation below — the CPU client
+    # bakes the dispatch mode in at creation (see actor_fleet)
+    ensure_cpu_sync_dispatch()
     cfg = ModelConfig(
         vocab_size=512, hidden_size=64, intermediate_size=192,
         num_layers=2, num_heads=4, num_kv_heads=4,

@@ -25,6 +25,8 @@ from dla_tpu.rollout.actor_fleet import (
     SamplerFleetConfig,
     SamplerFleetMetrics,
     TrajectoryGroup,
+    ensure_cpu_sync_dispatch,
+    learner_dispatch_gate,
     shard_trajectory_groups,
 )
 from dla_tpu.rollout.engine import (
@@ -54,6 +56,8 @@ __all__ = [
     "apply_staleness_correction",
     "assemble_rows",
     "build_rollout_pipeline",
+    "ensure_cpu_sync_dispatch",
+    "learner_dispatch_gate",
     "make_staleness_corrector",
     "shard_trajectory_groups",
 ]

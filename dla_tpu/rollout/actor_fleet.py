@@ -23,8 +23,12 @@ ever stalling the learner's step loop.
 
 **Trajectory sharding.** Completed groups land on the bounded queue
 tagged with the emitting member's slot, param version (the learner
-update count stamped at its last successful refit), and membership
-epoch. The consumer side reassembles strictly in group order —
+update count stamped at its last successful refit), membership epoch,
+and the rollout index they were generated for — the collector accepts
+a group only from its current owner for the current rollout (and the
+queue is drained at each rollout start), so a slow retired member can
+never leak rows across a rollout boundary. The consumer side
+reassembles strictly in group order —
 completion order can never change the arrays — and
 :func:`shard_trajectory_groups` deterministically slices groups across
 learner data-parallel ranks. Because members refit at different times
@@ -47,6 +51,7 @@ fleet can re-grow to target size through the same engine factory
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue
 import threading
@@ -146,12 +151,17 @@ class TrajectoryGroup:
     samples as host arrays (the per-group slice of the
     ``build_generate_fn`` output contract), staleness-tagged with the
     emitting member's param ``version`` (learner update count at its
-    last successful refit) and the fleet membership ``epoch``."""
+    last successful refit) and the fleet membership ``epoch``.
+    ``rollout`` is the fleet rollout index the group was generated FOR:
+    the collector discards any group whose tag does not match the
+    rollout it is assembling, so a slow retired-but-alive member can
+    never leak rollout N's rows into rollout N+1."""
     group: int
     member: int
     version: int
     epoch: int
     rows: Dict[str, np.ndarray]
+    rollout: int = 0
     error: Optional[BaseException] = None   # drive-crash sentinel
 
 
@@ -173,6 +183,62 @@ def shard_trajectory_groups(groups: Sequence[TrajectoryGroup],
         shards.append(ordered[at:at + take])
         at += take
     return shards
+
+
+# On the virtual CPU mesh, every sharded program needs all 8 device
+# participants to rendezvous inside XLA's intra-op thread pool; N member
+# threads plus the learner dispatching concurrently can starve the pool
+# and deadlock the rendezvous (observed live on a 1-core box: two
+# drive-loop run_ids plus a train step interleaved, all stuck; also
+# reproduced with just ONE member program against the learner's train
+# step). The gate serializes the fleet's dispatches against each other
+# AND — via :func:`learner_dispatch_gate` — against the learner's
+# sharded programs, so exactly one multi-participant program runs at a
+# time. Process-wide on purpose: two fleets in one process
+# (chaos-vs-planned A/Bs) share the one CPU runtime. None on TPU,
+# where the runtime queues per-device and members own their own
+# slices.
+_CPU_DISPATCH_GATE = threading.Lock()
+
+
+def _read_jax_flag(name: str) -> Optional[bool]:
+    """Current value of a JAX config flag, or None if this JAX version
+    exposes no way to read it (in which case the caller skips the
+    restore rather than guessing)."""
+    try:
+        return bool(getattr(jax.config, name))
+    except AttributeError:
+        pass
+    try:
+        return bool(jax.config._value_holders[name].value)
+    except Exception:
+        return None
+
+
+def ensure_cpu_sync_dispatch() -> None:
+    """Disable async dispatch for the CPU backend. MUST run before the
+    process's first jax computation: the flag is read ONCE when the CPU
+    client is created, and updating it afterwards is a no-op — so the
+    :class:`SamplerFleet` constructor's own update only protects
+    processes that build the fleet before touching jax (the test
+    suite's conftest sets it at import for the same reason; a training
+    CLI builds the learner first and needs this called up front).
+    Harmless when the backend is TPU — the flag only shapes the cpu
+    client."""
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+
+def learner_dispatch_gate():
+    """Context manager serializing the CALLER's XLA dispatch with fleet
+    members' (see ``_CPU_DISPATCH_GATE``). The learner's rollout loop
+    wraps its score/update section in this so its sharded programs
+    never interleave with a member's — members queue at the gate
+    (lease-safe: a queued ``_drive`` refreshes ``step_started``) and
+    resume the moment the learner's section ends. Null away from the
+    cpu backend, where overlap is the point, not a hazard."""
+    if jax.default_backend() == "cpu":
+        return _CPU_DISPATCH_GATE
+    return contextlib.nullcontext()
 
 
 class _Sampler:
@@ -264,10 +330,22 @@ class SamplerFleet:
         # CPU mesh interleave collective participants across rendezvous
         # and deadlock the inline CPU runtime; synchronous dispatch is
         # the documented escape (tests/conftest.py applies it suite-wide
-        # for the same reason). No-op on TPU, where the runtime queues
-        # per-device and samplers own their own slices.
+        # for the same reason). The update below only bites if the CPU
+        # client does not exist yet — the flag is baked in at client
+        # creation, which is why fleet-building CLIs call
+        # ensure_cpu_sync_dispatch() before their first jax use, and
+        # why _dispatch_gate exists as the in-process second layer. The
+        # flag is process-global, so the prior value is saved and
+        # restored on close() — the override must not outlive the
+        # fleet. No-op on TPU, where the runtime queues per-device and
+        # samplers own their own slices.
+        self._prev_async_dispatch: Optional[bool] = None
+        self._dispatch_gate: Optional[threading.Lock] = None
         if jax.default_backend() == "cpu":
+            self._prev_async_dispatch = _read_jax_flag(
+                "jax_cpu_enable_async_dispatch")
             jax.config.update("jax_cpu_enable_async_dispatch", False)
+            self._dispatch_gate = _CPU_DISPATCH_GATE
         for _ in range(int(fleet_cfg.samplers)):
             self._spawn()
 
@@ -360,9 +438,20 @@ class SamplerFleet:
                         ok = True
                         break
                     except FutureTimeout:
-                        pass            # executor wedged or slow
+                        # the member's single executor thread is wedged
+                        # (or merely slow): a resubmit would queue
+                        # BEHIND the stuck attempt on that same thread
+                        # and can never run sooner, so retries only
+                        # burn learner time — give up now. If the
+                        # original later completes it applies params on
+                        # the member's own drive thread, so m.version
+                        # and the rows it tags stay consistent; this
+                        # fanout still records the failure because the
+                        # learner could not confirm it in time.
+                        break
                     except Exception:
-                        pass            # publish raised (validation...)
+                        pass   # publish raised (validation/transient):
+                        #        the thread is alive, a retry can help
                     if attempt < int(fc.refit_retries):
                         fut = m.pool.submit(self._publish_one, m,
                                             params, donate, version)
@@ -399,7 +488,9 @@ class SamplerFleet:
         dispatch, and ``m.version`` is only ever written here."""
         if self.fleet_cfg.refit_delay_s > 0:
             time.sleep(self.fleet_cfg.refit_delay_s)
-        m.engine.publish_params(params, donate=donate, version=version)
+        with self._dispatch_gate or contextlib.nullcontext():
+            m.engine.publish_params(params, donate=donate,
+                                    version=version)
         if version is not None:
             m.version = int(version)
 
@@ -432,11 +523,22 @@ class SamplerFleet:
         self.rollouts_started += 1
         fc = self.fleet_cfg
         if fc.regrow:
-            while len(self.active()) < int(fc.samplers):
+            # bounded attempts: a factory that keeps producing wedged
+            # members must not turn the rollout into a spawn loop
+            attempts = int(fc.samplers)
+            while len(self.active()) < int(fc.samplers) and attempts > 0:
+                attempts -= 1
                 grown = self._spawn()
-                # a fresh member starts from the CURRENT tree+version
-                grown.pool.submit(self._publish_one, grown, self._params,
-                                  False, self.version).result()
+                # a fresh member starts from the CURRENT tree+version;
+                # same deadline as the fanout — regrow must never stall
+                # the learner on a wedged fresh member either
+                fut = grown.pool.submit(self._publish_one, grown,
+                                        self._params, False, self.version)
+                try:
+                    fut.result(timeout=fc.refit_timeout_s)
+                except Exception:   # FutureTimeout or a raised publish
+                    self._retire(grown, "regrow_refit_failed")
+                    continue
                 with self._state_lock:
                     self.epoch += 1
                 self._record("sampler_grown", slot=grown.slot,
@@ -472,9 +574,18 @@ class SamplerFleet:
                   for m in self._samplers}
         self._record("fleet_rollout_begin", rollout=idx,
                      groups=b_unique, samplers=len(members))
+        # drain stale leftovers before dispatching: a member retired
+        # mid-collect (lease expiry) may have emitted its group after
+        # the reassigned copy won, and nothing consumes the queue
+        # between rollouts
+        try:
+            while True:
+                self._traj_q.get_nowait()
+        except queue.Empty:
+            pass
         for m in members:
             if assignment[m.slot]:
-                self._dispatch_drive(m, assignment[m.slot], shape)
+                self._dispatch_drive(m, assignment[m.slot], shape, idx)
         done = self._collect(idx, b_unique, owner, shape)
         out = self._assemble(done, b_unique)
         t1 = self._now()
@@ -498,23 +609,25 @@ class SamplerFleet:
         return out
 
     def _dispatch_drive(self, m: _Sampler, groups: List[int],
-                        shape: Tuple[int, int]) -> None:
+                        shape: Tuple[int, int], idx: int) -> None:
         """Reset the member's lease (it may have idled since its last
         drive — an instant re-expiry is not a death) and queue the
         drive on its executor."""
         with self._state_lock:
             self._leases[m.slot] = self._now()
-        m.pool.submit(self._drive, m, groups, shape)
+        m.pool.submit(self._drive, m, groups, shape, idx)
 
     def _drive(self, m: _Sampler, groups: List[int],
-               shape: Tuple[int, int]) -> None:
+               shape: Tuple[int, int], idx: int) -> None:
         """Runs ON the member's executor: submit the assigned groups'
         G seeded requests, step the supervised engine, beat the lease
         each step, and emit each group onto the bounded queue as its
         last request reaches a terminal state. A ``killed`` member
         honors its remaining ``kill_budget`` then goes silent (no
         beats, no emissions) — the collector's lease check finds the
-        corpse."""
+        corpse. A member retired mid-drive (lease expired while merely
+        slow) notices at the next loop check and exits: its groups were
+        reassigned, so anything it would still produce is garbage."""
         p_width, n_pad = shape
         try:
             driver = m.driver
@@ -539,9 +652,12 @@ class SamplerFleet:
                     return
                 with self._state_lock:
                     dead = m.killed and m.kill_budget <= 0
+                    retired = m.retired
                     slow_s = m.slow_s
                 if dead:
                     return               # silent: no beat, no emission
+                if retired:
+                    return               # reassigned: stop producing
                 if slow_s > 0:
                     time.sleep(slow_s)
                 now = self._now()
@@ -550,7 +666,29 @@ class SamplerFleet:
                     m.step_started = now
                 try:
                     if driver.has_work():
-                        driver.step()
+                        # gate waits look mid-step to the collector:
+                        # step_started is already set, so step_wedge_s
+                        # (not the lease TTL) covers a queued member.
+                        # A wait can outlive even that grace (the
+                        # learner holds the gate across its first-step
+                        # compiles), so refresh step_started while
+                        # queued: waiting at the gate is queued, not
+                        # wedged
+                        gate = self._dispatch_gate
+                        if gate is None:
+                            driver.step()
+                        else:
+                            while not gate.acquire(timeout=5.0):
+                                if self._stop_requested.is_set():
+                                    return
+                                with self._state_lock:
+                                    if m.retired:
+                                        return
+                                    m.step_started = self._now()
+                            try:
+                                driver.step()
+                            finally:
+                                gate.release()
                 finally:
                     with self._state_lock:
                         m.step_started = None
@@ -563,7 +701,7 @@ class SamplerFleet:
                     rows = assemble_rows(driver.result, pending.pop(g),
                                          p_width, n_pad,
                                          int(self.gen.pad_token_id))
-                    self._emit(m, g, rows)
+                    self._emit(m, g, rows, idx)
                     with self._state_lock:
                         if m.killed:
                             m.kill_budget -= 1
@@ -580,18 +718,25 @@ class SamplerFleet:
                 self._traj_q.put(
                     TrajectoryGroup(group=-1, member=m.slot,
                                     version=m.version, epoch=ep,
-                                    rows={}, error=exc),
+                                    rows={}, rollout=idx, error=exc),
                     timeout=1.0)
             except queue.Full:
                 pass
 
     def _emit(self, m: _Sampler, g: int,
-              rows: Dict[str, np.ndarray]) -> None:
+              rows: Dict[str, np.ndarray], idx: int) -> None:
         with self._state_lock:
             ep = self.epoch
         tg = TrajectoryGroup(group=g, member=m.slot, version=m.version,
-                             epoch=ep, rows=rows)
+                             epoch=ep, rows=rows, rollout=idx)
         while not self._stop_requested.is_set():
+            with self._state_lock:
+                retired = m.retired
+            if retired:
+                # retired mid-backpressure: the group was reassigned
+                # and nothing will ever consume this emission — drop it
+                # rather than spin on a bounded queue forever
+                return
             try:
                 self._traj_q.put(tg, timeout=0.1)
                 return
@@ -606,9 +751,12 @@ class SamplerFleet:
         """Consumer side: drain the queue until every group arrived,
         checking leases on every poll timeout. A stale lease retires
         the member and reassigns its unfinished groups to survivors
-        (journaled prompts + seeds -> bit-identical regeneration). The
-        first arrival of a group wins — a member declared lost just as
-        it emits produces a duplicate, never a hole."""
+        (journaled prompts + seeds -> bit-identical regeneration). Only
+        groups tagged with THIS rollout index and emitted by the
+        group's CURRENT owner are accepted: a stale emission from a
+        prior rollout, or from a member retired after its groups were
+        reassigned, is discarded — the owner regenerates bit-identically
+        from the journal, so a discard is never a hole."""
         done: Dict[int, TrajectoryGroup] = {}
         while len(done) < b_unique:
             if self._stop_requested.is_set():
@@ -621,6 +769,13 @@ class SamplerFleet:
                 continue
             self.fleet_metrics.trajectory_queue_depth.set(
                 self._traj_q.qsize())
+            if tg.rollout != idx:
+                # stale leak from a prior rollout (slow retired member
+                # still flushing): its rows belong to other prompts
+                self._record("stale_group_discarded", rollout=idx,
+                             stale_rollout=tg.rollout, group=tg.group,
+                             slot=tg.member)
+                continue
             if tg.error is not None:
                 by_slot = {m.slot: m for m in self._samplers}
                 m = by_slot.get(tg.member)
@@ -629,6 +784,11 @@ class SamplerFleet:
                         m, f"drive_error:{type(tg.error).__name__}")
                     self._reassign(idx, b_unique, owner, done, shape,
                                    m.slot)
+                continue
+            if owner.get(tg.group) != tg.member:
+                # emitter lost ownership (retired + reassigned) before
+                # this arrival was consumed; the new owner's copy is
+                # the canonical one
                 continue
             done.setdefault(tg.group, tg)
         return done
@@ -680,7 +840,7 @@ class SamplerFleet:
         by_slot = {s.slot: s for s in survivors}
         for slot, groups in per.items():
             if groups:
-                self._dispatch_drive(by_slot[slot], groups, shape)
+                self._dispatch_drive(by_slot[slot], groups, shape, idx)
         self.fleet_metrics.reassigned_rollouts.inc(len(orphans))
         self._record("sampler_reassigned", rollout=idx,
                      from_slot=dead_slot, groups=len(orphans),
@@ -781,3 +941,7 @@ class SamplerFleet:
                 m.engine.close()
             except Exception:
                 pass
+        if self._prev_async_dispatch is not None:
+            jax.config.update("jax_cpu_enable_async_dispatch",
+                              self._prev_async_dispatch)
+            self._prev_async_dispatch = None

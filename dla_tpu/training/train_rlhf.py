@@ -39,6 +39,7 @@ crosses the boundary besides scalar logging).
 """
 from __future__ import annotations
 
+import contextlib
 import random
 from typing import Any, Dict, Tuple
 
@@ -282,6 +283,14 @@ def compute_local_rollout_shape(batch_size: int, n_procs: int,
 def main(argv=None) -> None:
     args = make_arg_parser("dla_tpu PPO-RLHF trainer").parse_args(argv)
     config = config_from_args(args)
+    # a sampler fleet on the CPU backend needs synchronous dispatch,
+    # and that flag is baked into the CPU client at creation — decide
+    # BEFORE the first jax call below (the fleet constructor's own
+    # update is a no-op once the learner has built the client)
+    if (dict(config.get("ppo") or {}).get("rollout") or {}).get(
+            "fleet") is not None:
+        from dla_tpu.rollout import ensure_cpu_sync_dispatch
+        ensure_cpu_sync_dispatch()
     initialize_distributed(config.get("hardware"))
     mesh = mesh_from_config(config.get("hardware"))
     rng = seed_everything(int(config.get("seed", 0)))
@@ -547,6 +556,19 @@ def main(argv=None) -> None:
                 + (f", fleet={pipeline.rollout.fleet_cfg.samplers}"
                    if fleet_cfg is not None else "") + ")")
 
+        # cpu-backend fleet runs: the learner's sharded score/update
+        # programs must not interleave with a member's (XLA collective
+        # rendezvous starvation — see actor_fleet._CPU_DISPATCH_GATE),
+        # so the update section below runs under the fleet's dispatch
+        # gate; members queue at it (lease-safe) and resume between the
+        # learner's sections. Null context for non-fleet runs and away
+        # from the cpu backend, where overlap is the point.
+        if pipeline is not None \
+                and getattr(pipeline.rollout, "fleet_cfg", None) is not None:
+            from dla_tpu.rollout import learner_dispatch_gate as learner_gate
+        else:
+            learner_gate = contextlib.nullcontext
+
         rollout_idx = 0
         if args.resume:
             if trainer.try_resume() is not None:
@@ -593,127 +615,128 @@ def main(argv=None) -> None:
                     prompt_lens = jnp.repeat(
                         jnp.sum(gbatch["mask"], axis=1),
                         samples_per_prompt, axis=0)
-                if algo == "gae":
-                    if quant_fn is not None:
-                        # behavior stats must come from the SAME int8
-                        # tree that sampled (rp is already merged for
-                        # LoRA runs, so no separate adapters)
-                        scores = score_fn(
-                            rp, trainer.params["value_head"],
-                            ref_params, rm_params,
-                            out["sequences"], out["sequence_mask"],
-                            prompt_lens, jnp.float32(kl_coef))
+                with learner_gate():
+                    if algo == "gae":
+                        if quant_fn is not None:
+                            # behavior stats must come from the SAME int8
+                            # tree that sampled (rp is already merged for
+                            # LoRA runs, so no separate adapters)
+                            scores = score_fn(
+                                rp, trainer.params["value_head"],
+                                ref_params, rm_params,
+                                out["sequences"], out["sequence_mask"],
+                                prompt_lens, jnp.float32(kl_coef))
+                        else:
+                            scores = score_fn(
+                                trainer.frozen["base"] if use_lora
+                                else policy_tree(),
+                                trainer.params["value_head"],
+                                ref_params, rm_params,
+                                out["sequences"], out["sequence_mask"],
+                                prompt_lens, jnp.float32(kl_coef),
+                                lora=policy_tree() if use_lora else None)
                     else:
-                        scores = score_fn(
-                            trainer.frozen["base"] if use_lora
-                            else policy_tree(),
-                            trainer.params["value_head"],
-                            ref_params, rm_params,
-                            out["sequences"], out["sequence_mask"],
-                            prompt_lens, jnp.float32(kl_coef),
-                            lora=policy_tree() if use_lora else None)
-                else:
-                    scores = score_fn(rp, ref_params, rm_params,
-                                      out["sequences"], out["sequence_mask"],
-                                      jnp.float32(kl_coef))
-                if staleness > 0:
-                    # async rollout sampled `staleness` optimizer updates
-                    # behind the current policy: truncated importance
-                    # ratios (current vs. behavior mean response logp,
-                    # clipped at ppo.rollout.is_clip) reweight the
-                    # advantages — the standard bounded-lag correction
-                    w = staleness_corrector(rp, out)
-                    if isinstance(out, dict) \
-                            and "staleness_updates" in out:
-                        # fleet rollouts are stale per TRAJECTORY (fleet
-                        # members refit at different learner versions):
-                        # rows generated at the current version stay
-                        # exactly on-policy (weight 1); only laggard
-                        # members' rows are reweighted
-                        w = jnp.where(out["staleness_updates"] > 0,
-                                      w, jnp.float32(1.0))
-                    scores = {**scores,
-                              "advantages": apply_staleness_correction(
-                                  scores["advantages"], w)}
+                        scores = score_fn(rp, ref_params, rm_params,
+                                          out["sequences"], out["sequence_mask"],
+                                          jnp.float32(kl_coef))
+                    if staleness > 0:
+                        # async rollout sampled `staleness` optimizer updates
+                        # behind the current policy: truncated importance
+                        # ratios (current vs. behavior mean response logp,
+                        # clipped at ppo.rollout.is_clip) reweight the
+                        # advantages — the standard bounded-lag correction
+                        w = staleness_corrector(rp, out)
+                        if isinstance(out, dict) \
+                                and "staleness_updates" in out:
+                            # fleet rollouts are stale per TRAJECTORY (fleet
+                            # members refit at different learner versions):
+                            # rows generated at the current version stay
+                            # exactly on-policy (weight 1); only laggard
+                            # members' rows are reweighted
+                            w = jnp.where(out["staleness_updates"] > 0,
+                                          w, jnp.float32(1.0))
+                        scores = {**scores,
+                                  "advantages": apply_staleness_correction(
+                                      scores["advantages"], w)}
 
-                # 4. update(s) — entirely on device (round-2 verdict weak
-                # -item 4: the update path previously bounced rollout
-                # tensors through the host via local_numpy). Reinforce:
-                # zero host transfers of token tensors. PPO: only the
-                # host-generated permutation indices go device-ward; the
-                # minibatch gather runs SPMD on the global arrays with
-                # the SAME permutation on every host (seeded by
-                # (rollout, epoch), so multi-host stays coherent).
-                up = {
-                    "sequences": out["sequences"],
-                    "sequence_mask": out["sequence_mask"],
-                    "advantages": scores["advantages"],
-                    "behavior_logp": scores["behavior_logp"],
-                }
-                if algo == "gae":
-                    up.update(
-                        returns=scores["returns"],
-                        behavior_values=scores["behavior_values"],
-                        action_mask=scores["action_mask"])
-                losses = []
-                if algo in ("ppo", "gae"):
-                    # mb_size/n_minibatches derived from rollout_rows up
-                    # top (where updates_per_rollout and the trainer's
-                    # batch identity were sized); the permutation covers
-                    # the actual rows, remainder rows sit out this epoch
-                    assert int(up["sequences"].shape[0]) == rollout_rows
-                    for epoch in range(ppo_epochs):
-                        order = np.random.default_rng(
-                            (rollout_idx, epoch)).permutation(rollout_rows)
-                        for k in range(n_minibatches):
-                            sl = jnp.asarray(
-                                order[k * mb_size:(k + 1) * mb_size])
-                            mb = jax.tree.map(
-                                lambda v: jnp.take(v, sl, axis=0), up)
-                            loss, _ = trainer.step_on_device_batch(
-                                mb, jax.random.fold_in(rng, trainer.step))
-                            losses.append(loss)
-                else:
-                    loss, _ = trainer.step_on_device_batch(
-                        up, jax.random.fold_in(rng, trainer.step))
-                    losses.append(loss)
-                if pipeline is not None:
-                    # advance the staleness clock; async mode also hands
-                    # the post-update rollout tree to the generator
-                    # thread, which refits it before its next rollout
-                    pipeline.notify_updates(len(losses),
-                                            params=rollout_params())
-
-                kl_now = float(scores["kl"])
-                if algo in ("ppo", "gae") and target_kl:
-                    # adaptive KL controller on the dead-in-reference target_kl
-                    if kl_now > 1.5 * float(target_kl):
-                        kl_coef *= 2.0
-                    elif kl_now < float(target_kl) / 1.5:
-                        kl_coef *= 0.5
-
-                rollout_idx += 1
-                if rollout_idx % int(config.get("logging", {})
-                                     .get("log_every_steps", 10)) == 0:
-                    payload = {
-                        "train/loss": float(np.mean(losses)),
-                        "train/kl": kl_now,
-                        "train/kl_coef": kl_coef,
-                        "train/reward_mean": float(scores["reward_mean"]),
-                        "train/rm_score_mean": float(scores["rm_score_mean"]),
-                        "train/response_len": float(jnp.mean(jnp.sum(
-                            out["response_mask"], axis=-1))),
-                        # rows whose rollout generated nothing: their RM
-                        # score never enters the (action-masked) rewards,
-                        # so a collapsed all-EOS policy would otherwise
-                        # read as reward ~0 rather than as an error
-                        "train/zero_len_responses": float(jnp.sum(jnp.sum(
-                            out["response_mask"], axis=-1) == 0)),
+                    # 4. update(s) — entirely on device (round-2 verdict weak
+                    # -item 4: the update path previously bounced rollout
+                    # tensors through the host via local_numpy). Reinforce:
+                    # zero host transfers of token tensors. PPO: only the
+                    # host-generated permutation indices go device-ward; the
+                    # minibatch gather runs SPMD on the global arrays with
+                    # the SAME permutation on every host (seeded by
+                    # (rollout, epoch), so multi-host stays coherent).
+                    up = {
+                        "sequences": out["sequences"],
+                        "sequence_mask": out["sequence_mask"],
+                        "advantages": scores["advantages"],
+                        "behavior_logp": scores["behavior_logp"],
                     }
-                    trainer.logger.log(payload, rollout_idx)
-                    log_rank_zero(
-                        f"rollout {rollout_idx}: reward "
-                        f"{payload['train/reward_mean']:.4f} kl {kl_now:.4f}")
+                    if algo == "gae":
+                        up.update(
+                            returns=scores["returns"],
+                            behavior_values=scores["behavior_values"],
+                            action_mask=scores["action_mask"])
+                    losses = []
+                    if algo in ("ppo", "gae"):
+                        # mb_size/n_minibatches derived from rollout_rows up
+                        # top (where updates_per_rollout and the trainer's
+                        # batch identity were sized); the permutation covers
+                        # the actual rows, remainder rows sit out this epoch
+                        assert int(up["sequences"].shape[0]) == rollout_rows
+                        for epoch in range(ppo_epochs):
+                            order = np.random.default_rng(
+                                (rollout_idx, epoch)).permutation(rollout_rows)
+                            for k in range(n_minibatches):
+                                sl = jnp.asarray(
+                                    order[k * mb_size:(k + 1) * mb_size])
+                                mb = jax.tree.map(
+                                    lambda v: jnp.take(v, sl, axis=0), up)
+                                loss, _ = trainer.step_on_device_batch(
+                                    mb, jax.random.fold_in(rng, trainer.step))
+                                losses.append(loss)
+                    else:
+                        loss, _ = trainer.step_on_device_batch(
+                            up, jax.random.fold_in(rng, trainer.step))
+                        losses.append(loss)
+                    if pipeline is not None:
+                        # advance the staleness clock; async mode also hands
+                        # the post-update rollout tree to the generator
+                        # thread, which refits it before its next rollout
+                        pipeline.notify_updates(len(losses),
+                                                params=rollout_params())
+
+                    kl_now = float(scores["kl"])
+                    if algo in ("ppo", "gae") and target_kl:
+                        # adaptive KL controller on the dead-in-reference target_kl
+                        if kl_now > 1.5 * float(target_kl):
+                            kl_coef *= 2.0
+                        elif kl_now < float(target_kl) / 1.5:
+                            kl_coef *= 0.5
+
+                    rollout_idx += 1
+                    if rollout_idx % int(config.get("logging", {})
+                                         .get("log_every_steps", 10)) == 0:
+                        payload = {
+                            "train/loss": float(np.mean(losses)),
+                            "train/kl": kl_now,
+                            "train/kl_coef": kl_coef,
+                            "train/reward_mean": float(scores["reward_mean"]),
+                            "train/rm_score_mean": float(scores["rm_score_mean"]),
+                            "train/response_len": float(jnp.mean(jnp.sum(
+                                out["response_mask"], axis=-1))),
+                            # rows whose rollout generated nothing: their RM
+                            # score never enters the (action-masked) rewards,
+                            # so a collapsed all-EOS policy would otherwise
+                            # read as reward ~0 rather than as an error
+                            "train/zero_len_responses": float(jnp.sum(jnp.sum(
+                                out["response_mask"], axis=-1) == 0)),
+                        }
+                        trainer.logger.log(payload, rollout_idx)
+                        log_rank_zero(
+                            f"rollout {rollout_idx}: reward "
+                            f"{payload['train/reward_mean']:.4f} kl {kl_now:.4f}")
 
                 save_every = int(config.get("logging", {})
                                  .get("save_every_steps", 0))

@@ -324,6 +324,87 @@ def test_sampler_slow_completes_without_retire(model_and_params,
 
 
 # ---------------------------------------------------------------------------
+# cross-rollout isolation: stale producers can never corrupt a rollout
+# ---------------------------------------------------------------------------
+
+def test_stale_queue_entries_never_leak_across_rollouts(model_and_params,
+                                                        prompt_batch):
+    """A retired-but-alive member may leave emissions on the trajectory
+    queue between rollouts; ``generate`` drains leftovers before
+    dispatching and the collector discards any group not tagged (this
+    rollout, current owner). The poisoned entries below carry empty
+    rows — if any were ever seated, assembly would crash or parity
+    would break."""
+    model, params = model_and_params
+    ids, mask = prompt_batch
+    gen = _gen()
+    seeds = derive_rollout_seeds(123, len(ids))
+    ref = _batch_reference(model, params, gen, ids, mask, seeds)
+
+    fleet = SamplerFleet(model, params, gen, _serving_cfg(),
+                         SamplerFleetConfig(samplers=2))
+    try:
+        for g in range(3):
+            fleet._traj_q.put(TrajectoryGroup(
+                group=g, member=0, version=9, epoch=0, rows={},
+                rollout=-1))
+        out = fleet.generate(ids, mask, seeds)
+        _assert_parity(ref, out)
+        assert np.asarray(out["row_versions"]).tolist() == [0] * len(ids)
+    finally:
+        fleet.close()
+
+
+def test_collect_rejects_stale_rollout_and_foreign_owner(model_and_params):
+    """``_collect`` accepts a group only from its current owner for the
+    current rollout index: a stale-rollout emission and one from a
+    member whose groups were reassigned away are both discarded rather
+    than seated via first-arrival."""
+    model, params = model_and_params
+    gen = _gen()
+    fleet = SamplerFleet(model, params, gen, _serving_cfg(),
+                         SamplerFleetConfig(samplers=1))
+    try:
+        slot = fleet.active()[0].slot
+        stale = TrajectoryGroup(group=0, member=slot, version=0, epoch=0,
+                                rows={}, rollout=99)
+        foreign = TrajectoryGroup(group=0, member=slot + 1, version=0,
+                                  epoch=0, rows={}, rollout=3)
+        good = TrajectoryGroup(group=0, member=slot, version=0, epoch=0,
+                               rows={}, rollout=3)
+        for tg in (stale, foreign, good):
+            fleet._traj_q.put(tg)
+        done = fleet._collect(3, 1, {0: slot}, (4, MAX_NEW))
+        assert done[0] is good
+    finally:
+        fleet.close()
+
+
+def test_retired_member_emit_drops_instead_of_spinning(model_and_params):
+    """A member retired while blocked on a full queue must drop its
+    group and release its executor thread — not spin re-filling the
+    bounded queue with garbage for the rest of the run."""
+    model, params = model_and_params
+    gen = _gen()
+    fleet = SamplerFleet(model, params, gen, _serving_cfg(),
+                         SamplerFleetConfig(samplers=1, traj_queue_cap=1))
+    try:
+        m = fleet.active()[0]
+        fleet._traj_q.put(TrajectoryGroup(group=0, member=m.slot,
+                                          version=0, epoch=0, rows={},
+                                          rollout=0))   # queue now full
+        fleet._retire(m, "test")
+        t = threading.Thread(target=fleet._emit, args=(m, 1, {}, 0),
+                             daemon=True)
+        t.start()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "_emit spun on a retired member"
+        assert fleet._traj_q.qsize() == 1   # nothing new enqueued
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
 # heterogeneous per-trajectory staleness
 # ---------------------------------------------------------------------------
 
