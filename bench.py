@@ -1426,6 +1426,170 @@ def run_serving_gateway_bench() -> dict:
     }
 
 
+def run_observability_bench() -> dict:
+    """Distributed-tracing overhead target (telemetry.trace_context /
+    tools/trace_merge.py): the same greedy wire trace through a
+    streaming gateway twice — process tracing OFF (the disabled
+    default: the zero-work-when-disabled pin) vs ON (enabled tracer +
+    per-process span spool) — reporting the wire throughput fraction
+    tracing costs. The detail pins the contract: measured-section
+    engine compile counts identical across arms (tracing adds zero
+    compiles), outputs bit-identical, zero ring drops and spool write
+    errors in the traced arm, and the traced arm's spool must merge
+    into a strictly valid Chrome trace via tools/trace_merge.py.
+
+    Deterministic, CPU-sized, in-process (sockets on loopback only)."""
+    import http.client
+    import shutil
+    import tempfile
+    import threading
+    import time
+    from pathlib import Path
+
+    import jax
+    import numpy as np
+    from dla_tpu.generation.engine import GenerationConfig
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+    from dla_tpu.serving import ServingConfig, ServingEngine, \
+        ServingGateway
+    from dla_tpu.telemetry.trace import Tracer, get_tracer, \
+        install_tracer
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=192,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_length=128, remat="none", dtype="float32",
+        param_dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    new_tokens = 8
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)
+    kw = dict(page_size=4, num_pages=64, num_slots=2, max_model_len=32,
+              max_prefill_batch=2, prefill_chunk=4, prefix_cache=True,
+              fault_plan="")
+    rs = np.random.RandomState(0)
+    prompts = [[int(t) for t in rs.randint(3, 500, (6,))]
+               for _ in range(8)]
+    warm_prompts = [[1 + (i + j) % 2 for i in range(6)]
+                    for j in range(len(prompts))]
+
+    def http_generate(port, prompt):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=300)
+        try:
+            conn.request("POST", "/v1/generate", json.dumps(
+                {"prompt": prompt, "max_new_tokens": new_tokens}
+            ).encode(), {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            toks = []
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                ev = json.loads(line[len(b"data: "):])
+                if ev.get("done"):
+                    break
+                toks.append(int(ev["token"]))
+            return toks
+        finally:
+            conn.close()
+
+    def drive_wire(port, batch):
+        out = [None] * len(batch)
+
+        def client(i):
+            out[i] = http_generate(port, batch[i])
+        ts = [threading.Thread(target=client, args=(i,),
+                               name=f"dla-bench-obsclient-{i}",
+                               daemon=True)
+              for i in range(len(batch))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        return out
+
+    # Interleaved best-of-N A/B against ONE gateway instance. The
+    # 2-slot CPU wire drive is bimodal (an engine-loop idle-poll park
+    # just as submits land serializes the tiny batch) and the mode is
+    # sticky per process phase — separate per-arm gateways measure
+    # scheduler luck, not tracing. Toggling the process tracer between
+    # measured drives on the same gateway hits both arms with the same
+    # artifact; max over reps is the steady-state throughput per arm.
+    reps = 5
+    spool = tempfile.mkdtemp(prefix="dla-obs-spool-")
+    prev = get_tracer()
+    traced = Tracer.from_config(
+        {"enabled": True, "capacity": 1 << 17,
+         "spool_dir": spool, "proc": "gateway"})
+    eng = ServingEngine(model, params, gen, ServingConfig(**kw))
+    gw = ServingGateway(eng)
+    try:
+        drive_wire(gw.port, warm_prompts)   # compile + wire warm
+        install_tracer(traced)
+        drive_wire(gw.port, warm_prompts)   # traced-path + spool warm
+        install_tracer(prev)
+        c0 = (eng.decode_compiles, eng.prefill_compiles)
+        best = {False: 0.0, True: 0.0}
+        outs = {False: None, True: None}
+        for _ in range(reps):
+            for arm in (False, True):
+                install_tracer(traced if arm else prev)
+                t0 = time.perf_counter()
+                rep = [list(o)
+                       for o in drive_wire(gw.port, prompts)]
+                dt = time.perf_counter() - t0
+                tps = sum(len(o) for o in rep) / dt
+                if outs[arm] is None or tps > best[arm]:
+                    best[arm], outs[arm] = tps, rep
+        # summed over ALL measured drives of BOTH arms — tracing must
+        # add zero compiles, so the pinned total is (0, 0)
+        compiles = (eng.decode_compiles - c0[0],
+                    eng.prefill_compiles - c0[1])
+    finally:
+        install_tracer(prev)
+        gw.close()
+    stats = {"spooled": traced.spooled, "dropped": traced.dropped,
+             "spool_errors": traced.spool_errors}
+    traced.detach_spool()
+    off_tps, on_tps = best[False], best[True]
+    off_out, on_out = outs[False], outs[True]
+    off_compiles = on_compiles = compiles
+
+    from tools.trace_merge import merge_dir, validate
+    merged = merge_dir(Path(spool))
+    problems = validate(merged)
+    n_spans = sum(1 for e in merged["traceEvents"]
+                  if e.get("ph") == "X")
+    shutil.rmtree(spool, ignore_errors=True)
+
+    return {
+        "metric": "observability_wire_overhead_frac",
+        "value": round(1.0 - on_tps / max(off_tps, 1e-9), 4),
+        "unit": "fraction",
+        "detail": {
+            "tokens_per_s_traced_off": round(off_tps, 1),
+            "tokens_per_s_traced_on": round(on_tps, 1),
+            # must be equal across arms: tracing adds zero compiles to
+            # the measured section (both expected (0, 0) post-warm)
+            "compiles_measured_off": list(off_compiles),
+            "compiles_measured_on": list(on_compiles),
+            "outputs_identical": bool(on_out == off_out),
+            "trace_spooled_records": int(stats.get("spooled", 0)),
+            "trace_dropped": int(stats.get("dropped", 0)),
+            "trace_spool_errors": int(stats.get("spool_errors", 0)),
+            "merged_trace_valid": not problems,
+            "merged_trace_spans": int(n_spans),
+            "new_tokens": new_tokens,
+            "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def run_resilience_bench() -> dict:
     """Recovery-overhead microbench for the fault-tolerance stack
     (dla_tpu/resilience): one tiny SFT run with an injected checkpoint
@@ -1961,7 +2125,7 @@ def _emit_and_maybe_extra() -> None:
                run_serving_prefix_bench, run_serving_spec_bench,
                run_serving_fleet_bench, run_serving_disagg_bench,
                run_serving_gateway_bench, run_elastic_resilience_bench,
-               run_rollout_fleet_bench):
+               run_rollout_fleet_bench, run_observability_bench):
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — extras must not kill the line
@@ -2053,6 +2217,14 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_serving_gateway_bench()))
+        return 0
+    if "observability" in sys.argv[1:]:
+        # distributed-tracing overhead target: wire + spool cost with
+        # tracing on vs off, compile counts pinned identical across
+        # arms and the spool merged via tools/trace_merge.py
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_observability_bench()))
         return 0
     if "serving-resilience" in sys.argv[1:]:
         # supervised-serving chaos target: same in-process forced-CPU

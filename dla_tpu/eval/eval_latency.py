@@ -756,12 +756,22 @@ def measure_gateway(model, params, srv: Dict) -> Dict[str, object]:
     threads). Reports both arms' client-observed TTFT/ITL percentiles,
     the wire overhead per token, greedy bit-identity across arms, and
     exercises a mid-trace client disconnect (the gateway must cancel
-    the orphaned request and count it)."""
+    the orphaned request and count it).
+
+    The wire arm runs with distributed tracing ON (enabled process
+    tracer + span spool): after the drive, the spool is merged with
+    ``tools/trace_merge.py`` and every completed wire request must
+    yield a complete span tree in the merged trace — the A/B output
+    reports spans-per-request and the coverage verdict."""
     import http.client
+    import shutil
+    import tempfile
     import threading
 
     from dla_tpu.serving import ServingEngine, ServingGateway
     from dla_tpu.serving.metrics import ServingMetrics
+    from dla_tpu.telemetry.trace import (Tracer, get_tracer,
+                                         install_tracer)
 
     gwc = srv.get("gateway") or {}
     n = int(gwc.get("num_requests", srv.get("num_requests", 16)))
@@ -793,7 +803,12 @@ def measure_gateway(model, params, srv: Dict) -> Dict[str, object]:
     dt_in, out_in = _drive_open_loop(eng, prompts, arrivals, new_tokens)
     snap = eng.metrics.snapshot()
 
-    # ---- arm B: the same trace over localhost HTTP ------------------
+    # ---- arm B: the same trace over localhost HTTP, tracing ON ------
+    spool_dir = tempfile.mkdtemp(prefix="dla-gw-spool-")
+    prev_tracer = get_tracer()
+    install_tracer(Tracer.from_config(
+        {"enabled": True, "capacity": 1 << 17,
+         "spool_dir": spool_dir, "proc": "gateway"}))
     gw = ServingGateway(ServingEngine(model, params, gen,
                                       _serving_config(srv)))
 
@@ -876,7 +891,36 @@ def measure_gateway(model, params, srv: Dict) -> Dict[str, object]:
             "serving/gateway/disconnect_cancels"]
     gw.close()
 
+    # ---- trace coverage: merge the wire arm's spool and demand one
+    # complete span tree per completed wire request -------------------
+    tracer = get_tracer()
+    trace_dropped = tracer.dropped
+    tracer.detach_spool()              # flush + close the spool file
+    install_tracer(prev_tracer)
+    from tools.trace_merge import merge_dir, validate
+    merged = merge_dir(Path(spool_dir))
+    problems = validate(merged)
+    per_trace: Dict[str, List[Dict]] = {}
+    for ev in merged["traceEvents"]:
+        tid = (ev.get("args") or {}).get("trace")
+        if tid and ev.get("ph") in ("X", "b", "i"):
+            per_trace.setdefault(tid, []).append(ev)
+    # a COMPLETE tree closed its root: the gateway's wire_request span
+    # emits on request completion, so a trace without one is a request
+    # the wire never finished (or a span the ring evicted)
+    complete = {t: evs for t, evs in per_trace.items()
+                if any(e["name"] == "wire_request" for e in evs)}
+    completed_wire = sum(1 for o in out_wire if o is not None)
+    spans_per_request = (sum(len(v) for v in complete.values())
+                         / max(len(complete), 1))
+    shutil.rmtree(spool_dir, ignore_errors=True)
+
     return {
+        "trace_spans_per_request": spans_per_request,
+        "trace_requests_traced": len(complete),
+        "trace_coverage_complete": (not problems
+                                    and trace_dropped == 0
+                                    and len(complete) >= completed_wire),
         "num_requests": n,
         "arrival_rate": rate,
         "new_tokens": new_tokens,

@@ -73,6 +73,8 @@ from dla_tpu.serving.fleet import broadcast_waves
 from dla_tpu.serving.scheduler import TERMINAL_STATES
 from dla_tpu.serving.server import ServingConfig
 from dla_tpu.telemetry.registry import MetricRegistry
+from dla_tpu.telemetry.trace import get_tracer, register_trace_gauges
+from dla_tpu.telemetry.trace_context import TraceContext
 
 
 class SamplerFleetMetrics:
@@ -91,6 +93,9 @@ class SamplerFleetMetrics:
             "rollout/fleet/reassigned_rollouts")
         self.trajectory_queue_depth = r.gauge(
             "rollout/fleet/trajectory_queue_depth")
+        # span-drop accounting for the fleet process's tracer ring
+        # (members share it), the trainer tracer's contract
+        register_trace_gauges(r)
 
     def snapshot(self) -> Dict[str, float]:
         return {
@@ -163,6 +168,10 @@ class TrajectoryGroup:
     rows: Dict[str, np.ndarray]
     rollout: int = 0
     error: Optional[BaseException] = None   # drive-crash sentinel
+    # {"trace", "span"} hex ids of the dispatch that produced this group
+    # (None with tracing disabled): the merged fleet timeline can tie a
+    # consumed group back to the member-side drive that generated it
+    trace: Optional[Dict[str, str]] = None
 
 
 def shard_trajectory_groups(groups: Sequence[TrajectoryGroup],
@@ -326,6 +335,11 @@ class SamplerFleet:
         # group -> (prompt tokens, G seeds, G max_new): the
         # bit-identical regeneration source for reassignment
         self._journal: Dict[int, Tuple] = {}
+        # group -> TraceContext of its CURRENT dispatch (empty with
+        # tracing disabled): _reassign parents the replacement dispatch
+        # span under the original one, so a chaos run's merged timeline
+        # shows reassignment as a child of the dispatch it replaced
+        self._dispatch_ctx: Dict[int, TraceContext] = {}
         # N member threads stepping sharded programs on the SAME virtual
         # CPU mesh interleave collective participants across rendezvous
         # and deadlock the inline CPU runtime; synchronous dispatch is
@@ -550,8 +564,15 @@ class SamplerFleet:
             raise RuntimeError(
                 f"sampler fleet below min_samplers: {len(members)} < "
                 f"{fc.min_samplers}")
+        tracer = get_tracer()
+        # rollout root context, minted at the dispatch origin (the
+        # trace-context contract: mint at origin, child() per hop);
+        # skipped entirely when tracing is off — no ids, no span work
+        root = TraceContext.mint() if tracer.enabled else None
+        tr_t0 = tracer.now()
         with self._state_lock:
             self._journal.clear()
+            self._dispatch_ctx.clear()
             for i in range(b_unique):
                 toks = [int(t) for t, m in zip(ids[i], mask[i]) if m]
                 g_seeds = [int(s)
@@ -585,7 +606,8 @@ class SamplerFleet:
             pass
         for m in members:
             if assignment[m.slot]:
-                self._dispatch_drive(m, assignment[m.slot], shape, idx)
+                self._dispatch_drive(m, assignment[m.slot], shape, idx,
+                                     parent=root)
         done = self._collect(idx, b_unique, owner, shape)
         out = self._assemble(done, b_unique)
         t1 = self._now()
@@ -606,19 +628,44 @@ class SamplerFleet:
         for m in list(self._samplers):
             if m.killed and not m.retired:
                 self._retire(m, "sampler_lost")
+        if root is not None:
+            tracer.complete("fleet_rollout", tr_t0, tracer.now(),
+                            cat="rollout",
+                            args=dict(rollout=idx, groups=b_unique,
+                                      samplers=len(members),
+                                      **root.tags()))
         return out
 
     def _dispatch_drive(self, m: _Sampler, groups: List[int],
-                        shape: Tuple[int, int], idx: int) -> None:
+                        shape: Tuple[int, int], idx: int,
+                        parent: Optional[TraceContext] = None,
+                        name: str = "sampler_dispatch") -> None:
         """Reset the member's lease (it may have idled since its last
         drive — an instant re-expiry is not a death) and queue the
-        drive on its executor."""
+        drive on its executor. With tracing on, ``parent`` is the
+        rollout root (initial dispatch) or the ORIGINAL dispatch's
+        context (reassignment) — the dispatch span parents under it,
+        and the drive span under the dispatch."""
+        dtags = None
+        if parent is not None:
+            tracer = get_tracer()
+            ctx = parent.child()
+            with self._state_lock:
+                for g in groups:
+                    self._dispatch_ctx[g] = ctx
+            t = tracer.now()
+            tracer.complete(name, t, t, cat="rollout",
+                            args=dict(slot=m.slot, rollout=idx,
+                                      groups=len(groups),
+                                      **ctx.tags(parent)))
+            dtags = ctx.child().tags(ctx)
         with self._state_lock:
             self._leases[m.slot] = self._now()
-        m.pool.submit(self._drive, m, groups, shape, idx)
+        m.pool.submit(self._drive, m, groups, shape, idx, dtags)
 
     def _drive(self, m: _Sampler, groups: List[int],
-               shape: Tuple[int, int], idx: int) -> None:
+               shape: Tuple[int, int], idx: int,
+               dtags: Optional[Dict[str, str]] = None) -> None:
         """Runs ON the member's executor: submit the assigned groups'
         G seeded requests, step the supervised engine, beat the lease
         each step, and emit each group onto the bounded queue as its
@@ -629,6 +676,8 @@ class SamplerFleet:
         slow) notices at the next loop check and exits: its groups were
         reassigned, so anything it would still produce is garbage."""
         p_width, n_pad = shape
+        tracer = get_tracer()
+        drive_t0 = tracer.now()
         try:
             driver = m.driver
             pending: Dict[int, List[int]] = {}
@@ -722,13 +771,22 @@ class SamplerFleet:
                     timeout=1.0)
             except queue.Full:
                 pass
+        finally:
+            if dtags is not None:
+                tracer.complete("sampler_drive", drive_t0, tracer.now(),
+                                cat="rollout",
+                                args=dict(slot=m.slot, rollout=idx,
+                                          groups=len(groups), **dtags))
 
     def _emit(self, m: _Sampler, g: int,
               rows: Dict[str, np.ndarray], idx: int) -> None:
         with self._state_lock:
             ep = self.epoch
+            ctx = self._dispatch_ctx.get(g)
         tg = TrajectoryGroup(group=g, member=m.slot, version=m.version,
-                             epoch=ep, rows=rows, rollout=idx)
+                             epoch=ep, rows=rows, rollout=idx,
+                             trace=ctx.tags() if ctx is not None
+                             else None)
         while not self._stop_requested.is_set():
             with self._state_lock:
                 retired = m.retired
@@ -838,9 +896,16 @@ class SamplerFleet:
             owner[g] = s.slot
             per[s.slot].append(g)
         by_slot = {s.slot: s for s in survivors}
+        # parent each replacement dispatch under the orphans' ORIGINAL
+        # dispatch span: the merged timeline then shows the reassignment
+        # as a child of the dispatch it replaced, not a fresh root
+        with self._state_lock:
+            orig = self._dispatch_ctx.get(orphans[0])
         for slot, groups in per.items():
             if groups:
-                self._dispatch_drive(by_slot[slot], groups, shape, idx)
+                self._dispatch_drive(by_slot[slot], groups, shape, idx,
+                                     parent=orig,
+                                     name="sampler_reassign_dispatch")
         self.fleet_metrics.reassigned_rollouts.inc(len(orphans))
         self._record("sampler_reassigned", rollout=idx,
                      from_slot=dead_slot, groups=len(orphans),

@@ -44,7 +44,10 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from dla_tpu.resilience.faults import FaultPlan
+from dla_tpu.telemetry.aggregate import FleetMetricsAggregator
 from dla_tpu.telemetry.registry import MetricRegistry
+from dla_tpu.telemetry.trace import get_tracer, register_trace_gauges
+from dla_tpu.telemetry.trace_context import TRACEPARENT_HEADER, TraceContext
 
 
 class FederationError(RuntimeError):
@@ -71,6 +74,10 @@ class FederationMetrics:
     """The ``serving/federation/*`` panel, owned by the router's own
     registry (which outlives every remote fleet)."""
 
+    #: RTT histogram families — one fleet-wide + one per peer each, so
+    #: a slow peer is attributable before it goes stale.
+    RTT_KINDS = ("peek", "place", "stream")
+
     def __init__(self, registry: Optional[MetricRegistry] = None):
         r = self.registry = registry or MetricRegistry()
         self.gossip_beats = r.counter("serving/federation/gossip_beats")
@@ -79,23 +86,49 @@ class FederationMetrics:
         self.handoff_bytes = r.counter(
             "serving/federation/handoff_bytes")
         self.stale_peers = r.counter("serving/federation/stale_peers")
+        self._rtt = {
+            "peek": r.histogram("serving/federation/peek_rtt_ms"),
+            "place": r.histogram("serving/federation/place_rtt_ms"),
+            "stream": r.histogram("serving/federation/stream_rtt_ms"),
+        }
+        # the router process's tracer ring/spool accounting (the
+        # trainer tracer's contract, extended to every tracer ring)
+        register_trace_gauges(r)
+
+    def rtt(self, kind: str, peer: str, ms: float) -> None:
+        """Observe one wire round trip on the fleet-wide histogram AND
+        the per-peer one (``serving/federation/peer/<name>/...``, a
+        dynamic-prefix family like ``serving/fleet/engine/``)."""
+        self._rtt[kind].record(ms)
+        key = (kind, peer)
+        hist = self._rtt.get(key)
+        if hist is None:
+            hist = self._rtt[key] = self.registry.histogram(
+                f"serving/federation/peer/{peer}/{kind}_rtt_ms")
+        hist.record(ms)
 
     def snapshot(self) -> Dict[str, float]:
         return self.registry.snapshot()
 
 
 def write_beat(gossip_dir, name: str, url: str, seq: int,
-               pressure: float, draining: bool) -> None:
+               pressure: float, draining: bool,
+               metrics: Optional[Dict[str, float]] = None) -> None:
     """One gossip heartbeat, atomically (write-aside + ``os.replace``,
-    the elastic lease idiom): readers never see a torn beat."""
+    the elastic lease idiom): readers never see a torn beat.
+    ``metrics`` is the writer's numeric health digest
+    (``ServingGateway.metrics_digest``) that ``FleetMetricsAggregator``
+    rolls into the reader-side ``fleet/*`` panel."""
     gossip_dir = Path(gossip_dir)
     gossip_dir.mkdir(parents=True, exist_ok=True)
     path = gossip_dir / f"peer_{name}.json"
     tmp = gossip_dir / f".peer_{name}.tmp"
-    tmp.write_text(json.dumps({
-        "name": name, "url": url, "seq": int(seq),
-        "time": time.time(), "pressure": float(pressure),
-        "draining": bool(draining)}))
+    doc = {"name": name, "url": url, "seq": int(seq),
+           "time": time.time(), "pressure": float(pressure),
+           "draining": bool(draining)}
+    if metrics:
+        doc["metrics"] = {str(k): float(v) for k, v in metrics.items()}
+    tmp.write_text(json.dumps(doc))
     os.replace(tmp, path)
 
 
@@ -123,9 +156,20 @@ class GossipBeater:
             try:
                 with gw._lock:
                     _, pressure = gw.peek([])
+                digest = None
+                digest_fn = getattr(gw, "metrics_digest", None)
+                if digest_fn is not None:
+                    digest = digest_fn()
                 self._seq += 1
                 write_beat(self.gossip_dir, self.name, gw.url,
-                           self._seq, pressure, gw.draining)
+                           self._seq, pressure, gw.draining,
+                           metrics=digest)
+                # stamp the send on the span spool: matched with the
+                # observer's beat_seen stamp, this pair is what lets
+                # trace_merge align the two processes' clocks
+                spool = get_tracer().spool
+                if spool is not None:
+                    spool.beat_sent(self.name, self._seq)
             except Exception:  # noqa: BLE001 — a failed beat is a
                 pass           # missed heartbeat, not a crash
             self._stop.wait(self.cfg.beat_interval_s)
@@ -151,6 +195,7 @@ class FedRequest:
     remote_rid: Optional[int] = None
     replays: int = 0
     handoff: Optional[Tuple[str, int]] = None   # (peer name, new rid)
+    trace: Optional[TraceContext] = None        # root context (origin)
     handoff_event: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -171,6 +216,9 @@ class FederatedRouter:
         self.gossip_dir = Path(gossip_dir)
         self.cfg = cfg or FederationConfig()
         self.metrics = FederationMetrics(registry)
+        # gossip metric digests rolled into the fleet/* panel, served
+        # from this router's /metrics (serve_metrics)
+        self.fleet = FleetMetricsAggregator(self.metrics.registry)
         self.plan = fault_plan or FaultPlan()
         self.replayed = 0
         self._lock = threading.Lock()
@@ -196,6 +244,7 @@ class FederatedRouter:
                 docs.append(json.loads(path.read_text()))
             except (OSError, ValueError):
                 pass                       # torn/unlinked beat: skip
+        fresh = []                         # (name, seq) newly observed
         with self._lock:
             for doc in docs:
                 name = doc.get("name")
@@ -206,6 +255,18 @@ class FederatedRouter:
                     doc["_seen"] = now
                     self._peers[name] = doc
                     self.metrics.gossip_beats.inc()
+                    fresh.append((name, int(doc["seq"])))
+            digests = {name: dict(doc.get("metrics") or {})
+                       for name, doc in self._peers.items()
+                       if now - doc["_seen"] <= self.cfg.lease_ttl_s}
+        # spool first-observation stamps OUTSIDE the lock (file I/O):
+        # matched with the writers' beat_sent stamps they bound the
+        # cross-process clock offset for trace_merge
+        spool = get_tracer().spool
+        if spool is not None:
+            for name, seq in fresh:
+                spool.beat_seen(name, seq)
+        self.fleet.update(digests)
 
     def live_peers(self) -> List[dict]:
         """Fresh, non-draining peers; stale ones are counted and
@@ -246,12 +307,15 @@ class FederatedRouter:
         return http.client.HTTPConnection(
             u.hostname, u.port, timeout=self.cfg.wire_timeout_s)
 
-    def _post_json(self, url: str, path: str, obj) -> dict:
+    def _post_json(self, url: str, path: str, obj,
+                   headers: Optional[Dict[str, str]] = None) -> dict:
         self._net_op()
         conn = self._connect(url)
         try:
-            conn.request("POST", path, json.dumps(obj).encode(),
-                         {"Content-Type": "application/json"})
+            hdrs = {"Content-Type": "application/json"}
+            if headers:
+                hdrs.update(headers)
+            conn.request("POST", path, json.dumps(obj).encode(), hdrs)
             resp = conn.getresponse()
             body = resp.read()
             if resp.status != 200:
@@ -261,7 +325,8 @@ class FederatedRouter:
         finally:
             conn.close()
 
-    def _post_raw(self, url: str, path: str, obj) -> bytes:
+    def _post_raw(self, url: str, path: str, obj,
+                  headers: Optional[Dict[str, str]] = None) -> bytes:
         self._net_op()
         conn = self._connect(url)
         try:
@@ -270,7 +335,10 @@ class FederatedRouter:
             ctype = ("application/octet-stream"
                      if isinstance(obj, (bytes, bytearray))
                      else "application/json")
-            conn.request("POST", path, body, {"Content-Type": ctype})
+            hdrs = {"Content-Type": ctype}
+            if headers:
+                hdrs.update(headers)
+            conn.request("POST", path, body, hdrs)
             resp = conn.getresponse()
             raw = resp.read()
             if resp.status != 200:
@@ -289,17 +357,34 @@ class FederatedRouter:
         """Best live peer for this prompt: the FleetRouter score over
         peeked hit-frac and pressure, sticky family affinity, name
         tie-break. None when no live peer answers."""
+        tracer = get_tracer()
+        t_place = tracer.now()
         peers = self.live_peers()
         with self._lock:
             sticky = self._affinity.get(self._family(fr.prompt_tokens))
         scored = []
         for doc in peers:
+            headers = None
+            pk_ctx = None
+            if fr.trace is not None:
+                # the peek rides the request's trace: the peer's gateway
+                # emits a child span under this hop
+                pk_ctx = fr.trace.child()
+                headers = {TRACEPARENT_HEADER: pk_ctx.to_header()}
+            t0 = tracer.now()
             try:
                 pk = self._post_json(doc["url"], "/v1/peek",
-                                     {"prompt": fr.prompt_tokens})
+                                     {"prompt": fr.prompt_tokens},
+                                     headers=headers)
             except (OSError, http.client.HTTPException,
                     FederationError):
                 continue               # unreachable despite a fresh
+            t1 = tracer.now()
+            self.metrics.rtt("peek", doc["name"], (t1 - t0) * 1e3)
+            if pk_ctx is not None:
+                tracer.complete("peek", t0, t1, cat="federation",
+                                args=dict(peer=doc["name"],
+                                          **pk_ctx.tags(fr.trace)))
             if pk.get("draining"):     # beat: treat as dead this pass
                 continue
             hit = float(pk.get("hit_frac") or 0.0)
@@ -317,6 +402,13 @@ class FederatedRouter:
             self._affinity[self._family(fr.prompt_tokens)] = \
                 best["name"]
         self.metrics.routed_remote.inc()
+        t_done = tracer.now()
+        self.metrics.rtt("place", best["name"], (t_done - t_place) * 1e3)
+        if fr.trace is not None:
+            ctx = fr.trace.child()
+            tracer.complete("place", t_place, t_done, cat="federation",
+                            args=dict(peer=best["name"], fid=fr.fid,
+                                      **ctx.tags(fr.trace)))
         return best
 
     # ------------------------------------------------------------- intake
@@ -334,18 +426,31 @@ class FederatedRouter:
                 fid=fid, prompt_tokens=[int(t) for t in prompt_tokens],
                 max_new_tokens=int(max_new_tokens),
                 sampling=dict(sampling) if sampling else None,
-                priority=int(priority))
+                priority=int(priority),
+                # the router is this request's ORIGIN: mint the root
+                # trace context every downstream hop parents onto
+                trace=TraceContext.mint())
             self._requests[fid] = fr
             t = threading.Thread(target=self._serve_request, args=(fr,),
                                  name=f"dla-federation-req-{fid}",
                                  daemon=True)
             self._threads[fid] = t
+        get_tracer().async_begin("federation", "federated_request", fid,
+                                 **fr.trace.tags())
         t.start()
         return fid
 
     # --------------------------------------------------------- the reader
 
     def _serve_request(self, fr: FedRequest) -> None:
+        try:
+            self._serve_request_inner(fr)
+        finally:
+            get_tracer().async_end(
+                "federation", "federated_request", fr.fid,
+                state=fr.state, replays=fr.replays, **fr.trace.tags())
+
+    def _serve_request_inner(self, fr: FedRequest) -> None:
         deadline = time.monotonic() + self.cfg.place_timeout_s
         while True:
             peer = self._place(fr)
@@ -409,15 +514,25 @@ class FederatedRouter:
     def _stream_generate(self, peer: dict, fr: FedRequest) -> str:
         op = self._net_op()
         disconnect = self.plan.take("disconnect", op, site="net")
+        tracer = get_tracer()
+        hop = fr.trace.child() if fr.trace is not None else None
+        t0 = tracer.now()
         conn = self._connect(peer["url"])
         try:
+            headers = {"Content-Type": "application/json"}
+            if hop is not None:
+                # the remote gateway's wire_request span parents onto
+                # this hop's span id
+                headers[TRACEPARENT_HEADER] = hop.to_header()
             conn.request("POST", "/v1/generate", json.dumps({
                 "prompt": fr.prompt_tokens,
                 "max_new_tokens": fr.max_new_tokens,
                 "sampling": fr.sampling,
                 "priority": fr.priority,
-            }).encode(), {"Content-Type": "application/json"})
+            }).encode(), headers)
             resp = conn.getresponse()
+            self.metrics.rtt("stream", peer["name"],
+                             (tracer.now() - t0) * 1e3)
             if resp.status != 200:
                 raise FederationError(
                     f"generate on {peer['name']} -> {resp.status}: "
@@ -431,6 +546,12 @@ class FederatedRouter:
                 disconnect_after=1 if disconnect is not None else None)
         finally:
             conn.close()
+            if hop is not None:
+                tracer.complete(
+                    "stream_generate", t0, tracer.now(),
+                    cat="federation",
+                    args=dict(peer=peer["name"], fid=fr.fid,
+                              **hop.tags(fr.trace)))
 
     def _resume_after_handoff(self, fr: FedRequest) -> str:
         """The source stream ended with ``migrated``: wait for
@@ -448,6 +569,9 @@ class FederatedRouter:
             have = len(fr.tokens)
             url = self._peers[peer_name]["url"]
         self._net_op()
+        tracer = get_tracer()
+        hop = fr.trace.child() if fr.trace is not None else None
+        t0 = tracer.now()
         conn = self._connect(url)
         try:
             conn.request("GET", f"/v1/stream?rid={rid}&have={have}")
@@ -458,6 +582,12 @@ class FederatedRouter:
             return self._read_events(resp, fr, disconnect_after=None)
         finally:
             conn.close()
+            if hop is not None:
+                tracer.complete(
+                    "resume_after_handoff", t0, tracer.now(),
+                    cat="federation",
+                    args=dict(peer=peer_name, fid=fr.fid,
+                              **hop.tags(fr.trace)))
 
     # ------------------------------------------------------------ handoff
 
@@ -472,9 +602,20 @@ class FederatedRouter:
                 raise FederationError(f"fid {fid} is not streaming yet")
             src_url = self._peers[src_name]["url"]
             dst_url = self._peers[target_name]["url"]
-        blob = self._post_raw(src_url, "/v1/migrate_out", {"rid": rid})
+        tracer = get_tracer()
+        hop = fr.trace.child() if fr.trace is not None else None
+        headers = ({TRACEPARENT_HEADER: hop.to_header()}
+                   if hop is not None else None)
+        t0 = tracer.now()
+        blob = self._post_raw(src_url, "/v1/migrate_out", {"rid": rid},
+                              headers=headers)
         self.metrics.handoff_bytes.inc(len(blob))
         ack = json.loads(self._post_raw(dst_url, "/v1/migrate_in", blob))
+        if hop is not None:
+            tracer.complete(
+                "migrate", t0, tracer.now(), cat="federation",
+                args=dict(src=src_name, dst=target_name, fid=fid,
+                          **hop.tags(fr.trace)))
         with self._lock:
             fr.handoff = (target_name, int(ack["rid"]))
             fr.handoff_event.set()
@@ -505,3 +646,13 @@ class FederatedRouter:
         with self._lock:
             url = self._peers[name]["url"]
         self._post_json(url, "/admin/drain", {})
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """Expose this router's registry — the ``serving/federation/*``
+        counters/RTT histograms plus the gossip-fed ``fleet/*`` panel —
+        on a ``/metrics`` endpoint (the exporter idiom). Returns the
+        started :class:`~dla_tpu.telemetry.exporter.MetricsHTTPServer`;
+        the caller owns ``stop()``."""
+        from dla_tpu.telemetry.exporter import MetricsHTTPServer
+        return MetricsHTTPServer(self.metrics.registry, port=port,
+                                 host=host)

@@ -52,6 +52,7 @@ import dataclasses
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -61,6 +62,8 @@ from dla_tpu.serving.migration import MigrationError, MigrationTicket
 from dla_tpu.serving.scheduler import TERMINAL_STATES, RequestState
 from dla_tpu.telemetry.exporter import DlaThreadingHTTPServer, ReadinessProbe
 from dla_tpu.telemetry.registry import MetricRegistry
+from dla_tpu.telemetry.trace import get_tracer, register_trace_gauges
+from dla_tpu.telemetry.trace_context import TRACEPARENT_HEADER, TraceContext
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,6 +95,10 @@ class GatewayMetrics:
             "serving/gateway/disconnect_cancels")
         self.http_429 = r.counter("serving/gateway/http_429")
         self.http_408 = r.counter("serving/gateway/http_408")
+        # the trainer tracer's accounting contract, extended to this
+        # process's tracer ring: drops are visible in /metrics, not
+        # silently evicted (FuncGauges follow the live install_tracer)
+        register_trace_gauges(r)
 
     def snapshot(self) -> Dict[str, float]:
         return self.registry.snapshot()
@@ -124,6 +131,14 @@ class ServingGateway:
                        "http_408": 0}
         self._mirrored = dict.fromkeys(self._stats, 0)
         self._streams: Dict[int, _Stream] = {}
+        # rid -> wire trace context (guarded by _lock, like _streams):
+        # lets a later migrate_out parent the ticket onto the span tree
+        # the request's origin minted
+        self._trace_ctx: Dict[int, TraceContext] = {}
+        # gossip metrics-digest rate state (only the beater thread calls
+        # metrics_digest, but guard anyway — it is cheap)
+        self._digest_t = time.monotonic()
+        self._digest_tokens = 0
         self._stop = threading.Event()
         self.loop_error: Optional[str] = None
         handler = _make_handler(self)
@@ -219,11 +234,13 @@ class ServingGateway:
                 st.q.put(("done", req.state.name.lower(), reason,
                           len(req.generated)))
                 del self._streams[rid]
+                self._trace_ctx.pop(rid, None)
 
     def _fail_streams(self, err: str) -> None:
         for rid, st in list(self._streams.items()):
             st.q.put(("done", "error", err, st.sent))
             del self._streams[rid]
+            self._trace_ctx.pop(rid, None)
 
     def _mirror_gateway_counters(self) -> None:
         """Delta-mirror the handler-thread stats into the registry
@@ -257,12 +274,14 @@ class ServingGateway:
     def unregister_stream(self, rid: int) -> None:
         with self._lock:
             self._streams.pop(rid, None)
+            self._trace_ctx.pop(rid, None)
 
     def cancel_disconnected(self, rid: int) -> None:
         """Broken pipe on an event write: the client is gone — give the
         slot and pages back and count it."""
         with self._lock:
             self._streams.pop(rid, None)
+            self._trace_ctx.pop(rid, None)
             try:
                 self.engine.cancel(rid, "client_disconnect")
             except KeyError:
@@ -285,6 +304,33 @@ class ServingGateway:
                 if eng.admission is not None
                 else max(8, 2 * eng.cfg.num_slots))
         return hit, max(occ, eng.scheduler.queue_depth / max(1, qcap))
+
+    def metrics_digest(self) -> Dict[str, float]:
+        """Small numeric health digest for the gossip beat — the inputs
+        ``FleetMetricsAggregator`` rolls into the ``fleet/*`` panel.
+        Called from the beater thread between beats; every key must be
+        a finite float (the beat doc is strict JSON)."""
+        with self._lock:
+            try:
+                _hit, pressure = self.peek([])
+            except Exception:  # noqa: BLE001 — engine mid-swap: report
+                pressure = 1.0  # saturated rather than kill the beat
+            depth = float(len(self._streams))
+        with self._stats_lock:
+            tokens = self._stats["streamed_tokens"]
+            now = time.monotonic()
+            dt = now - self._digest_t
+            tok_s = ((tokens - self._digest_tokens) / dt) if dt > 0 \
+                else 0.0
+            self._digest_t, self._digest_tokens = now, tokens
+        tracer = get_tracer()
+        return {
+            "pressure": float(pressure),
+            "queue_depth": depth,
+            "goodput_tok_s": float(tok_s),
+            "trace_dropped": float(tracer.dropped),
+            "draining": 1.0 if self.draining else 0.0,
+        }
 
 
 def _make_handler(outer: ServingGateway):
@@ -398,6 +444,15 @@ def _make_handler(outer: ServingGateway):
             sampling = spec.get("sampling")
             if sampling is not None:
                 sampling = SamplingParams(**sampling)
+            # trace context: continue the caller's trace (a federated
+            # router hop) or mint a root here — the gateway IS the
+            # request's origin for direct clients
+            parent = TraceContext.from_header(
+                self.headers.get(TRACEPARENT_HEADER))
+            ctx = parent.child() if parent is not None \
+                else TraceContext.mint()
+            tracer = get_tracer()
+            t0 = tracer.now()
             with outer._lock:
                 try:
                     rid = outer.engine.submit(
@@ -417,7 +472,16 @@ def _make_handler(outer: ServingGateway):
                                retry_after=True)
                     return
                 st = outer.register_stream(rid, sent=len(req.generated))
-            self._pump(rid, st, first_decides_status=True)
+                outer._trace_ctx[rid] = ctx
+            try:
+                self._pump(rid, st, first_decides_status=True)
+            finally:
+                # one wire-request span covering submit -> last event,
+                # tagged with the shared trace id so trace_merge can
+                # stitch it under the remote caller's span
+                tracer.complete(
+                    "wire_request", t0, tracer.now(), cat="gateway",
+                    args=dict(rid=rid, **ctx.tags(parent)))
 
         def _stream_attach(self):
             q = parse_qs(urlparse(self.path).query)
@@ -528,15 +592,27 @@ def _make_handler(outer: ServingGateway):
         def _peek(self):
             spec = json.loads(self._body() or b"{}")
             prompt = [int(t) for t in spec.get("prompt") or ()]
+            parent = TraceContext.from_header(
+                self.headers.get(TRACEPARENT_HEADER))
+            tracer = get_tracer()
+            t0 = tracer.now()
             with outer._lock:
                 hit, pressure = outer.peek(prompt)
                 draining = outer.draining
+            if parent is not None:
+                ctx = parent.child()
+                tracer.complete("peek", t0, tracer.now(), cat="gateway",
+                                args=ctx.tags(parent))
             self._json(200, {"hit_frac": hit, "pressure": pressure,
                              "draining": draining})
 
         def _migrate_out(self):
             spec = json.loads(self._body() or b"{}")
             rid = int(spec.get("rid", -1))
+            header_ctx = TraceContext.from_header(
+                self.headers.get(TRACEPARENT_HEADER))
+            tracer = get_tracer()
+            t0 = tracer.now()
             with outer._lock:
                 try:
                     ticket = outer.engine.export_request(rid)
@@ -546,6 +622,10 @@ def _make_handler(outer: ServingGateway):
                 except MigrationError as exc:
                     self._json(409, {"error": str(exc)})
                     return
+                # parent the migration onto the request's own wire span
+                # when we minted/continued one here, else onto the
+                # caller's context, else the ticket travels untraced
+                base = outer._trace_ctx.pop(rid, None) or header_ctx
                 # two-phase engines (ServingEngine) still hold the
                 # source copy; FleetRouter.export_request has already
                 # released it and owns no release_migrated
@@ -559,6 +639,15 @@ def _make_handler(outer: ServingGateway):
                 st = outer._streams.pop(rid, None)
                 if st is not None:
                     st.q.put(("done", "migrated", "migrated", st.sent))
+            if base is not None:
+                ctx = base.child()
+                # the ticket carries the context so the TARGET process's
+                # migrate_in span can parent onto this one
+                ticket = dataclasses.replace(
+                    ticket, trace_ctx=ctx.tags(base))
+                tracer.complete(
+                    "migrate_out", t0, tracer.now(), cat="gateway",
+                    args=dict(rid=rid, **ctx.tags(base)))
             blob = ticket.to_bytes()
             self.send_response(200)
             self.send_header("Content-Type", "application/octet-stream")
@@ -573,6 +662,13 @@ def _make_handler(outer: ServingGateway):
             except MigrationError as exc:
                 self._json(400, {"error": str(exc)})
                 return
+            tracer = get_tracer()
+            t0 = tracer.now()
+            tct = ticket.trace_ctx
+            remote = None
+            if isinstance(tct, dict) and isinstance(tct.get("trace"), str) \
+                    and isinstance(tct.get("span"), str):
+                remote = TraceContext(tct["trace"], tct["span"])
             with outer._lock:
                 try:
                     existing = outer.engine.result(ticket.rid)
@@ -587,6 +683,16 @@ def _make_handler(outer: ServingGateway):
                 except MigrationError as exc:
                     self._json(409, {"error": str(exc)})
                     return
+                if remote is not None:
+                    # the imported request keeps streaming HERE: adopt
+                    # the ticket's context so its remaining spans stay
+                    # in the origin's trace
+                    ctx = remote.child()
+                    outer._trace_ctx[req.rid] = ctx
+            if remote is not None:
+                tracer.complete(
+                    "migrate_in", t0, tracer.now(), cat="gateway",
+                    args=dict(rid=req.rid, **ctx.tags(remote)))
             self._json(200, {"rid": req.rid,
                              "generated": len(req.generated)})
 

@@ -145,6 +145,10 @@ class MigrationTicket:
     admitted_time: Optional[float] = None
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None
+    # distributed-tracing context ({"trace", "span", "parent"} hex ids,
+    # docs/OBSERVABILITY.md): optional meta key read via ``meta.get`` on
+    # the old side, so carrying it needs no WIRE_VERSION bump
+    trace_ctx: Optional[dict] = None
 
     @property
     def payload_bytes(self) -> int:
@@ -185,6 +189,7 @@ class MigrationTicket:
             "admitted_time": self.admitted_time,
             "first_token_time": self.first_token_time,
             "last_token_time": self.last_token_time,
+            "trace_ctx": self.trace_ctx,
             "k_dtype": str(k.dtype), "k_shape": list(k.shape),
             "v_dtype": str(v.dtype), "v_shape": list(v.shape),
         }
@@ -254,7 +259,8 @@ class MigrationTicket:
             src_slot=meta["src_slot"],
             admitted_time=meta["admitted_time"],
             first_token_time=meta["first_token_time"],
-            last_token_time=meta["last_token_time"])
+            last_token_time=meta["last_token_time"],
+            trace_ctx=meta.get("trace_ctx"))
 
 
 class KVMigrator:

@@ -67,7 +67,12 @@ from dla_tpu.telemetry.exporter import MetricsHTTPServer, ReadinessProbe
 from dla_tpu.telemetry.flight_recorder import FlightRecorder
 from dla_tpu.telemetry.mfu import MFUCalculator
 from dla_tpu.telemetry.slo import SLOWatch
-from dla_tpu.telemetry.trace import Tracer, get_tracer, install_tracer
+from dla_tpu.telemetry.trace import (
+    Tracer,
+    get_tracer,
+    install_tracer,
+    register_trace_gauges,
+)
 from dla_tpu.telemetry.xla_introspect import (
     IntrospectedFunction,
     register_live_bytes_gauge,
@@ -308,6 +313,10 @@ class ServingEngine:
             self._installed_tracer = True
         else:
             self.tracer = get_tracer()
+        # ring/spool accounting for THIS engine's tracer, mirrored into
+        # the engine registry (the trainer tracer's contract — drops
+        # are a /metrics number, not a silent eviction)
+        register_trace_gauges(self.metrics.registry, self.tracer)
         # resilience surface: flight recorder for postmortems, the
         # admission gate + degradation ladder (both off unless cfg.shed
         # enables them), and the serving-scoped fault plan

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -168,3 +168,104 @@ def compute_stats(rows: np.ndarray) -> PodStats:
     return PodStats(step_ms=step, goodput=good,
                     straggler_host=int(step.argmax()) if step.size else 0,
                     skew=skew)
+
+
+# -------------------------------------------------- fleet-wide federation
+
+
+#: Digest keys every gossip beat may carry (``ServingGateway.
+#: metrics_digest``). Unknown keys in a beat are surfaced per-peer but
+#: excluded from the rolled-up extrema below.
+FLEET_DIGEST_KEYS: Tuple[str, ...] = ("pressure", "queue_depth",
+                                      "goodput_tok_s", "trace_dropped",
+                                      "draining")
+
+
+class FleetMetricsAggregator:
+    """:class:`PodAggregator` lifted from hosts to processes: metric
+    digests ride each peer's gossip beat (no extra RPC — the beat file
+    was being written anyway) and the reader rolls them into ``fleet/*``
+    gauges on the federated router's registry, so ONE ``/metrics``
+    scrape answers "is any fleet drowning, and which one".
+
+    Same shape as the pod panel: per-peer series (``fleet/peer/<name>/
+    <key>``, a dynamic-prefix family), the extrema that page (max
+    pressure, min goodput), and straggler attribution — ``fleet/
+    straggler_peer`` is the index (in sorted live-peer-name order) of
+    the most-pressured peer, the process-level analogue of
+    ``telemetry/straggler_host``.
+
+    ``update()`` is called from ``FederatedRouter.refresh_peers`` with
+    the live (non-stale) peers' digests; a peer that goes stale simply
+    stops appearing, so ``fleet/peers`` dropping is itself the alert.
+    Single-threaded by contract (only the refresh path calls it).
+    """
+
+    def __init__(self, registry: Any):
+        self.registry = registry
+        g = registry.gauge
+        self._peers = g("fleet/peers")
+        self._draining = g("fleet/draining")
+        self._pressure_max = g("fleet/pressure_max")
+        self._pressure_mean = g("fleet/pressure_mean")
+        self._queue_max = g("fleet/queue_depth_max")
+        self._queue_sum = g("fleet/queue_depth_sum")
+        self._goodput_min = g("fleet/goodput_tok_s_min")
+        self._goodput_sum = g("fleet/goodput_tok_s_sum")
+        self._trace_dropped = g("fleet/trace_dropped")
+        self._straggler = g("fleet/straggler_peer")
+        self._per_peer: Dict[tuple, Any] = {}   # (peer, key) -> Gauge
+        self.updates = 0
+
+    def _peer_gauge(self, peer: str, key: str) -> Any:
+        gauge = self._per_peer.get((peer, key))
+        if gauge is None:
+            gauge = self.registry.gauge(f"fleet/peer/{peer}/{key}")
+            self._per_peer[(peer, key)] = gauge
+        return gauge
+
+    def update(self, digests: Dict[str, Dict[str, Any]]) -> None:
+        """Roll one gossip generation's digests ({peer: digest}) into
+        the panel. Tolerates partial digests (older peers may gossip a
+        subset of :data:`FLEET_DIGEST_KEYS`) and never raises — this
+        sits on the placement refresh path."""
+        self.updates += 1
+        names = sorted(digests)
+        self._peers.set(float(len(names)))
+        cols: Dict[str, list] = {k: [] for k in FLEET_DIGEST_KEYS}
+        for peer in names:
+            digest = digests[peer] or {}
+            for key, raw in digest.items():
+                try:
+                    v = float(raw)
+                except (TypeError, ValueError):
+                    continue
+                self._peer_gauge(peer, key).set(v)
+                if key in cols:
+                    cols[key].append(v)
+        pressure = cols["pressure"]
+        if pressure:
+            self._pressure_max.set(max(pressure))
+            self._pressure_mean.set(sum(pressure) / len(pressure))
+            # Straggler attribution: most-pressured live peer, reported
+            # as its index in sorted-name order (peers with no pressure
+            # in their digest rank as 0.0 — unknowable != drowning).
+            by_peer = {p: 0.0 for p in names}
+            for p in names:
+                try:
+                    by_peer[p] = float((digests[p] or {})
+                                       .get("pressure", 0.0))
+                except (TypeError, ValueError):
+                    pass
+            worst = max(names, key=lambda p: by_peer[p])
+            self._straggler.set(float(names.index(worst)))
+        queue = cols["queue_depth"]
+        if queue:
+            self._queue_max.set(max(queue))
+            self._queue_sum.set(sum(queue))
+        goodput = cols["goodput_tok_s"]
+        if goodput:
+            self._goodput_min.set(min(goodput))
+            self._goodput_sum.set(sum(goodput))
+        self._trace_dropped.set(sum(cols["trace_dropped"]))
+        self._draining.set(sum(1.0 for v in cols["draining"] if v))

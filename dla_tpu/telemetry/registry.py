@@ -200,6 +200,23 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "trace events emitted since start"),
     _s("telemetry/trace_dropped", "counter", "events",
        "trace events evicted from the ring buffer"),
+    # -- distributed tracing (telemetry.trace_context): the process-
+    #    local tracer's health mirrored into every registry that fronts
+    #    a /metrics endpoint (gateway, fleet members, federated router)
+    #    — the trainer contract (``telemetry/trace_events`` FuncGauge)
+    #    extended to the serving side. Scrape-cadence FuncGauges over
+    #    the installed tracer.
+    _s("telemetry/trace/emitted", "counter", "events",
+       "trace events emitted by this process's tracer", "scrape"),
+    _s("telemetry/trace/dropped", "counter", "events",
+       "trace events evicted from this process's ring buffer",
+       "scrape"),
+    _s("telemetry/trace/spooled", "counter", "records",
+       "span records appended to this process's cross-process spool "
+       "file (tools/trace_merge.py input)", "scrape"),
+    _s("telemetry/trace/spool_errors", "counter", "errors",
+       "spool write failures (counted, never raised — the spool sits "
+       "behind serving hot paths)", "scrape"),
     # -- serving instrument panel (serving.metrics)
     _s("serving/queue_depth", "gauge", "requests",
        "waiting requests", "step"),
@@ -346,6 +363,45 @@ CATALOG: Tuple[MetricSpec, ...] = (
     _s("serving/federation/stale_peers", "counter", "peers",
        "placement passes that skipped a peer whose gossip lease had "
        "gone stale (no beat within the TTL)", "step"),
+    _s("serving/federation/peek_rtt_ms", "histogram", "ms",
+       "wire RTT of prefix-peek probes during placement (fleet-wide; "
+       "per-peer series ride the serving/federation/peer/ prefix)",
+       "step"),
+    _s("serving/federation/place_rtt_ms", "histogram", "ms",
+       "submit-to-placement-decision wall time per federated request",
+       "step"),
+    _s("serving/federation/stream_rtt_ms", "histogram", "ms",
+       "POST /v1/generate to first SSE event (wire TTFB) per placed "
+       "request", "step"),
+    # -- fleet-wide metrics federation (telemetry.aggregate.
+    #    FleetMetricsAggregator): per-peer digests gossiped on beats,
+    #    rolled up on the federated router's registry — the pod
+    #    aggregation idiom lifted from hosts to processes. Per-peer
+    #    series ride the fleet/peer/ dynamic prefix below.
+    _s("fleet/peers", "gauge", "peers",
+       "live (non-stale) peers whose digests fed the last rollup"),
+    _s("fleet/draining", "gauge", "peers",
+       "live peers currently refusing new placements"),
+    _s("fleet/pressure_max", "gauge", "fraction",
+       "most-loaded peer's admission pressure (the placement-refusal "
+       "horizon)"),
+    _s("fleet/pressure_mean", "gauge", "fraction",
+       "fleet-mean admission pressure"),
+    _s("fleet/queue_depth_max", "gauge", "requests",
+       "deepest per-peer in-flight stream count"),
+    _s("fleet/queue_depth_sum", "gauge", "requests",
+       "fleet-total in-flight stream count"),
+    _s("fleet/goodput_tok_s_min", "gauge", "tok/s",
+       "slowest peer's streamed-token rate over its last digest "
+       "interval"),
+    _s("fleet/goodput_tok_s_sum", "gauge", "tok/s",
+       "fleet-total streamed-token rate"),
+    _s("fleet/trace_dropped", "gauge", "events",
+       "fleet-total trace-ring evictions (any nonzero peer means its "
+       "merged timeline has holes)"),
+    _s("fleet/straggler_peer", "gauge", "peer",
+       "index (sorted live-peer-name order) of the most-pressured "
+       "peer — the process-level telemetry/straggler_host"),
     # -- RLHF rollout subsystem (dla_tpu/rollout): serving-backed
     #    generation for train_rlhf (docs/RLHF.md)
     _s("rollout/rollouts", "counter", "rollouts",
@@ -430,7 +486,9 @@ CATALOG: Tuple[MetricSpec, ...] = (
 DYNAMIC_PREFIXES: Tuple[str, ...] = ("train/rms/", "train/aux/", "eval/",
                                      "slo/", "telemetry/xla/",
                                      "telemetry/anomaly/",
-                                     "serving/fleet/engine/")
+                                     "serving/fleet/engine/",
+                                     "serving/federation/peer/",
+                                     "fleet/peer/")
 
 #: Derived suffixes ``latency_summary`` appends to histogram base names.
 HISTOGRAM_SUFFIXES: Tuple[str, ...] = ("p50", "p95", "p99", "mean",

@@ -41,6 +41,12 @@ A process-wide tracer (``install_tracer`` / ``get_tracer``) lets
 producers that are not handed an instance (``utils.profiling.annotate``,
 ``step_annotation``) mirror into the active timeline; the default
 global tracer is disabled, so library code calls it unconditionally.
+
+For multi-process runs a ``SpanSpool`` (telemetry/trace_context.py) can
+be attached: every pushed event is also appended to the process's spool
+file so ``tools/trace_merge.py`` can stitch one fleet-wide timeline.
+The spool rides inside ``_push`` — downstream of the ``enabled`` check
+— so the zero-work-when-disabled contract extends to it unchanged.
 """
 from __future__ import annotations
 
@@ -119,6 +125,7 @@ class Tracer:
         self._t0 = now()
         self._pid = 0              # one trace per process; 0 keeps dumps
         self._threads: Dict[int, str] = {}        # tid -> thread name
+        self._spool = None         # optional cross-process write-aside
 
     @classmethod
     def from_config(cls, cfg: Optional[Dict[str, Any]],
@@ -131,8 +138,14 @@ class Tracer:
         path = cfg.get("path")
         if path is None and default_dir:
             path = str(Path(default_dir) / "trace.json")
-        return cls(enabled=enabled,
-                   capacity=int(cfg.get("capacity", 65536)), path=path)
+        tracer = cls(enabled=enabled,
+                     capacity=int(cfg.get("capacity", 65536)), path=path)
+        spool_dir = cfg.get("spool_dir")
+        if enabled and spool_dir:
+            from dla_tpu.telemetry.trace_context import open_spool
+            tracer.attach_spool(open_spool(
+                str(spool_dir), str(cfg.get("proc", "dla_tpu"))))
+        return tracer
 
     # -------------------------------------------------------------- recording
 
@@ -140,6 +153,37 @@ class Tracer:
     def dropped(self) -> int:
         """Events evicted from the ring (emitted minus retained)."""
         return max(0, self.emitted - len(self.events))
+
+    @property
+    def spooled(self) -> int:
+        """Records the attached spool accepted (0 with no spool)."""
+        return 0 if self._spool is None else self._spool.written
+
+    @property
+    def spool(self):
+        """The attached ``SpanSpool`` (or None) — producers that write
+        non-span records (gossip beat stamps) reach it through here."""
+        return self._spool
+
+    @property
+    def spool_errors(self) -> int:
+        """Spool write failures — counted, never raised (the spool sits
+        behind serving/rollout hot paths)."""
+        return 0 if self._spool is None else self._spool.errors
+
+    def attach_spool(self, spool) -> None:
+        """Forward every subsequent event to ``spool`` (a ``SpanSpool``)
+        and record this tracer's clock anchor so the merger can place
+        tracer-relative timestamps on the process monotonic timeline.
+        The spool is only reached downstream of the ``enabled`` check,
+        so a disabled tracer still does zero work."""
+        spool.anchor(self._t0)
+        self._spool = spool
+
+    def detach_spool(self) -> None:
+        if self._spool is not None:
+            self._spool.close()
+            self._spool = None
 
     def _ts(self, t: Optional[float]) -> float:
         """Raw clock reading -> microseconds since tracer start."""
@@ -153,6 +197,8 @@ class Tracer:
         evt["tid"] = tid
         self.events.append(evt)    # atomic under the GIL: thread-safe
         self.emitted += 1
+        if self._spool is not None:
+            self._spool.event(evt)
 
     def span(self, name: str, cat: Optional[str] = None, **args):
         """Duration-span context manager on the calling thread."""
@@ -242,7 +288,8 @@ class Tracer:
         return {"traceEvents": meta + list(self.events),
                 "displayTimeUnit": "ms",
                 "otherData": {"emitted": self.emitted,
-                              "dropped": self.dropped}}
+                              "dropped": self.dropped,
+                              "spooled": self.spooled}}
 
     def dump(self, path: Optional[str] = None) -> Optional[Path]:
         """Write the trace JSON; returns the path, or None if there is
@@ -279,3 +326,24 @@ def install_tracer(tracer: Optional[Tracer]) -> Tracer:
     global _GLOBAL
     _GLOBAL = tracer if tracer is not None else _NULL_TRACER
     return _GLOBAL
+
+
+def register_trace_gauges(registry, tracer: Optional[Tracer] = None
+                          ) -> None:
+    """Mirror a tracer's ring/spool accounting into ``registry`` as the
+    ``telemetry/trace/*`` FuncGauges — the trainer tracer's contract
+    (``telemetry/trace_events``/``…_dropped``) extended to every
+    registry that fronts a tracer ring (gateway, serving engine,
+    sampler fleet, federated router): ring evictions and spool write
+    failures are visible on /metrics, never silently swallowed. With no
+    ``tracer`` the gauges follow the LIVE process tracer across
+    ``install_tracer`` swaps."""
+    src = (lambda: tracer) if tracer is not None else get_tracer
+    registry.func_gauge("telemetry/trace/emitted",
+                        lambda: float(src().emitted))
+    registry.func_gauge("telemetry/trace/dropped",
+                        lambda: float(src().dropped))
+    registry.func_gauge("telemetry/trace/spooled",
+                        lambda: float(src().spooled))
+    registry.func_gauge("telemetry/trace/spool_errors",
+                        lambda: float(src().spool_errors))

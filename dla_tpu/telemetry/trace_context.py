@@ -1,0 +1,268 @@
+"""Cross-process trace context + span spool (docs/OBSERVABILITY.md,
+"Distributed tracing").
+
+A request that crosses the wire — gateway SSE stream, federated
+placement, KV migration, sampler-fleet dispatch — becomes invisible to
+a per-process ``Tracer`` at the boundary. Two small pieces make it one
+timeline again:
+
+- ``TraceContext``: a compact W3C-traceparent-style context (128-bit
+  trace id + 64-bit span id) minted at each request's ORIGIN and
+  carried over every hop — an HTTP header (``X-DLA-Traceparent``) on
+  /v1/generate, /v1/peek and /v1/migrate_out|in, a ``trace_ctx`` key in
+  ``MigrationTicket`` meta, a ``trace`` field on ``TrajectoryGroup``.
+  Each process's tracer tags its wire-boundary spans with the shared
+  trace id, so ``tools/trace_merge.py`` can stitch parent links across
+  processes.
+- ``SpanSpool``: a per-process JSONL write-aside file in the shared run
+  dir (the lease-file idiom from serving/federation.py — each process
+  owns exactly one file, so no cross-process locking). The tracer
+  forwards every completed event to the spool; the spool also records
+  the CLOCK ANCHOR (simultaneous perf_counter / monotonic / wall
+  readings) and gossip-beat send/observe stamps that let the merger
+  align per-process clocks without ever comparing raw cross-host wall
+  clocks.
+
+Spool records are one JSON object per line, discriminated by ``"k"``:
+
+====================  ====================================================
+``k``                 fields
+====================  ====================================================
+``clock``             ``proc, pid, perf, mono, wall, t0`` — simultaneous
+                      clock readings + the tracer's perf-clock origin
+``span``              ``proc, ev`` — one Chrome-trace event dict whose
+                      ``ts`` is microseconds since the tracer's ``t0``
+``beat_sent``         ``proc, peer, seq, mono`` — gossip beat ``seq``
+                      for writer ``peer`` left this process at ``mono``
+``beat_seen``         ``proc, peer, seq, mono`` — this process first
+                      observed writer ``peer``'s beat ``seq`` at ``mono``
+====================  ====================================================
+
+A torn trailing line (the process died mid-write) is expected: readers
+skip undecodable lines and count them instead of crashing.
+
+The zero-producer-work contract extends here: a disabled tracer never
+reaches the spool (``tests/test_trace_merge.py`` pins it by making
+``SpanSpool.write`` raise), and spool I/O failures increment
+``errors`` rather than propagating into the serving hot path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "TRACEPARENT_HEADER", "TraceContext", "SpanSpool", "open_spool",
+    "read_spool", "spool_paths",
+]
+
+#: HTTP header carrying the serialized context across wire hops.
+TRACEPARENT_HEADER = "X-DLA-Traceparent"
+
+
+class TraceContext:
+    """Immutable (trace id, span id) pair in W3C traceparent shape:
+    ``00-<32 hex trace>-<16 hex span>-01``."""
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    # ------------------------------------------------------------ minting
+
+    @staticmethod
+    def mint() -> "TraceContext":
+        """Fresh root context — call at the request's ORIGIN only
+        (gateway submit, router placement, fleet rollout dispatch)."""
+        return TraceContext(secrets.token_hex(16), secrets.token_hex(8))
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — one per hop/sub-operation."""
+        return TraceContext(self.trace_id, secrets.token_hex(8))
+
+    # ----------------------------------------------------- serialization
+
+    def to_header(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @staticmethod
+    def from_header(value: Optional[str]) -> Optional["TraceContext"]:
+        """Parse a traceparent header; malformed input yields ``None``
+        (an untraced request), never an error on the serving path."""
+        if not value:
+            return None
+        parts = value.strip().split("-")
+        if len(parts) != 4:
+            return None
+        _, trace_id, span_id, _ = parts
+        if len(trace_id) != 32 or len(span_id) != 16:
+            return None
+        try:
+            int(trace_id, 16), int(span_id, 16)
+        except ValueError:
+            return None
+        return TraceContext(trace_id.lower(), span_id.lower())
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> Optional["TraceContext"]:
+        if not isinstance(d, dict):
+            return None
+        trace_id, span_id = d.get("trace_id"), d.get("span_id")
+        if not (isinstance(trace_id, str) and isinstance(span_id, str)):
+            return None
+        return TraceContext(trace_id, span_id)
+
+    # ---------------------------------------------------------- plumbing
+
+    def tags(self, parent: Optional["TraceContext"] = None
+             ) -> Dict[str, str]:
+        """Span args tagging an event for the merger: the shared trace
+        id, this hop's span id, and (when known) the parent span id."""
+        out = {"trace": self.trace_id, "span": self.span_id}
+        if parent is not None:
+            out["parent"] = parent.span_id
+        return out
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, TraceContext)
+                and other.trace_id == self.trace_id
+                and other.span_id == self.span_id)
+
+    def __hash__(self) -> int:
+        return hash((self.trace_id, self.span_id))
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.to_header()!r})"
+
+
+class SpanSpool:
+    """Append-only JSONL write-aside for one process's trace output.
+
+    One file per process (``spans_<proc>_<pid>.jsonl``), opened lazily
+    on first write and flushed per record so a killed process leaves at
+    most one torn trailing line. All writes are serialized under one
+    lock; failures are counted (``errors``), never raised — the spool
+    sits behind serving and rollout hot paths.
+    """
+
+    def __init__(self, path: str, proc: str):
+        self.path = Path(path)
+        self.proc = proc
+        self.written = 0
+        self.errors = 0
+        self._fh = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ writing
+
+    def write(self, rec: Dict[str, Any]) -> None:
+        """Append one record; json-encodes outside the failure domain of
+        the file handle so a bad value is also just counted."""
+        try:
+            line = json.dumps(rec, allow_nan=False) + "\n"
+        except (TypeError, ValueError):
+            self.errors += 1
+            return
+        with self._lock:
+            try:
+                if self._fh is None:
+                    self.path.parent.mkdir(parents=True, exist_ok=True)
+                    # dla: disable=blocking-under-lock -- _lock exists only to serialize appends to this one file handle and is never nested inside any other lock; the lazy open happens once and spool writers tolerate the flush latency by design
+                    self._fh = open(self.path, "a", encoding="utf-8")
+                self._fh.write(line)
+                self._fh.flush()
+                self.written += 1
+            except OSError:
+                self.errors += 1
+
+    def anchor(self, t0: float) -> None:
+        """Record the clock anchor: simultaneous readings of the three
+        host clocks plus the tracer's perf-clock origin ``t0``. The
+        merger converts event ``ts`` (µs since ``t0``) to this
+        process's monotonic timeline via ``mono + (t0 + ts/1e6 - perf)``
+        and only falls back to ``wall`` for peers with no beat path."""
+        self.write({"k": "clock", "proc": self.proc, "pid": os.getpid(),
+                    "perf": time.perf_counter(), "mono": time.monotonic(),
+                    "wall": time.time(), "t0": t0})
+
+    def event(self, ev: Dict[str, Any]) -> None:
+        """One completed Chrome-trace event (tracer-relative ``ts``)."""
+        self.write({"k": "span", "proc": self.proc, "ev": ev})
+
+    def beat_sent(self, peer: str, seq: int) -> None:
+        """Gossip writer stamp: beat ``seq`` for writer name ``peer``
+        (this process's own gossip identity) left here now."""
+        self.write({"k": "beat_sent", "proc": self.proc, "peer": peer,
+                    "seq": int(seq), "mono": time.monotonic()})
+
+    def beat_seen(self, peer: str, seq: int) -> None:
+        """Gossip observer stamp: writer ``peer``'s beat ``seq`` was
+        first observed by this process now. Matched ``(peer, seq)``
+        sent/seen pairs bound the cross-process clock offset — the only
+        cross-host time comparison the merger ever performs."""
+        self.write({"k": "beat_seen", "proc": self.proc, "peer": peer,
+                    "seq": int(seq), "mono": time.monotonic()})
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    self.errors += 1
+                self._fh = None
+
+
+def open_spool(spool_dir: str, proc: str) -> SpanSpool:
+    """The one filename convention readers glob for:
+    ``<spool_dir>/spans_<proc>_<pid>.jsonl``."""
+    safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                   for c in proc) or "proc"
+    return SpanSpool(str(Path(spool_dir)
+                         / f"spans_{safe}_{os.getpid()}.jsonl"), proc)
+
+
+def spool_paths(spool_dir: str) -> List[Path]:
+    return sorted(Path(spool_dir).glob("spans_*.jsonl"))
+
+
+def read_spool(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """Read one spool file, skipping undecodable lines (a process killed
+    mid-write leaves a torn trailing record — expected, not an error).
+    Returns ``(records, skipped_line_count)``."""
+    recs: List[Dict[str, Any]] = []
+    skipped = 0
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    continue
+                if isinstance(rec, dict) and "k" in rec:
+                    recs.append(rec)
+                else:
+                    skipped += 1
+    except OSError:
+        return [], 0
+    return recs, skipped
+
+
+def _iter_spools(spool_dir: str
+                 ) -> Iterator[Tuple[Path, List[Dict[str, Any]], int]]:
+    for p in spool_paths(spool_dir):
+        recs, skipped = read_spool(str(p))
+        yield p, recs, skipped

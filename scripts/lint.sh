@@ -30,3 +30,7 @@ done
 python -m tools.dla_lint --format json \
     --baseline tools/lint_baseline.json --root . "$@"
 python tools/dla_doctor.py --self-check >&2
+# merged-trace schema gate: merge the committed two-process fixture and
+# validate the full Chrome-trace output contract (clock alignment from
+# beat pairs, torn-line skip, cross-process span trees)
+python tools/trace_merge.py --self-check >&2

@@ -11,7 +11,10 @@ the parent to kill this worker MID-STREAM (the zero-loss replay path)
 or migrate a live request away.
 
 Usage: python tests/_gateway_worker.py <gossip_dir> <name> [slow_ms]
-(launched with a scrubbed CPU env; see _cpuhost.scrubbed_cpu_env).
+[spool_dir] (launched with a scrubbed CPU env; see
+_cpuhost.scrubbed_cpu_env). A non-empty ``spool_dir`` installs an
+enabled process tracer spooling into it — the distributed-tracing
+acceptance test merges every worker's spool with tools/trace_merge.py.
 """
 import sys
 import time
@@ -20,6 +23,7 @@ import time
 def main() -> None:
     gossip_dir, name = sys.argv[1], sys.argv[2]
     slow_ms = float(sys.argv[3]) if len(sys.argv) > 3 else 0.0
+    spool_dir = sys.argv[4] if len(sys.argv) > 4 else ""
 
     import jax
 
@@ -34,6 +38,11 @@ def main() -> None:
         ServingEngine,
         ServingGateway,
     )
+
+    if spool_dir:
+        from dla_tpu.telemetry.trace import Tracer, install_tracer
+        install_tracer(Tracer.from_config(
+            {"enabled": True, "spool_dir": spool_dir, "proc": name}))
 
     cfg = get_model_config("tiny")
     model = Transformer(cfg)

@@ -18,6 +18,7 @@ from tools.dla_doctor import (
     correlate_anomaly,
     diagnose,
     load_run,
+    load_runs,
     main,
     self_check,
 )
@@ -204,6 +205,79 @@ def test_unreadable_artifacts_never_fatal(tmp_path):
     findings = diagnose(load_run(tmp_path), tmp_path)
     assert sum(f["rule"] == "artifact-unreadable"
                for f in findings) == 2
+
+
+# ---------------------------------------------------------------------------
+# multi-process correlation: a sampler-side wedge explains a
+# learner-side anomaly (dla-doctor over N artifact dirs)
+# ---------------------------------------------------------------------------
+
+def _fleet_dirs(tmp_path):
+    """Two processes' artifact dirs: the learner saw a step-time
+    anomaly at step 12 with NO local cause in its ring; the sampler
+    process logged an injected fault at rollout 12 (one rollout per
+    learner step in the lockstep loop)."""
+    learner = tmp_path / "learner"
+    sampler = tmp_path / "sampler0"
+    learner.mkdir()
+    sampler.mkdir()
+    (learner / "postmortem_anomaly.json").write_text(json.dumps({
+        "reason": "anomaly",
+        "anomaly": {"trigger": "metric", "metric": "step_ms",
+                    "trigger_step": 12, "value": 900.0, "median": 80.0,
+                    "z": 40.0},
+        "events": [{"t": 5.0, "kind": "step_end", "step": 12}]}))
+    (sampler / "postmortem_fleet.json").write_text(json.dumps({
+        "reason": "anomaly",
+        "events": [
+            {"t": 4.0, "kind": "sampler_fault", "rollout": 12,
+             "slot": 1, "fault": "lost"},
+            {"t": 4.2, "kind": "sampler_reassigned", "rollout": 12,
+             "slot": 1}]}))
+    return learner, sampler
+
+
+def test_cross_process_cause_ranked_first(tmp_path):
+    learner, sampler = _fleet_dirs(tmp_path)
+    run = load_runs([learner, sampler])
+    assert set(run["dirs"]) == {"learner", "sampler0"}
+    findings = diagnose(run, learner)
+    top = findings[0]
+    assert top["rule"] == "anomaly-correlated"
+    # desc names the anomaly's process, cause names the sampler's
+    assert "[learner]" in top["message"]
+    assert "sampler fault" in top["message"]
+    assert "in sampler0" in top["message"]
+    assert top["data"]["cause"]["kind"] == "sampler_fault"
+    assert top["data"]["cause"]["proc"] == "sampler0"
+    # the sampler fault (weight 3.6, distance 0) outranks the
+    # reassignment it triggered (weight 2.8)
+    assert top["data"]["cause"]["score"] == pytest.approx(3.6)
+
+
+def test_single_dir_load_runs_is_load_run(tmp_path):
+    _pm(tmp_path, events=[], anomaly={"trigger": "metric",
+                                      "metric": "step_ms",
+                                      "trigger_step": 3, "value": 1.0,
+                                      "median": 1.0, "z": 0.0})
+    solo = load_runs([tmp_path])
+    assert set(solo["dirs"]) == {tmp_path.name}
+    assert len(solo["postmortems"]) == 1
+    # no _proc tag, no key prefixing in the single-dir shape
+    assert "_proc" not in solo["postmortems"][0]
+
+
+def test_cli_accepts_multiple_dirs(tmp_path, capsys):
+    learner, sampler = _fleet_dirs(tmp_path)
+    rc = main([str(learner), str(sampler), "--format", "json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    validate_report(doc)
+    assert doc["summary"]["dirs"] == 2
+    assert doc["findings"][0]["rule"] == "anomaly-correlated"
+    assert "in sampler0" in doc["findings"][0]["message"]
+    # a missing dir anywhere in the list is still a usage error
+    assert main([str(learner), str(tmp_path / "nope")]) == 2
 
 
 # ---------------------------------------------------------------------------

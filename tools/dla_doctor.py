@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Offline auto-triage over one run directory: correlate anomaly
-postmortems with the evidence the run left behind and print a ranked
-diagnosis.
+"""Offline auto-triage over one or more run directories: correlate
+anomaly postmortems with the evidence the run left behind and print a
+ranked diagnosis.
 
 A run that died (or merely hiccuped) leaves artifacts scattered across
 its output directory: flight-recorder postmortems (``postmortem_*.json``),
@@ -14,9 +14,19 @@ injected faults, XLA recompiles, load shedding, SLO burns, watchdog
 hangs), scoring candidates by kind weight over step distance, and
 emitting findings most-likely-cause first.
 
+A disaggregated RLHF run leaves artifacts in SEVERAL processes' dirs
+(learner pod, sampler fleet host, serving gateway); pass them all and
+the doctor triages the union — a learner-side step-time anomaly can
+then correlate with a sampler-side event (``sampler_fault``,
+``sampler_lost``, reassignment), because in the lockstep rollout loop
+the fleet's ``rollout`` index advances with the learner's step and is
+used as the event's step coordinate. Cross-process causes are
+attributed to their source dir in the finding message.
+
 Usage::
 
     python tools/dla_doctor.py RUN_DIR                # ranked text
+    python tools/dla_doctor.py LEARNER_DIR SAMPLER_DIR  # cross-process
     python tools/dla_doctor.py RUN_DIR --format json  # dla-report/1
     python tools/dla_doctor.py --self-check           # committed fixture
 
@@ -62,7 +72,25 @@ CAUSE_KINDS: Dict[str, Tuple[str, float]] = {
     "elastic_resume": ("elastic topology-shift resume", 2.0),
     "host_slow": ("lagging host lease", 2.0),
     "slo_burn": ("SLO burn alert", 1.5),
+    # -- sampler-fleet events (rollout.actor_fleet): recorded against
+    #    the fleet's rollout index, which the lockstep loop advances
+    #    with the learner step — so they correlate across process dirs
+    "sampler_fault": ("injected sampler fault", 3.6),
+    "rollout_fault": ("injected rollout-engine fault", 3.5),
+    "sampler_lost": ("sampler member lost (lease expired)", 3.4),
+    "sampler_reassigned": ("trajectory-group reassignment", 2.8),
+    "sampler_retired": ("sampler member retired", 2.6),
+    "sampler_refit_failed": ("sampler refit failure", 2.4),
+    "sampler_slow": ("lagging sampler member", 2.0),
 }
+
+
+def _evt_step(evt: Dict) -> Optional[int]:
+    """An event's step coordinate: learner events carry ``step``,
+    fleet events carry ``rollout`` (one rollout per learner step in
+    the lockstep loop)."""
+    s = evt.get("step")
+    return evt.get("rollout") if s is None else s
 
 
 # ------------------------------------------------------------ run loading
@@ -107,6 +135,36 @@ def load_run(run_dir: Path) -> Dict[str, Any]:
     return run
 
 
+def load_runs(run_dirs: List[Path]) -> Dict[str, Any]:
+    """Union of N processes' artifact dirs. With one dir this is
+    exactly :func:`load_run`; with several, every postmortem is tagged
+    with its source dir name (``_proc``) and metric/trace/bench keys
+    are prefixed ``<proc>/`` so same-named artifacts never collide."""
+    if len(run_dirs) == 1:
+        run = load_run(run_dirs[0])
+        run["dirs"] = {run_dirs[0].name: run_dirs[0]}
+        return run
+    merged: Dict[str, Any] = {"postmortems": [], "metrics": {},
+                              "bench": {}, "traces": {}, "errors": [],
+                              "dirs": {}}
+    for d in run_dirs:
+        proc = d.name
+        run = load_run(d)
+        merged["dirs"][proc] = d
+        for pm in run["postmortems"]:
+            pm["_proc"] = proc
+            pm["_path"] = f"{proc}/{pm['_path']}"
+            merged["postmortems"].append(pm)
+        for k, v in run["metrics"].items():
+            merged["metrics"][f"{proc}/{k}"] = v
+        for k, v in run["bench"].items():
+            merged["bench"][f"{proc}/{k}"] = v
+        for k, v in run["traces"].items():
+            merged["traces"][f"{proc}/{k}"] = v
+        merged["errors"].extend(f"{proc}/{e}" for e in run["errors"])
+    return merged
+
+
 def _load_trace(path: Path, errors: List[str]) -> int:
     """-> number of Chrome-trace events, -1 when unloadable."""
     try:
@@ -123,15 +181,19 @@ def _all_events(run: Dict[str, Any]) -> List[Dict]:
     overlap (each carries the whole ring at its moment of writing)."""
     seen, out = set(), []
     for pm in run["postmortems"]:
+        proc = pm.get("_proc")
         for evt in pm.get("events", ()):
             if not isinstance(evt, dict):
                 continue
-            key = (evt.get("t"), evt.get("kind"), evt.get("step"),
-                   evt.get("fn"), evt.get("frm"), evt.get("to"))
+            # proc in the key: dumps only overlap WITHIN a process —
+            # two processes legitimately record look-alike events
+            key = (proc, evt.get("t"), evt.get("kind"), evt.get("step"),
+                   evt.get("rollout"), evt.get("slot"), evt.get("fn"),
+                   evt.get("frm"), evt.get("to"))
             if key in seen:
                 continue
             seen.add(key)
-            out.append(evt)
+            out.append(dict(evt, _proc=proc) if proc else evt)
         # a lock-witness postmortem that knows its step participates in
         # cause correlation like any ring event (CAUSE_KINDS lock_cycle)
         if pm.get("reason") == "lock_cycle" \
@@ -148,7 +210,8 @@ def _anomaly_blocks(run: Dict[str, Any]) -> List[Dict]:
     for pm in run["postmortems"]:
         block = pm.get("anomaly")
         if isinstance(block, dict):
-            out.append(dict(block, _path=pm["_path"]))
+            out.append(dict(block, _path=pm["_path"],
+                            _proc=pm.get("_proc")))
     return out
 
 
@@ -163,19 +226,22 @@ def correlate_anomaly(block: Dict, events: List[Dict],
     for evt in events:
         kind = evt.get("kind")
         spec = CAUSE_KINDS.get(kind)
-        if spec is None or evt.get("step") is None:
+        step = _evt_step(evt)
+        if spec is None or step is None:
             continue
         if kind == "compile" and evt.get("first"):
             continue               # warmup compile: expected, not a cause
-        dist = abs(int(evt["step"]) - int(trigger_step))
+        dist = abs(int(step) - int(trigger_step))
         if dist > window:
             continue
         label, weight = spec
         candidates.append({
-            "kind": kind, "label": label, "step": int(evt["step"]),
+            "kind": kind, "label": label, "step": int(step),
             "distance": dist, "score": weight / (1.0 + dist),
+            "proc": evt.get("_proc"),
             "detail": {k: v for k, v in evt.items()
-                       if k not in ("t", "kind", "step")},
+                       if k not in ("t", "kind", "step")
+                       and not k.startswith("_")},
         })
     candidates.sort(key=lambda c: (-c["score"], c["distance"]))
     return candidates
@@ -201,11 +267,16 @@ def diagnose(run: Dict[str, Any], run_dir: Path,
 
     for block in _anomaly_blocks(run):
         desc = _describe_anomaly(block)
+        if block.get("_proc"):
+            desc = f"[{block['_proc']}] {desc}"
         causes = correlate_anomaly(block, events, window)
         trace_note = _trace_note(block, run, run_dir)
         if causes:
             top = causes[0]
-            msg = (f"{desc} correlates with {top['label']} at step "
+            src = ""
+            if top.get("proc") and top["proc"] != block.get("_proc"):
+                src = f" in {top['proc']}"   # cross-process attribution
+            msg = (f"{desc} correlates with {top['label']}{src} at step "
                    f"{top['step']} (distance {top['distance']}, score "
                    f"{top['score']:.2f})")
             if trace_note:
@@ -248,9 +319,16 @@ def _trace_note(block: Dict, run: Dict, run_dir: Path) -> str:
         return ""
     name = Path(trace_path).name
     n = run["traces"].get(name)
+    if n is None:       # multi-dir: trace keys carry a <proc>/ prefix
+        for key, v in run["traces"].items():
+            if key.endswith("/" + name):
+                n = v
+                break
     if n is None:
-        n = _load_trace(run_dir / name, []) \
-            if (run_dir / name).exists() else None
+        for d in (run.get("dirs") or {run_dir.name: run_dir}).values():
+            if (d / name).exists():
+                n = _load_trace(d / name, [])
+                break
     if n is None:
         return f"capture trace {name} MISSING"
     if n < 0:
@@ -334,13 +412,16 @@ _METRIC_CHECKS = (
 
 def _metric_rows(run: Dict[str, Any]) -> List[Tuple[float, Dict]]:
     out = []
-    metrics = run["metrics"]
-    for name, pred, rule, tmpl, severity, score in _METRIC_CHECKS:
-        v = metrics.get(name)
-        if v is not None and pred(v):
-            out.append((score, finding_row(
-                rule, "metrics-dump", 0, f"{name}: " + tmpl.format(v=v),
-                severity=severity, data={"metric": name, "value": v})))
+    # multi-dir keys carry a <proc>/ prefix (prometheus names contain
+    # no "/"); a check fires per process whose dump trips it
+    for key, v in sorted(run["metrics"].items()):
+        name = key.rsplit("/", 1)[-1]
+        for check, pred, rule, tmpl, severity, score in _METRIC_CHECKS:
+            if name == check and pred(v):
+                out.append((score, finding_row(
+                    rule, "metrics-dump", 0,
+                    f"{key}: " + tmpl.format(v=v), severity=severity,
+                    data={"metric": key, "value": v})))
     return out
 
 
@@ -377,12 +458,16 @@ def _summary(run: Dict[str, Any], findings: List[Dict]) -> Dict:
         "metrics": len(run["metrics"]),
         "traces": len(run["traces"]),
         "bench_files": len(run["bench"]),
+        "dirs": len(run.get("dirs") or ()) or 1,
     }
 
 
 def render_text(run_dir: Path, run: Dict[str, Any],
                 findings: List[Dict]) -> str:
-    lines = [f"dla-doctor: {run_dir}",
+    dirs = run.get("dirs") or {}
+    shown = (", ".join(str(d) for d in dirs.values())
+             if len(dirs) > 1 else str(run_dir))
+    lines = [f"dla-doctor: {shown}",
              f"  artifacts: {len(run['postmortems'])} postmortem(s), "
              f"{len(run['traces'])} trace(s), {len(run['metrics'])} "
              f"metric(s), {len(run['bench'])} bench file(s)"]
@@ -441,8 +526,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("run_dir", nargs="?", type=Path,
-                    help="run output directory to triage")
+    ap.add_argument("run_dir", nargs="*", type=Path,
+                    help="run output directory (or several — one per "
+                         "process of a disaggregated run) to triage")
     ap.add_argument("--window", type=int, default=10,
                     help="max step distance for cause correlation "
                          "(default 10)")
@@ -455,21 +541,21 @@ def main(argv=None) -> int:
 
     if args.self_check:
         return self_check()
-    if args.run_dir is None:
+    if not args.run_dir:
         ap.error("run_dir is required (or pass --self-check)")
-    if not args.run_dir.is_dir():
-        print(f"dla-doctor: not a directory: {args.run_dir}",
-              file=sys.stderr)
-        return 2
+    for d in args.run_dir:
+        if not d.is_dir():
+            print(f"dla-doctor: not a directory: {d}", file=sys.stderr)
+            return 2
 
-    run = load_run(args.run_dir)
-    findings = diagnose(run, args.run_dir, window=args.window)
+    run = load_runs(args.run_dir)
+    findings = diagnose(run, args.run_dir[0], window=args.window)
     if args.format == "json":
         print(dump_report(build_report(
             "dla-doctor", findings, summary=_summary(run, findings))),
             end="")
     else:
-        print(render_text(args.run_dir, run, findings), end="")
+        print(render_text(args.run_dir[0], run, findings), end="")
     return 0
 
 

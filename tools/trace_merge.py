@@ -1,0 +1,485 @@
+#!/usr/bin/env python
+"""dla-trace-merge: stitch per-process span spools into ONE Chrome trace.
+
+Every traced process appends completed spans to its own spool file
+(``spans_<proc>_<pid>.jsonl``, written by
+``dla_tpu.telemetry.trace_context.SpanSpool``) in a shared run dir.
+This tool merges a spool dir into a single strict Chrome-trace JSON
+loadable in Perfetto — one timeline showing gateway arrival -> remote
+placement -> engine admission -> per-token decode -> migration ->
+completion across process boundaries.
+
+Clock alignment NEVER compares raw cross-host wall clocks. Each
+process's events live on its own monotonic timeline (via the spool's
+clock-anchor record); cross-process offsets come from matched
+gossip-beat ``(peer, seq)`` send/observe stamp pairs:
+
+- a beat seen at observer time ``v`` that left the writer at ``s``
+  bounds the writer->observer offset ``o <= v - s`` (the lag is
+  non-negative);
+- with beats flowing BOTH ways the two one-sided bounds bracket the
+  true offset and the midpoint is the NTP-style estimate
+  (``method: "paired"``);
+- a peer with beats in only one direction (or a single beat) uses the
+  one-sided bound directly (``method: "one_way"``);
+- only a peer with NO beat path at all falls back to the wall-clock
+  anchor, and the merge flags it (``method: "wall"``).
+
+After alignment a causal fix-up clamps every child span to start no
+earlier than its parent (``args.parent`` -> ``args.span`` links), so
+merged timelines are monotone even inside the residual lag bound.
+Cross-process parent links additionally become Chrome flow arrows.
+
+Usage::
+
+    python tools/trace_merge.py <spool_dir> [-o merged.json]
+    python tools/trace_merge.py --self-check     # committed fixture
+
+``--self-check`` merges the committed two-process fixture
+(tests/fixtures/trace_merge_run/) and validates the full output
+contract — scripts/lint.sh runs it, the dla_doctor --self-check idiom.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import deque
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dla_tpu.telemetry.trace_context import (  # noqa: E402
+    read_spool,
+    spool_paths,
+)
+
+SELF_CHECK_DIR = REPO / "tests" / "fixtures" / "trace_merge_run"
+
+#: Event phases that carry a usable start timestamp for causal clamping.
+_CLAMP_PHASES = ("X", "b", "i", "n")
+
+
+class MergeError(RuntimeError):
+    """A spool dir that cannot produce a valid merged trace."""
+
+
+# --------------------------------------------------------------- loading
+
+
+def load_dir(spool_dir: str) -> Dict[str, Any]:
+    """Read every spool file under ``spool_dir``. Returns per-process
+    events (on each process's own monotonic timeline, seconds), beat
+    stamps, anchors, and the torn-line count."""
+    procs: Dict[str, Dict[str, Any]] = {}
+    skipped = 0
+    for path in spool_paths(spool_dir):
+        recs, torn = read_spool(str(path))
+        skipped += torn
+        anchor: Optional[Dict[str, Any]] = None
+        # one anchor per file: attach_spool writes it before any event
+        for rec in recs:
+            if rec.get("k") == "clock":
+                anchor = rec
+                break
+        for rec in recs:
+            name = str(rec.get("proc") or path.stem)
+            p = procs.setdefault(name, {
+                "events": [], "beat_sent": {}, "beat_seen": {},
+                "anchors": [], "unanchored": 0})
+            k = rec.get("k")
+            if k == "clock":
+                p["anchors"].append(rec)
+            elif k == "span":
+                ev = rec.get("ev")
+                if not isinstance(ev, dict) or "ts" not in ev:
+                    skipped += 1
+                    continue
+                if anchor is None:
+                    p["unanchored"] += 1    # no clock anchor: unplaceable
+                    continue
+                # tracer-relative µs -> this process's monotonic seconds
+                mono = (anchor["mono"]
+                        + (anchor["t0"] + float(ev["ts"]) / 1e6
+                           - anchor["perf"]))
+                p["events"].append((mono, dict(ev)))
+            elif k == "beat_sent":
+                key = (str(rec.get("peer")), int(rec.get("seq", -1)))
+                p["beat_sent"].setdefault(key, float(rec["mono"]))
+            elif k == "beat_seen":
+                key = (str(rec.get("peer")), int(rec.get("seq", -1)))
+                p["beat_seen"].setdefault(key, float(rec["mono"]))
+    return {"procs": procs, "skipped": skipped}
+
+
+# ------------------------------------------------------------- alignment
+
+
+def _pair_bounds(procs: Dict[str, Dict[str, Any]]
+                 ) -> Dict[Tuple[str, str], float]:
+    """One-sided offset bounds from matched beat pairs.
+
+    ``bounds[(W, O)] = min(seen_O - sent_W)`` over matched ``(peer,
+    seq)`` keys, which upper-bounds the writer->observer monotonic
+    offset ``o = t_O - t_W`` (observation lag is non-negative).
+    """
+    # gossip writer name -> proc owning it (the proc that spooled
+    # beat_sent for that name)
+    owner: Dict[str, str] = {}
+    for name, p in procs.items():
+        for (peer, _seq) in p["beat_sent"]:
+            owner[peer] = name
+    bounds: Dict[Tuple[str, str], float] = {}
+    for obs_name, p in procs.items():
+        for (peer, seq), seen in p["beat_seen"].items():
+            w = owner.get(peer)
+            if w is None or w == obs_name:
+                continue
+            sent = procs[w]["beat_sent"].get((peer, seq))
+            if sent is None:
+                continue
+            key = (w, obs_name)
+            delta = seen - sent
+            if key not in bounds or delta < bounds[key]:
+                bounds[key] = delta
+    return bounds
+
+
+def align(procs: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Per-process offset onto the reference timeline.
+
+    Returns ``{proc: {"offset": seconds-to-ADD to the proc's monotonic
+    readings, "method": "reference"|"paired"|"one_way"|"wall"}}``. The
+    reference is the process with the most events (the busiest
+    timeline; name-sorted tiebreak). Offsets compose along a BFS of the
+    beat-pair graph; only beat-disconnected processes use wall anchors.
+    """
+    if not procs:
+        return {}
+    bounds = _pair_bounds(procs)
+    edges: Dict[Tuple[str, str], Tuple[float, str]] = {}
+    for (w, o), fwd in bounds.items():
+        rev = bounds.get((o, w))
+        if rev is not None:
+            # o in [-rev, fwd]; NTP-style midpoint of the bracket
+            edges[(w, o)] = ((fwd - rev) / 2.0, "paired")
+        else:
+            edges[(w, o)] = (fwd, "one_way")
+    ref = sorted(procs, key=lambda n: (-len(procs[n]["events"]), n))[0]
+    out: Dict[str, Dict[str, Any]] = {
+        ref: {"offset": 0.0, "method": "reference"}}
+    queue = deque([ref])
+    while queue:
+        cur = queue.popleft()
+        for (w, o), (delta, method) in edges.items():
+            # edge gives t_o = t_w + delta in monotonic terms
+            if w == cur and o not in out:
+                out[o] = {"offset": out[cur]["offset"] - delta,
+                          "method": method}
+                queue.append(o)
+            elif o == cur and w not in out:
+                out[w] = {"offset": out[cur]["offset"] + delta,
+                          "method": method}
+                queue.append(w)
+    # beat-disconnected processes: wall-anchor fallback, flagged
+    ref_anchor = (procs[ref]["anchors"] or [None])[0]
+    for name, p in procs.items():
+        if name in out:
+            continue
+        anchor = (p["anchors"] or [None])[0]
+        if anchor is None or ref_anchor is None:
+            out[name] = {"offset": 0.0, "method": "unaligned"}
+            continue
+        # align so the two wall clocks agree at their anchors:
+        # wall = mono + c  with  c = wall_anchor - mono_anchor
+        c_p = anchor["wall"] - anchor["mono"]
+        c_r = ref_anchor["wall"] - ref_anchor["mono"]
+        out[name] = {"offset": c_p - c_r, "method": "wall"}
+    return out
+
+
+# --------------------------------------------------------------- merging
+
+
+def merge_dir(spool_dir: str) -> Dict[str, Any]:
+    """Merge a spool dir into one strict Chrome-trace document."""
+    loaded = load_dir(spool_dir)
+    procs = loaded["procs"]
+    if not any(p["events"] for p in procs.values()):
+        raise MergeError(f"no span events under {spool_dir}")
+    offsets = align(procs)
+
+    names = sorted(procs)
+    pid_of = {n: i for i, n in enumerate(names)}
+    rows: List[Dict[str, Any]] = []       # events on the aligned timeline
+    aligned_ts: List[float] = []
+    for name in names:
+        off = offsets[name]["offset"]
+        for mono, ev in procs[name]["events"]:
+            t = mono + off
+            ev = dict(ev)
+            ev["pid"] = pid_of[name]
+            ev["tid"] = int(ev.get("tid", 0))
+            ev["_t"] = t                  # aligned seconds (stripped later)
+            rows.append(ev)
+            aligned_ts.append(t)
+    t_min = min(aligned_ts)
+
+    # causal fix-up: a child may not start before its parent. Span ids
+    # are unique per hop; take each id's earliest event as the start.
+    start_of: Dict[str, Dict[str, Any]] = {}
+    for ev in rows:
+        args = ev.get("args") or {}
+        sid = args.get("span")
+        if isinstance(sid, str) and ev.get("ph") in _CLAMP_PHASES:
+            cur = start_of.get(sid)
+            if cur is None or ev["_t"] < cur["_t"]:
+                start_of[sid] = ev
+    clamped = 0
+    # iterate to convergence: clamping a parent can cascade to its kids
+    for _ in range(len(rows)):
+        moved = False
+        for ev in rows:
+            parent = (ev.get("args") or {}).get("parent")
+            if not isinstance(parent, str):
+                continue
+            head = start_of.get(parent)
+            if head is not None and ev["_t"] < head["_t"]:
+                ev["_t"] = head["_t"]
+                clamped += 1
+                moved = True
+        if not moved:
+            break
+
+    out_events: List[Dict[str, Any]] = []
+    for name in names:
+        out_events.append({"name": "process_name", "ph": "M",
+                           "pid": pid_of[name], "args": {"name": name}})
+    flows: List[Dict[str, Any]] = []
+    for ev in rows:
+        args = ev.get("args") or {}
+        parent = args.get("parent")
+        head = start_of.get(parent) if isinstance(parent, str) else None
+        if head is not None and head["pid"] != ev["pid"]:
+            # cross-process parent link -> Perfetto flow arrow
+            flows.append({"name": "trace", "ph": "s", "cat": "traceflow",
+                          "id": parent, "pid": head["pid"],
+                          "tid": head["tid"],
+                          "ts": (head["_t"] - t_min) * 1e6})
+            flows.append({"name": "trace", "ph": "f", "bp": "e",
+                          "cat": "traceflow", "id": parent,
+                          "pid": ev["pid"], "tid": ev["tid"],
+                          "ts": (ev["_t"] - t_min) * 1e6})
+        ev = dict(ev)
+        ev["ts"] = (ev.pop("_t") - t_min) * 1e6
+        out_events.append(ev)
+    out_events.extend(flows)
+
+    unanchored = sum(p["unanchored"] for p in procs.values())
+    return {
+        "traceEvents": out_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "procs": {n: {"pid": pid_of[n],
+                          "events": len(procs[n]["events"]),
+                          "offset_s": round(offsets[n]["offset"], 6),
+                          "method": offsets[n]["method"]}
+                      for n in names},
+            "skipped_lines": loaded["skipped"],
+            "unanchored_events": unanchored,
+            "clamped": clamped,
+        },
+    }
+
+
+# ------------------------------------------------------------ span trees
+
+
+def span_trees(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Group a merged document's tagged events by trace id.
+
+    Returns ``{trace_id: {"spans": {span_id: {...}}, "procs": set,
+    "roots": [...], "unresolved": [...]}}`` — the shape
+    ``eval_latency --gateway`` and the acceptance tests assert
+    completeness on (every parent resolves, at least one root, the
+    tree spans the processes the request actually crossed).
+    """
+    trees: Dict[str, Dict[str, Any]] = {}
+    for ev in doc.get("traceEvents", []):
+        args = ev.get("args") or {}
+        trace, span = args.get("trace"), args.get("span")
+        if not (isinstance(trace, str) and isinstance(span, str)):
+            continue
+        tree = trees.setdefault(trace, {"spans": {}, "procs": set()})
+        info = tree["spans"].setdefault(span, {
+            "name": ev.get("name"), "parent": None, "ts": ev.get("ts"),
+            "pids": set()})
+        parent = args.get("parent")
+        if isinstance(parent, str):
+            info["parent"] = parent
+        info["pids"].add(ev.get("pid"))
+        if ev.get("ts") is not None and (
+                info["ts"] is None or ev["ts"] < info["ts"]):
+            info["ts"] = ev["ts"]
+        tree["procs"].add(ev.get("pid"))
+    for tree in trees.values():
+        spans = tree["spans"]
+        tree["roots"] = [s for s, i in spans.items()
+                        if i["parent"] is None]
+        tree["unresolved"] = sorted(
+            i["parent"] for i in spans.values()
+            if i["parent"] is not None and i["parent"] not in spans)
+    return trees
+
+
+def _strict_parse(text: str) -> Dict[str, Any]:
+    def _reject(tok: str):
+        raise ValueError(f"non-strict JSON token {tok!r} in merged trace")
+    return json.loads(text, parse_constant=_reject)
+
+
+def validate(doc: Dict[str, Any]) -> List[str]:
+    """Schema check on a merged document; returns problem strings."""
+    problems: List[str] = []
+    try:
+        doc = _strict_parse(json.dumps(doc, allow_nan=False))
+    except ValueError as e:
+        return [f"not strict JSON: {e}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return ["traceEvents missing or empty"]
+    pids: Set[int] = set()
+    for i, ev in enumerate(events):
+        for key in ("name", "ph", "pid"):
+            if key not in ev:
+                problems.append(f"event {i} missing {key!r}")
+        ph = ev.get("ph")
+        if ph != "M":
+            if "ts" not in ev:
+                problems.append(f"event {i} ({ev.get('name')}) missing ts")
+            elif not (isinstance(ev["ts"], (int, float))
+                      and ev["ts"] >= 0):
+                problems.append(f"event {i} has bad ts {ev['ts']!r}")
+            pids.add(ev.get("pid"))
+        if ph == "X" and not (isinstance(ev.get("dur"), (int, float))
+                              and ev["dur"] >= 0):
+            problems.append(f"event {i} ({ev.get('name')}) bad dur")
+    named = {ev.get("pid") for ev in events
+             if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    for pid in pids - named:
+        problems.append(f"pid {pid} has no process_name metadata")
+    for trace, tree in span_trees(doc).items():
+        if not tree["roots"]:
+            problems.append(f"trace {trace}: no root span")
+        if tree["unresolved"]:
+            problems.append(
+                f"trace {trace}: unresolved parents {tree['unresolved']}")
+        for sid, info in tree["spans"].items():
+            parent = info["parent"]
+            if parent in tree["spans"]:
+                if info["ts"] < tree["spans"][parent]["ts"]:
+                    problems.append(
+                        f"trace {trace}: span {sid} starts before its "
+                        f"parent {parent}")
+    return problems
+
+
+# ------------------------------------------------------------ self-check
+
+
+def self_check(run_dir: Path = SELF_CHECK_DIR) -> int:
+    """Merge the committed two-process fixture and assert the output
+    contract end to end. Exit 0 on OK, 1 with reasons otherwise."""
+    if not run_dir.is_dir():
+        print(f"trace-merge --self-check: fixture missing: {run_dir}",
+              file=sys.stderr)
+        return 1
+    problems: List[str] = []
+    try:
+        doc = merge_dir(str(run_dir))
+    except Exception as e:  # noqa: BLE001 — report, don't crash the gate
+        print(f"trace-merge --self-check: FAIL: merge raised "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    problems += validate(doc)
+    other = doc["otherData"]
+    if other["skipped_lines"] != 1:
+        problems.append("fixture's torn trailing line was not skipped "
+                        f"exactly once (skipped={other['skipped_lines']})")
+    methods = {p["method"] for p in other["procs"].values()}
+    if "paired" not in methods:
+        problems.append(f"expected a paired beat alignment, got {methods}")
+    if "wall" in methods:
+        problems.append("beat-connected fixture fell back to wall clocks")
+    trees = span_trees(doc)
+    if not trees:
+        problems.append("no tagged span trees in merged fixture")
+    for trace, tree in trees.items():
+        if len(tree["procs"]) < 2:
+            problems.append(f"trace {trace} does not cross 2 processes")
+    # the fixture's wall clocks disagree by ~123 s on purpose: beats won
+    # only if every recovered offset is within the beat-lag bound
+    for name, p in other["procs"].items():
+        if p["method"] == "paired" and abs(p["offset_s"]) > 0 and not (
+                3999.0 < abs(p["offset_s"]) < 4001.0):
+            problems.append(
+                f"{name}: offset {p['offset_s']} outside the fixture's "
+                f"known ~4000 s skew (wall clocks must not win)")
+    if problems:
+        for p in problems:
+            print(f"trace-merge --self-check: FAIL: {p}", file=sys.stderr)
+        return 1
+    procs = ", ".join(f"{n}@{p['method']}"
+                      for n, p in sorted(other["procs"].items()))
+    print(f"trace-merge --self-check: OK ({len(trees)} trace(s) across "
+          f"{procs}; {other['clamped']} clamped)")
+    return 0
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("spool_dir", nargs="?", type=Path,
+                    help="directory of spans_*.jsonl spool files")
+    ap.add_argument("-o", "--out", type=Path, default=None,
+                    help="merged Chrome-trace output path "
+                         "(default: <spool_dir>/merged_trace.json)")
+    ap.add_argument("--self-check", action="store_true",
+                    help="validate the merge against the committed "
+                         "two-process fixture and exit")
+    args = ap.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if args.spool_dir is None:
+        ap.error("spool_dir is required (or pass --self-check)")
+    try:
+        doc = merge_dir(str(args.spool_dir))
+    except MergeError as e:
+        print(f"trace-merge: {e}", file=sys.stderr)
+        return 2
+    problems = validate(doc)
+    out = args.out or (args.spool_dir / "merged_trace.json")
+    out.write_text(json.dumps(doc, allow_nan=False))
+    other = doc["otherData"]
+    trees = span_trees(doc)
+    print(f"trace-merge: wrote {out} ({len(doc['traceEvents'])} events, "
+          f"{len(other['procs'])} processes, {len(trees)} trace(s), "
+          f"{other['skipped_lines']} torn line(s) skipped)")
+    for name, p in sorted(other["procs"].items()):
+        print(f"  {name}: pid {p['pid']}, {p['events']} events, "
+              f"offset {p['offset_s']:+.6f}s ({p['method']})")
+    if problems:
+        for p in problems:
+            print(f"trace-merge: WARN: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
