@@ -891,6 +891,61 @@ def run_serving_spec_bench() -> dict:
     }
 
 
+def run_serving_tenant_bench() -> dict:
+    """Multi-tenant LoRA serving A/B (dla_tpu/serving/tenancy): N=4
+    tenants' adapters batched into ONE engine (per-slot adapter gather,
+    one decode compile across the whole tenant mix) vs serving the same
+    tenants' interleaved arrival trace on a single-tenant engine that
+    pays a merge-and-republish weight swap at every tenant switch. The
+    headline is the batched arm's tokens/s speedup over the serial-swap
+    arm (higher is better) — the model is sized so a swap costs real
+    merge + republish time, not just a pointer flip, since that is the
+    cost the adapter pool removes;
+    detail pins per-tenant greedy outputs identical across arms,
+    decode_step_compiles == 1 on the batched engine, and the
+    noisy-tenant quota probe (a flooding tenant's sheds must land on
+    itself only, every other tenant's requests finishing untouched).
+    Deterministic, CPU-sized, in-process."""
+    import jax
+    from dla_tpu.eval.eval_latency import measure_multi_tenant
+    from dla_tpu.models.config import ModelConfig
+    from dla_tpu.models.transformer import Transformer
+
+    cfg = ModelConfig(
+        vocab_size=2048, hidden_size=384, intermediate_size=768,
+        num_layers=4, num_heads=6, num_kv_heads=6,
+        max_seq_length=128, remat="none", dtype="float32",
+        param_dtype="float32", lora_r=8, lora_alpha=16.0)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    srv = {"new_tokens": 8, "arrival_rate": 1000.0, "seed": 7,
+           "page_size": 4, "num_pages": 96, "num_slots": 4,
+           "max_model_len": 48, "max_prefill_batch": 2,
+           "chunked_prefill": {"chunk": 8},
+           "tenancy": {"tenants": 4, "requests_per_tenant": 3}}
+    row = measure_multi_tenant(model, params, srv)
+    return {
+        "metric": "serving_tenant_batched_speedup",
+        "value": round(row["batched_speedup"], 3),
+        "unit": "x",
+        "detail": {
+            "tokens_per_s_batched": round(row["tokens_per_s_batched"], 1),
+            "tokens_per_s_serial": round(row["tokens_per_s_serial"], 1),
+            "outputs_identical": bool(row["outputs_identical"]),
+            "decode_step_compiles": int(row["decode_step_compiles"]),
+            "adapter_publishes": int(row["adapter_publishes"]),
+            "adapter_resident": int(row["adapter_resident"]),
+            "noisy_isolated": bool(row["noisy_isolated"]),
+            "noisy_shed": int(row["noisy_shed"]),
+            "others_shed": int(row["others_shed"]),
+            "others_finished": int(row["others_finished"]),
+            "tenants": row["tenants"],
+            "requests_per_tenant": row["requests_per_tenant"],
+            "lora_rank": row["lora_rank"],
+            "params_m": round(count_params(params) / 1e6)},
+    }
+
+
 def run_serving_fleet_bench() -> dict:
     """Fleet-routing A/B/C on a shared-prefix request mix: the SAME
     prompts through (1) a single engine, (2) an N=4 fleet with random
@@ -2124,7 +2179,8 @@ def _emit_and_maybe_extra() -> None:
     for fn in (run_ppo_bench, run_decode_bench, run_serving_bench,
                run_serving_prefix_bench, run_serving_spec_bench,
                run_serving_fleet_bench, run_serving_disagg_bench,
-               run_serving_gateway_bench, run_elastic_resilience_bench,
+               run_serving_gateway_bench, run_serving_tenant_bench,
+               run_elastic_resilience_bench,
                run_rollout_fleet_bench, run_observability_bench):
         try:
             res = fn()
@@ -2208,6 +2264,15 @@ def main() -> int:
         from _cpuhost import force_cpu_platform
         force_cpu_platform()
         print(json.dumps(run_serving_disagg_bench()))
+        return 0
+    if "serving-tenant" in sys.argv[1:]:
+        # multi-tenant LoRA serving A/B target: same in-process
+        # forced-CPU pattern; headline is batched-vs-serial-swap
+        # tokens/s speedup, detail pins output identity, one decode
+        # compile across the tenant mix, and noisy-tenant isolation
+        from _cpuhost import force_cpu_platform
+        force_cpu_platform()
+        print(json.dumps(run_serving_tenant_bench()))
         return 0
     if "serving-gateway" in sys.argv[1:]:
         # gateway wire-overhead + federation chaos target: same

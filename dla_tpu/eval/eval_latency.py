@@ -72,6 +72,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "HTTP through the streaming gateway (SSE "
                         "per-token events; equivalent to "
                         "latency.serving.gateway.enabled: true)")
+    p.add_argument("--tenancy", action="store_true",
+                   help="also run the multi-tenant serving A/B: N "
+                        "tenants' LoRA adapters batched into ONE "
+                        "engine (per-slot adapter gather) vs serving "
+                        "them serially with merge-and-republish swaps, "
+                        "plus a noisy-tenant quota-isolation probe "
+                        "(equivalent to "
+                        "latency.serving.tenancy.enabled: true)")
     return p.parse_args(argv)
 
 
@@ -947,6 +955,186 @@ def measure_gateway(model, params, srv: Dict) -> Dict[str, object]:
     }
 
 
+def measure_multi_tenant(model, params, srv: Dict) -> Dict[str, object]:
+    """Multi-tenant serving A/B plus a quota-isolation probe.
+
+    **A/B**: the SAME interleaved round-robin arrival trace, greedy,
+    through (a) ONE engine holding every tenant's LoRA adapter in the
+    device pool — heterogeneous tenants batch into one decode step via
+    the per-slot adapter gather — vs (b) a single-tenant engine serving
+    the trace in order, which can only batch CONSECUTIVE same-tenant
+    arrivals and pays a ``merge_lora`` + ``publish_params`` weight swap
+    at every tenant switch (the dedicated-engine-per-tenant operating
+    model, time-sliced over interleaved traffic). Per-tenant outputs
+    must be token-identical across arms, and the batched engine's
+    decode must have compiled exactly once across the whole tenant
+    mix.
+
+    **Isolation**: a fresh tenancy engine gives one noisy tenant a
+    near-empty token bucket and floods it; the probe passes when every
+    shed lands on the noisy tenant and the other tenants' requests all
+    finish — one tenant's overload must not burn its neighbours."""
+    from dla_tpu.serving import ServingEngine
+    from dla_tpu.serving.metrics import ServingMetrics
+
+    if model.cfg.lora_r <= 0:
+        raise ValueError("multi-tenant A/B wants a LoRA-enabled model "
+                         "(model.lora.enabled / lora_r > 0)")
+    tn = srv.get("tenancy") or {}
+    n_tenants = int(tn.get("tenants", 4))
+    per_tenant = int(tn.get("requests_per_tenant", 3))
+    new_tokens = int(srv.get("new_tokens", 8))
+    rate = float(srv.get("arrival_rate", 1000.0))
+    gen = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           eos_token_id=-1)          # greedy, run to length
+    rs = np.random.RandomState(int(srv.get("seed", 0)))
+    vocab = model.cfg.vocab_size
+    cp = srv.get("chunked_prefill") or {}
+    chunk = int(cp.get("chunk", 0)) or 2 * int(srv.get("page_size", 16))
+    pool_cfg = {"max_adapters": n_tenants,
+                "max_rank": int(model.cfg.lora_r)}
+
+    tenants = [f"tenant{i}" for i in range(n_tenants)]
+    # distinct, NON-trivial adapters per tenant: init_lora zeros the B
+    # factors (identity delta), so randomize both factors — every
+    # tenant must produce different tokens than base weights would
+    adapters: Dict[str, Dict] = {}
+    for i, t in enumerate(tenants):
+        key = jax.random.key(1000 + i)
+        tree = model.init_lora(key)
+        layers = {}
+        for name, leaf in tree["layers"].items():
+            key, sub = jax.random.split(key)
+            layers[name] = 0.05 * jax.random.normal(
+                sub, leaf.shape, jnp.float32)
+        adapters[t] = {"layers": layers}
+    prompts: Dict[str, List[List[int]]] = {
+        t: [[int(x) for x in rs.randint(3, vocab - 1,
+                                        (rs.randint(chunk // 2, chunk),))]
+            for _ in range(per_tenant)]
+        for t in tenants}
+    order = [(tenants[j % n_tenants], j // n_tenants)
+             for j in range(n_tenants * per_tenant)]
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, len(order)))
+
+    def drain_collect(eng) -> Dict[int, List[int]]:
+        toks: Dict[int, List[int]] = {}
+        while eng.has_work():
+            for rid, tok in eng.step():
+                toks.setdefault(rid, []).append(tok)
+        return toks
+
+    def warm(eng) -> None:
+        # compile warmup (chunk fn + decode) off the clock, then zero
+        # the instrument panel so percentiles measure serving, not XLA
+        eng.submit([3 + (i % 251) for i in range(chunk + 1)], 1)
+        eng.run_until_drained()
+        eng.metrics = ServingMetrics()
+
+    # ---- arm A: one engine, every adapter resident, tenants batched --
+    eng = ServingEngine(model, params, gen, _serving_config(
+        srv, prefill_chunk=chunk, tenancy={"adapter_pool": pool_cfg}))
+    for t in tenants:
+        eng.publish_adapter(t, adapters[t])
+    warm(eng)
+    rids: Dict[tuple, int] = {}
+    t0 = time.perf_counter()
+    for (t, j), at in zip(order, arrivals):
+        now = time.perf_counter() - t0
+        if at > now:
+            time.sleep(at - now)
+        rids[(t, j)] = eng.submit(prompts[t][j], new_tokens, tenant=t)
+    toks = drain_collect(eng)
+    dt_batched = time.perf_counter() - t0
+    outs_batched = {t: [toks.get(rids[(t, j)], [])
+                        for j in range(per_tenant)] for t in tenants}
+    decode_compiles = int(eng.decode_compiles)
+    store = eng.adapter_store
+
+    # ---- arm B: one single-tenant engine, serial merge-and-swap ------
+    # the SAME interleaved trace: a swap engine can only batch
+    # CONSECUTIVE same-tenant arrivals, and pays a merge_lora +
+    # publish_params weight swap at every tenant switch — the real
+    # cost of time-slicing one engine across interleaved tenants
+    eng2 = ServingEngine(model, model.merge_lora(params, adapters[
+        tenants[0]]), gen, _serving_config(srv, prefill_chunk=chunk))
+    warm(eng2)
+    outs_serial: Dict[str, List[List[int]]] = {
+        t: [None] * per_tenant for t in tenants}
+    swaps, current = 0, None
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(order):
+        t = order[i][0]
+        run = []
+        while i < len(order) and order[i][0] == t:
+            run.append(order[i])
+            i += 1
+        if current != t:
+            eng2.publish_params(model.merge_lora(params, adapters[t]))
+            current = t
+            swaps += 1
+        trids = {tj: eng2.submit(prompts[tj[0]][tj[1]], new_tokens)
+                 for tj in run}
+        toks = drain_collect(eng2)
+        for (tt, jj), r in trids.items():
+            outs_serial[tt][jj] = toks.get(r, [])
+    dt_serial = time.perf_counter() - t0
+
+    total_tokens = n_tenants * per_tenant * new_tokens
+
+    # ---- isolation probe: noisy tenant on a near-empty bucket --------
+    eng3 = ServingEngine(model, params, gen, _serving_config(
+        srv, prefill_chunk=chunk, tenancy={
+            "adapter_pool": pool_cfg,
+            "quotas": {tenants[0]: {"rate": 1e-6, "burst": 1.0}}}))
+    for t in tenants:
+        eng3.publish_adapter(t, adapters[t])
+    # warm WITHOUT the metrics reset: the per-tenant panels bind to the
+    # registry the engine was constructed with, and the probe reads them
+    eng3.submit([3 + (i % 251) for i in range(chunk + 1)], 1)
+    eng3.run_until_drained()
+    flood = 3 * per_tenant
+    for j in range(flood):                # noisy tenant floods its bucket
+        eng3.submit(prompts[tenants[0]][j % per_tenant], new_tokens,
+                    tenant=tenants[0])
+    for t in tenants[1:]:
+        for p in prompts[t]:
+            eng3.submit(p, new_tokens, tenant=t)
+    drain_collect(eng3)
+    iso = eng3.metrics.registry.snapshot()
+
+    def tkey(t, name):
+        return iso.get(f"serving/tenant/{t}/{name}", 0.0)
+
+    noisy_shed = tkey(tenants[0], "requests_shed")
+    others_shed = sum(tkey(t, "requests_shed") for t in tenants[1:])
+    others_finished = sum(tkey(t, "requests_finished")
+                          for t in tenants[1:])
+    return {
+        "tenants": n_tenants,
+        "requests_per_tenant": per_tenant,
+        "new_tokens": new_tokens,
+        "prefill_chunk": chunk,
+        "lora_rank": int(model.cfg.lora_r),
+        "duration_s_batched": dt_batched,
+        "duration_s_serial": dt_serial,
+        "tokens_per_s_batched": total_tokens / dt_batched,
+        "tokens_per_s_serial": total_tokens / dt_serial,
+        "batched_speedup": dt_serial / dt_batched,
+        "outputs_identical": outs_batched == outs_serial,
+        "decode_step_compiles": decode_compiles,
+        "adapter_publishes": int(store.publishes),
+        "adapter_resident": int(store.resident_count),
+        "noisy_shed": noisy_shed,
+        "others_shed": others_shed,
+        "others_finished": others_finished,
+        "noisy_isolated": bool(noisy_shed > 0 and others_shed == 0
+                               and others_finished
+                               == (n_tenants - 1) * per_tenant),
+    }
+
+
 def main(argv=None) -> None:
     args = parse_args(argv)
     config = load_config(args.config)
@@ -1075,6 +1263,20 @@ def main(argv=None) -> None:
                     f"ms/token, outputs identical: "
                     f"{gwr['outputs_identical']}, disconnect "
                     f"cancelled: {gwr['disconnect_cancelled']}")
+            if args.tenancy or \
+                    (srv.get("tenancy") or {}).get("enabled", False):
+                entry["tenancy"] = measure_multi_tenant(
+                    bundle.model, bundle.params, srv)
+                tnc = entry["tenancy"]
+                log_rank_zero(
+                    f"[dla_tpu][latency] tenancy (N="
+                    f"{tnc['tenants']}): "
+                    f"{tnc['tokens_per_s_batched']:.0f} tok/s batched "
+                    f"vs {tnc['tokens_per_s_serial']:.0f} serial-swap "
+                    f"({tnc['batched_speedup']:.2f}x), decode compiles "
+                    f"{tnc['decode_step_compiles']}, outputs identical:"
+                    f" {tnc['outputs_identical']}, noisy tenant "
+                    f"isolated: {tnc['noisy_isolated']}")
             if args.speculative or \
                     (srv.get("speculative") or {}).get("enabled", False):
                 entry["speculative"] = measure_speculative(

@@ -402,6 +402,29 @@ class Transformer:
         return base_out + ((z @ a.astype(self.adtype))
                            @ b_.astype(self.adtype)) * scale
 
+    def slot_lora_xs(self, adapters: Optional[Params]) -> Params:
+        """Per-slot LoRA leaves for the paged decode scans: gather each
+        batch row's adapter from the stacked ``[N, L, din, r]`` pools by
+        ``adapters["idx"]`` ([B] int32) and move the layer axis leading
+        ([L, B, din, r]) so the leaves ride the layer scan like
+        ``swa_on``. Keys are renamed ``_lora_`` -> ``_slot_lora_`` so
+        the training-path ``_lora_proj`` never sees them; pool B factors
+        are expected pre-scaled by alpha/r (AdapterStore's publish
+        contract), so the in-graph delta is a bare x@A@B. ``None``
+        (tenancy off) contributes nothing — the decode graph is
+        byte-identical to the adapter-free build."""
+        if adapters is None:
+            return {}
+        idx = adapters["idx"]
+        out: Params = {}
+        for key, pool in adapters.items():
+            if key == "idx":
+                continue
+            g = jnp.take(pool, idx, axis=0)        # [B, L, din, r]
+            out[key.replace("_lora_", "_slot_lora_")] = \
+                jnp.moveaxis(g, 0, 1)              # [L, B, din, r]
+        return out
+
     # ------------------------------------------------------- partition specs
 
     def partition_specs(self) -> Params:
@@ -1490,6 +1513,16 @@ class Transformer:
 
         def proj(name, inp):
             out = self._dense(layer, name, inp)
+            sa = layer.get(f"{name}_slot_lora_a")
+            if sa is not None:
+                # per-slot low-rank delta around the (possibly int8)
+                # base matmul: inp [B,T,din] x A [B,din,r] x B [B,r,out]
+                # — B pre-scaled by alpha/r at publish, rank-padded with
+                # zeros so every slot shares one static shape
+                sb = layer[f"{name}_slot_lora_b"]
+                z = jnp.einsum("btd,bdr->btr", inp, sa.astype(self.adtype))
+                out = out + jnp.einsum("btr,bro->bto", z,
+                                       sb.astype(self.adtype))
             bias = layer.get(f"{name}_bias")
             return out if bias is None else out + cast(bias)
 
@@ -1709,6 +1742,7 @@ class Transformer:
 
     def decode_step_paged(self, params: Params, view: Params,
                           tokens: jnp.ndarray,  # [B] the tokens just sampled
+                          adapters: Optional[Params] = None,
                           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One decode step against an EXTERNALLY-gathered KV view — the
         cache-layout-agnostic sibling of ``decode_step``. The serving
@@ -1754,7 +1788,8 @@ class Transformer:
 
             return self._decode_layer(layer, carry, cos, sin, attend)
 
-        xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
+        layers = self._with_layer_windows(self._flat_layers(params["layers"]))
+        xs = ({**layers, **self.slot_lora_xs(adapters)},
               view["k"], view["v"])
         x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
         h = self._final_norm(params, x)
@@ -1765,6 +1800,7 @@ class Transformer:
                            tokens: jnp.ndarray,     # [B, C] chunk tokens
                            positions: jnp.ndarray,  # [B, C] absolute pos
                            last_index: jnp.ndarray,  # [B] last real token
+                           adapters: Optional[Params] = None,
                            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One fixed-width prefill CHUNK against an externally-gathered
         KV view — the chunked-prefill sibling of ``decode_step_paged``.
@@ -1804,7 +1840,8 @@ class Transformer:
 
             return self._decode_layer(layer, carry, cos, sin, attend)
 
-        xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
+        layers = self._with_layer_windows(self._flat_layers(params["layers"]))
+        xs = ({**layers, **self.slot_lora_xs(adapters)},
               view["k"], view["v"])
         x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
         h = self._final_norm(params, x)                     # [B, C, H]
@@ -1814,6 +1851,7 @@ class Transformer:
 
     def decode_block_paged(self, params: Params, view: Params,
                            tokens: jnp.ndarray,  # [B, G] token block
+                           adapters: Optional[Params] = None,
                            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Verify a G-token block against an externally-gathered KV view
         — the speculative-verify sibling of ``decode_step_paged``. Row
@@ -1853,7 +1891,8 @@ class Transformer:
 
             return self._decode_layer(layer, carry, cos, sin, attend)
 
-        xs = (self._with_layer_windows(self._flat_layers(params["layers"])),
+        layers = self._with_layer_windows(self._flat_layers(params["layers"]))
+        xs = ({**layers, **self.slot_lora_xs(adapters)},
               view["k"], view["v"])
         x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
         h = self._final_norm(params, x)                      # [B, G, H]

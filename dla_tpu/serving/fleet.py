@@ -131,6 +131,9 @@ class FleetConfig:
     sticky_bonus: float = 0.5          # hit-frac stand-in for a sticky
                                        # family whose pages are not yet
                                        # registered (in-flight prefill)
+    adapter_weight: float = 1.0        # score weight of the tenant's
+                                       # adapter residency (device-hot
+                                       # 1.0, published-but-spilled 0.5)
     autoscale: bool = False
     scale_up_burn: float = 1.0         # max member SLO burn rate >= this
     scale_up_pressure: float = 0.85    # mean member pressure >= this
@@ -352,10 +355,17 @@ class FleetRouter:
         return max(occ, eng.scheduler.queue_depth / max(1, qcap))
 
     def member_burn(self, member: _Member) -> float:
-        slo = member.engine.slo
-        if slo is None or not slo.slos:
-            return 0.0
-        return max(slo.burn_rate(obj) for obj in slo.slos)
+        eng = member.engine
+        slo = eng.slo
+        burn = (max(slo.burn_rate(obj) for obj in slo.slos)
+                if slo is not None and slo.slos else 0.0)
+        # per-tenant SLOs feed the same autoscale signal: one tenant
+        # burning its budget scales the fleet even when the aggregate
+        # latency surface looks healthy
+        tenants = getattr(eng, "tenants", None)
+        if tenants is not None:
+            burn = max(burn, tenants.max_burn())
+        return burn
 
     def _spawn(self) -> _Member:
         slot = next(i for i in range(len(self._slots) + 1)
@@ -389,16 +399,19 @@ class FleetRouter:
     def submit(self, prompt_tokens: List[int], max_new_tokens: int,
                arrival_time: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0, sampling=None) -> int:
+               priority: int = 0, sampling=None,
+               tenant: Optional[str] = None) -> int:
         candidates = [m for m in self.members()
                       if m.accepting() and m.role != "decode"]
         if self._draining or not candidates:
             raise RuntimeError(
                 "fleet is draining: no member accepts admissions")
-        member, by_prefix = self._choose(prompt_tokens, candidates)
+        member, by_prefix = self._choose(prompt_tokens, candidates,
+                                         tenant=tenant)
         rid = member.sup.submit(
             prompt_tokens, max_new_tokens, arrival_time=arrival_time,
-            deadline_s=deadline_s, priority=priority, sampling=sampling)
+            deadline_s=deadline_s, priority=priority, sampling=sampling,
+            tenant=tenant)
         self._placement[rid] = member
         self._affinity[self._family(prompt_tokens)] = member.slot
         if by_prefix:
@@ -411,14 +424,31 @@ class FleetRouter:
         ps = self.members()[0].engine.cfg.page_size if self._slots else 16
         return tuple(prompt_tokens[:ps])
 
-    def _peek(self, member: _Member, prompt_tokens: List[int]) -> int:
+    def _peek(self, member: _Member, prompt_tokens: List[int],
+              tenant: Optional[str] = None) -> int:
         eng = member.engine
         if eng.prefix_cache is None:
             return 0
-        return eng.prefix_cache.peek(prompt_tokens, eng.cfg.prefill_chunk)
+        return eng.prefix_cache.peek(prompt_tokens, eng.cfg.prefill_chunk,
+                                     namespace=tenant)
+
+    def _adapter_heat(self, member: _Member,
+                      tenant: Optional[str]) -> float:
+        """Adapter residency scored like prefix-cache heat: a member
+        whose pool already holds the tenant's adapter on device serves
+        its first token without a host->device load (1.0); a member
+        holding only the spilled host copy avoids a publish but pays
+        the load (0.5); anywhere else the adapter is absent (0.0)."""
+        if tenant is None:
+            return 0.0
+        store = getattr(member.engine, "adapter_store", None)
+        if store is None or not store.has(tenant):
+            return 0.0
+        return 1.0 if store.resident(tenant) else 0.5
 
     def _choose(self, prompt_tokens: List[int],
-                candidates: List[_Member]) -> Tuple[_Member, bool]:
+                candidates: List[_Member],
+                tenant: Optional[str] = None) -> Tuple[_Member, bool]:
         """-> (member, routed_by_prefix). Deterministic: score ties
         break toward the sticky-affinity slot, then the lowest slot."""
         if self.cfg.placement == "random":
@@ -436,10 +466,12 @@ class FleetRouter:
             # there — score it as if the expected shared prefix were
             # already cached, or placement scatters a family submitted
             # in one burst across the whole fleet
-            hit = self._peek(m, prompt_tokens) / n
+            hit = self._peek(m, prompt_tokens, tenant) / n
             if m.slot == sticky:
                 hit = max(hit, self.cfg.sticky_bonus)
             score = (self.cfg.prefix_weight * hit
+                     + self.cfg.adapter_weight * self._adapter_heat(
+                         m, tenant)
                      - self.cfg.load_weight * self.member_pressure(m))
             key = (score, -m.slot)
             if best is None or key > best_key:
@@ -487,6 +519,24 @@ class FleetRouter:
         for wave in broadcast_waves(len(members), branch):
             futures = [members[i].pool.submit(
                 members[i].engine.publish_params, params, donate=donate)
+                for i in wave]
+            for fut in futures:
+                fut.result()
+
+    def publish_adapter(self, tenant: str, tree, *, alpha=None,
+                        rank=None, branch: int = 2) -> None:
+        """Fleet-wide adapter refit: publish ``tenant``'s LoRA tree into
+        every live member's AdapterStore on the same broadcast-tree wave
+        schedule as :meth:`publish_params` — every member can then land
+        the tenant's requests (placement still prefers members where the
+        adapter is device-resident, see ``adapter_weight``). Same
+        caveat: a supervisor rebuild re-runs the factory, which must
+        republish adapters it wants the rebuilt engine to serve."""
+        members = self.members()
+        for wave in broadcast_waves(len(members), branch):
+            futures = [members[i].pool.submit(
+                members[i].engine.publish_adapter, tenant, tree,
+                alpha=alpha, rank=rank)
                 for i in wave]
             for fut in futures:
                 fut.result()
@@ -559,12 +609,14 @@ class FleetRouter:
             done=req.state in TERMINAL_STATES, request=req,
             sampling=req.sampling,
             streamed_logps=list(req.generated_logprobs),
+            tenant=req.tenant,
             migrated_from=ticket.src_slot, migrations=1)
         self._placement[req.rid] = dst
         self._affinity[self._family(list(req.prompt_tokens))] = dst.slot
         return req
 
-    def peek_score(self, prompt_tokens: List[int]) -> Tuple[float, float]:
+    def peek_score(self, prompt_tokens: List[int],
+                   tenant: Optional[str] = None) -> Tuple[float, float]:
         """-> (best peeked hit-frac, mean member pressure) over the
         accepting members — the gateway's ``/v1/peek`` surface, so a
         FederatedRouter scores this fleet with the same inputs
@@ -574,7 +626,8 @@ class FleetRouter:
         if self._draining or not candidates:
             return 0.0, 1.0
         n = max(1, len(prompt_tokens))
-        hit = max(self._peek(m, prompt_tokens) / n for m in candidates)
+        hit = max(self._peek(m, prompt_tokens, tenant) / n
+                  for m in candidates)
         pressure = float(np.mean(
             [self.member_pressure(m) for m in candidates]))
         return hit, pressure
@@ -774,14 +827,16 @@ class FleetRouter:
             member.engine.scheduler.cancel(req, "rebalanced")
             if entry is None or entry.done:
                 continue
-            dst, _ = self._choose(entry.prompt_tokens, peers)
+            dst, _ = self._choose(entry.prompt_tokens, peers,
+                                  tenant=entry.tenant)
             restored = dst.engine.restore(
                 entry.prompt_tokens, entry.max_new_tokens,
                 generated=list(entry.streamed),
                 arrival_time=entry.arrival_time,
                 deadline=entry.deadline, priority=entry.priority,
                 rid=req.rid, sampling=entry.sampling,
-                generated_logprobs=list(entry.streamed_logps))
+                generated_logprobs=list(entry.streamed_logps),
+                tenant=entry.tenant)
             entry.request = restored
             entry.done = restored.state in TERMINAL_STATES
             del src.journal[req.rid]

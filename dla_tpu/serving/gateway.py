@@ -288,17 +288,20 @@ class ServingGateway:
                 pass
         self._bump("disconnect_cancels")
 
-    def peek(self, prompt_tokens) -> Tuple[float, float]:
+    def peek(self, prompt_tokens,
+             tenant: Optional[str] = None) -> Tuple[float, float]:
         """(hit_frac, pressure) for a prompt — the federation scoring
-        inputs. Caller must hold ``_lock``."""
+        inputs. Caller must hold ``_lock``. ``tenant`` scopes the
+        prefix peek to that tenant's KV namespace."""
         eng = self.engine
         if hasattr(eng, "peek_score"):          # FleetRouter
-            return eng.peek_score(list(prompt_tokens))
+            return eng.peek_score(list(prompt_tokens), tenant=tenant)
         n = max(1, len(prompt_tokens))
         hit = 0.0
         if getattr(eng, "prefix_cache", None) is not None:
             hit = eng.prefix_cache.peek(
-                list(prompt_tokens), eng.cfg.prefill_chunk) / n
+                list(prompt_tokens), eng.cfg.prefill_chunk,
+                namespace=tenant) / n
         occ = eng.cache.allocator.occupancy
         qcap = (eng.admission.cfg.max_queue_depth
                 if eng.admission is not None
@@ -444,6 +447,9 @@ def _make_handler(outer: ServingGateway):
             sampling = spec.get("sampling")
             if sampling is not None:
                 sampling = SamplingParams(**sampling)
+            # multi-tenant serving: an unknown tenant raises ValueError
+            # out of engine.submit and surfaces as HTTP 400
+            tenant = spec.get("tenant")
             # trace context: continue the caller's trace (a federated
             # router hop) or mint a root here — the gateway IS the
             # request's origin for direct clients
@@ -460,7 +466,7 @@ def _make_handler(outer: ServingGateway):
                         int(spec.get("max_new_tokens") or 16),
                         deadline_s=spec.get("deadline_s"),
                         priority=int(spec.get("priority") or 0),
-                        sampling=sampling)
+                        sampling=sampling, tenant=tenant)
                 except RuntimeError as exc:     # draining: admission shut
                     self._json(503, {"error": str(exc)},
                                retry_after=True)
@@ -597,7 +603,7 @@ def _make_handler(outer: ServingGateway):
             tracer = get_tracer()
             t0 = tracer.now()
             with outer._lock:
-                hit, pressure = outer.peek(prompt)
+                hit, pressure = outer.peek(prompt, spec.get("tenant"))
                 draining = outer.draining
             if parent is not None:
                 ctx = parent.child()

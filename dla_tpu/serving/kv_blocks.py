@@ -289,7 +289,17 @@ class PrefixCache:
         it would corrupt future hits, so writers must copy first."""
         return page in self._page_key
 
-    def lookup(self, tokens: Sequence[int], chunk: int
+    @staticmethod
+    def _nskey(namespace: Optional[str], sub: Tuple[int, ...]):
+        """Namespace a token-tuple key. Multi-tenant serving keys cached
+        KV by ``(tenant, tokens)`` — adapters change KV contents, so one
+        tenant's pages must never answer another's lookup. Applied at
+        the dict-key layer only: prefix slicing stays on the raw token
+        tuple, so page alignment is untouched."""
+        return sub if namespace is None else (namespace,) + sub
+
+    def lookup(self, tokens: Sequence[int], chunk: int,
+               namespace: Optional[str] = None,
                ) -> Tuple[List[int], int, Optional[np.ndarray]]:
         """Longest usable cached prefix of ``tokens``.
 
@@ -303,9 +313,9 @@ class PrefixCache:
         bit-identical to cache-off."""
         self.lookups += 1
         key = tuple(tokens)
-        pages, hit, entry = self._walk(key, chunk)
+        pages, hit, entry = self._walk(key, chunk, namespace)
         if entry is not None:
-            self._full.move_to_end(key)
+            self._full.move_to_end(self._nskey(namespace, key))
             for p in pages:
                 self.allocator.incref(p)
             self.hit_tokens += hit
@@ -315,7 +325,8 @@ class PrefixCache:
         self.hit_tokens += hit
         return pages, hit, None
 
-    def peek(self, tokens: Sequence[int], chunk: int) -> int:
+    def peek(self, tokens: Sequence[int], chunk: int,
+             namespace: Optional[str] = None) -> int:
         """Read-only hit-length estimate: the ``hit_len`` a ``lookup``
         of ``tokens`` would return right now, WITHOUT taking page
         references, touching the full-prompt LRU order, or advancing the
@@ -324,10 +335,11 @@ class PrefixCache:
         side-effect-free — a peek that increfed would leak references on
         the N-1 engines that lose the placement."""
         self.peeks += 1
-        _, hit, _ = self._walk(tuple(tokens), chunk)
+        _, hit, _ = self._walk(tuple(tokens), chunk, namespace)
         return hit
 
-    def _walk(self, key: Tuple[int, ...], chunk: int
+    def _walk(self, key: Tuple[int, ...], chunk: int,
+              namespace: Optional[str] = None,
               ) -> Tuple[List[int], int, Optional["_FullEntry"]]:
         """Shared read-only index walk behind ``lookup`` and ``peek``:
         ``(pages, hit_len, full_entry)`` with NO side effects — the
@@ -336,9 +348,9 @@ class PrefixCache:
         exact-full-prompt hit (``hit_len == len(key)``)."""
         n = len(key)
         ps = self.page_size
-        entry = self._full.get(key)
+        entry = self._full.get(self._nskey(namespace, key))
         if entry is not None:
-            pages = self._assemble_full(key, entry)
+            pages = self._assemble_full(key, entry, namespace)
             if pages is not None:
                 return pages, n, entry
         # chunk-granular: the last token's logits must be recomputed, so
@@ -348,7 +360,7 @@ class PrefixCache:
         pages: List[int] = []
         k = 1
         while k * ps <= max_hit:
-            p = self._index.get(key[:k * ps])
+            p = self._index.get(self._nskey(namespace, key[:k * ps]))
             if p is None:
                 break
             pages.append(p)
@@ -356,14 +368,15 @@ class PrefixCache:
         hit = (len(pages) * ps // chunk) * chunk if chunk > 0 else 0
         return pages[:hit // ps], hit, None
 
-    def _assemble_full(self, key: Tuple[int, ...], entry: _FullEntry
+    def _assemble_full(self, key: Tuple[int, ...], entry: _FullEntry,
+                       namespace: Optional[str] = None,
                        ) -> Optional[List[int]]:
         """All physical pages of an exact-prompt entry, or None when an
         interior page was evicted (fall back to the chunked walk)."""
         n, ps = len(key), self.page_size
         pages: List[int] = []
         for k in range(1, n // ps + 1):
-            p = self._index.get(key[:k * ps])
+            p = self._index.get(self._nskey(namespace, key[:k * ps]))
             if p is None:
                 return None
             pages.append(p)
@@ -373,7 +386,9 @@ class PrefixCache:
             pages.append(entry.tail_page)
         return pages
 
-    def acquire_pages(self, tokens: Sequence[int]) -> Optional[List[int]]:
+    def acquire_pages(self, tokens: Sequence[int],
+                      namespace: Optional[str] = None,
+                      ) -> Optional[List[int]]:
         """Every full page of a PAGE-ALIGNED prefix, each ALREADY
         increfed — or None, with no references taken, when the prefix is
         not aligned or any page is missing (an interior eviction hole).
@@ -391,7 +406,7 @@ class PrefixCache:
             return None
         pages: List[int] = []
         for k in range(1, n // ps + 1):
-            p = self._index.get(key[:k * ps])
+            p = self._index.get(self._nskey(namespace, key[:k * ps]))
             if p is None:
                 for q in pages:
                     self.allocator.decref(q)
@@ -404,7 +419,8 @@ class PrefixCache:
     # ------------------------------------------------------- registration
 
     def register(self, tokens: Sequence[int], pages: Sequence[int],
-                 logits: Optional[np.ndarray] = None) -> None:
+                 logits: Optional[np.ndarray] = None,
+                 namespace: Optional[str] = None) -> None:
         """Index a freshly prefilled prefix: one entry per FULL page
         (first writer wins — an existing entry for the same tokens keeps
         its page), plus, when ``logits`` is given, an exact-full-prompt
@@ -413,21 +429,22 @@ class PrefixCache:
         key = tuple(tokens)
         n, ps = len(key), self.page_size
         for k in range(1, n // ps + 1):
-            sub = key[:k * ps]
+            sub = self._nskey(namespace, key[:k * ps])
             page = pages[k - 1]
             if sub in self._index or page == 0:
                 continue
             self._index[sub] = page
             self._page_key[page] = ("page", sub)
-        if logits is None or key in self._full:
+        nkey = self._nskey(namespace, key)
+        if logits is None or nkey in self._full:
             return
         tail: Optional[int] = None
         if n % ps:
             tail = pages[n // ps]
             if tail == 0:
                 return
-            self._page_key[tail] = ("tail", key)
-        self._full[key] = _FullEntry(tail, np.asarray(logits))
+            self._page_key[tail] = ("tail", nkey)
+        self._full[nkey] = _FullEntry(tail, np.asarray(logits))
         while len(self._full) > self.logits_capacity:
             old_key, old = self._full.popitem(last=False)
             if old.tail_page is not None and \

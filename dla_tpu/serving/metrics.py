@@ -77,6 +77,11 @@ class ServingMetrics:
             "serving/migration/failed_migrations")
         self.handoff_wait_ms = r.histogram(
             "serving/migration/handoff_wait_ms")
+        self.adapter_resident = r.gauge("serving/adapter_pool/resident")
+        self.adapter_publishes = r.counter(
+            "serving/adapter_pool/publishes")
+        self.adapter_loads = r.counter("serving/adapter_pool/loads")
+        self.adapter_spills = r.counter("serving/adapter_pool/spills")
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {
@@ -128,6 +133,12 @@ class ServingMetrics:
                 self.host_bounce_bytes.value),
             "serving/migration/failed_migrations": float(
                 self.failed_migrations.value),
+            "serving/adapter_pool/resident": self.adapter_resident.value,
+            "serving/adapter_pool/publishes": float(
+                self.adapter_publishes.value),
+            "serving/adapter_pool/loads": float(self.adapter_loads.value),
+            "serving/adapter_pool/spills": float(
+                self.adapter_spills.value),
         }
         out.update(self.ttft_ms.summary("serving/ttft_ms_"))
         out.update(self.itl_ms.summary("serving/itl_ms_"))

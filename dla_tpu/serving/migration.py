@@ -149,6 +149,10 @@ class MigrationTicket:
     # docs/OBSERVABILITY.md): optional meta key read via ``meta.get`` on
     # the old side, so carrying it needs no WIRE_VERSION bump
     trace_ctx: Optional[dict] = None
+    # owning tenant (multi-tenant serving): the importer re-binds the
+    # request's adapter and KV namespace from this; optional meta key
+    # read via ``meta.get``, so no WIRE_VERSION bump either
+    tenant: Optional[str] = None
 
     @property
     def payload_bytes(self) -> int:
@@ -190,6 +194,7 @@ class MigrationTicket:
             "first_token_time": self.first_token_time,
             "last_token_time": self.last_token_time,
             "trace_ctx": self.trace_ctx,
+            "tenant": self.tenant,
             "k_dtype": str(k.dtype), "k_shape": list(k.shape),
             "v_dtype": str(v.dtype), "v_shape": list(v.shape),
         }
@@ -260,7 +265,8 @@ class MigrationTicket:
             admitted_time=meta["admitted_time"],
             first_token_time=meta["first_token_time"],
             last_token_time=meta["last_token_time"],
-            trace_ctx=meta.get("trace_ctx"))
+            trace_ctx=meta.get("trace_ctx"),
+            tenant=meta.get("tenant"))
 
 
 class KVMigrator:

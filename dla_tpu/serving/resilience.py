@@ -308,6 +308,10 @@ class JournalEntry:
     request: Request      # live request object on the CURRENT engine
     sampling: Optional[object] = None          # SamplingParams override
     streamed_logps: List[float] = dataclasses.field(default_factory=list)
+    # owning tenant (multi-tenant serving): replay re-binds the adapter
+    # and KV namespace from this — the rebuilt engine's factory must
+    # republish the tenant's adapter (engine.restore fails loudly if not)
+    tenant: Optional[str] = None
     # migration provenance: the fleet moves a journal entry to the
     # TARGET member's supervisor atomically with the KV install (popped
     # from the source first), so replay after a mid-handoff crash lands
@@ -374,6 +378,10 @@ class Supervisor:
         self._mig_totals = {"migrations": 0, "migrated_pages": 0,
                             "host_bounce_bytes": 0,
                             "failed_migrations": 0}
+        # and for the adapter-pool counters: serving/adapter_pool/*
+        # (a rebuilt engine's AdapterStore restarts at zero; republishes
+        # by the factory then count on top of the carried totals)
+        self._adapter_totals = {"publishes": 0, "loads": 0, "spills": 0}
         self.failures: List[str] = []     # restart kinds, in order
         self.tripped = False
         self.breaker = CircuitBreaker(
@@ -417,6 +425,11 @@ class Supervisor:
             m.migrated_pages.inc(mt["migrated_pages"])
             m.host_bounce_bytes.inc(mt["host_bounce_bytes"])
             m.failed_migrations.inc(mt["failed_migrations"])
+        at = self._adapter_totals
+        if any(at.values()):
+            m.adapter_publishes.inc(at["publishes"])
+            m.adapter_loads.inc(at["loads"])
+            m.adapter_spills.inc(at["spills"])
         self._arm_watchdog()
         if self.tripped:
             self.engine.begin_drain()
@@ -446,10 +459,12 @@ class Supervisor:
     def submit(self, prompt_tokens: List[int], max_new_tokens: int,
                arrival_time: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0, sampling=None) -> int:
+               priority: int = 0, sampling=None,
+               tenant: Optional[str] = None) -> int:
         rid = self.engine.submit(
             prompt_tokens, max_new_tokens, arrival_time=arrival_time,
-            deadline_s=deadline_s, priority=priority, sampling=sampling)
+            deadline_s=deadline_s, priority=priority, sampling=sampling,
+            tenant=tenant)
         req = self.engine.result(rid)
         self.journal[rid] = JournalEntry(
             prompt_tokens=list(prompt_tokens),
@@ -460,7 +475,8 @@ class Supervisor:
             streamed=[],
             done=req.state in TERMINAL_STATES,   # shed at the gate
             request=req,
-            sampling=sampling)
+            sampling=sampling,
+            tenant=tenant)
         return rid
 
     def result(self, rid: int) -> Request:
@@ -600,6 +616,10 @@ class Supervisor:
         if mig:
             for key in self._mig_totals:
                 self._mig_totals[key] += int(mig.get(key, 0))
+        store = getattr(eng, "adapter_store", None)
+        if store is not None:
+            for key in self._adapter_totals:
+                self._adapter_totals[key] += int(getattr(store, key, 0))
         self.breaker.record(self.now())
         out_of_budget = self.tripped   # tripped BEFORE this failure
         self.tripped = self.tripped or self.breaker.tripped
@@ -635,7 +655,8 @@ class Supervisor:
                 arrival_time=e.arrival_time,
                 deadline=e.deadline, priority=e.priority,
                 rid=e.request.rid, sampling=e.sampling,
-                generated_logprobs=list(e.streamed_logps))
+                generated_logprobs=list(e.streamed_logps),
+                tenant=e.tenant)
             e.request = req
             self.replayed += 1
             m.replayed_requests.inc()

@@ -95,6 +95,9 @@ class Request:
     # means the engine-global GenerationConfig with a seed derived from
     # (engine seed, rid)
     sampling: Optional[object] = None
+    # multi-tenant serving: the tenant whose LoRA adapter (and quota /
+    # SLO accounting) this request runs under; None = base model
+    tenant: Optional[str] = None
     # chosen-token logprobs under the raw model distribution, parallel
     # to `generated` — the per-request logprob surface (rollout behavior
     # logps, eval/debugging)
@@ -155,6 +158,10 @@ class Scheduler:
         # requests hold slots (None = every slot usable). Purely an
         # admission cap — shapes stay static, running requests finish.
         self.max_active: Optional[int] = None
+        # called with the request on every slot release (finish, evict,
+        # cancel) — the engine pairs it with its per-slot-bind adapter
+        # acquire so AdapterStore refcounts track slot residency exactly
+        self.release_hook = None
 
     def _admission_headroom(self) -> Optional[int]:
         """Slots admission may still fill under ``max_active``; None
@@ -265,8 +272,10 @@ class Scheduler:
         hit = 0
         logits = None
         if self.prefix_cache is not None:
+            # namespaced by tenant: one tenant's cached KV never serves
+            # another's lookups (adapters change the KV contents)
             hit_pages, hit, logits = self.prefix_cache.lookup(
-                prefix, self.cfg.prefill_chunk)
+                prefix, self.cfg.prefill_chunk, namespace=req.tenant)
         total = min(geom.pages_for(n) + self.cfg.decode_reserve_pages,
                     geom.pages_per_slot)
         fresh = self.cache.allocator.alloc(total - len(hit_pages))
@@ -468,6 +477,8 @@ class Scheduler:
 
     def _release_resources(self, req: Request) -> None:
         if req.slot is not None:
+            if self.release_hook is not None:
+                self.release_hook(req)
             self.running.pop(req.slot, None)
             self.prefilling.pop(req.slot, None)
             self.cache.close_slot(req.slot)

@@ -62,6 +62,11 @@ from dla_tpu.serving.scheduler import (
     Scheduler,
     SchedulerConfig,
 )
+from dla_tpu.serving.tenancy import (
+    AdapterStore,
+    TenancyConfig,
+    TenantPolicy,
+)
 from dla_tpu.telemetry.anomaly import AnomalyConfig, AnomalyMonitor
 from dla_tpu.telemetry.exporter import MetricsHTTPServer, ReadinessProbe
 from dla_tpu.telemetry.flight_recorder import FlightRecorder
@@ -153,6 +158,14 @@ class ServingConfig:
     # tokens itself at the request's fold_in(seed, k) stream positions
     # and accepts a draft token only when it EQUALS the target's sample.
     speculative: Optional[Dict] = None
+    # multi-tenant LoRA serving (serving.tenancy TenancyConfig fields as
+    # a dict): a device-resident pool of per-tenant adapters gathered
+    # per-slot inside the ONE compiled decode step, plus per-tenant
+    # quotas/SLOs/metrics. Requires prefill_chunk > 0 (tenant KV is
+    # namespaced in the prefix cache at chunk granularity, and the
+    # monolithic prefill path has no per-slot adapter plumbing).
+    # None or {enabled: false} = single-tenant, PR-1 behavior.
+    tenancy: Optional[Dict] = None
     # disaggregation role of this engine within a fleet:
     #   "mixed"   — prefill + decode co-scheduled (the default; a
     #               standalone engine is always mixed)
@@ -211,6 +224,12 @@ class ServingEngine:
                 "role 'prefill' requires prefill_chunk > 0: a prefill "
                 "engine ships chunk-aligned prefixes, and only chunked "
                 "prefill lands page-aligned committed state to export")
+        ten_cfg = TenancyConfig.from_config(cfg.tenancy)
+        if ten_cfg is not None and not cfg.prefill_chunk:
+            raise ValueError(
+                "tenancy requires prefill_chunk > 0: tenant KV is "
+                "namespaced in the prefix cache at chunk granularity and "
+                "only the chunked prefill path carries per-slot adapters")
         spec = dict(cfg.speculative or {})
         if spec and not spec.get("enabled", True):
             spec = {}
@@ -290,6 +309,10 @@ class ServingEngine:
         self.samp_top_k = np.zeros((ns,), np.int32)
         self.samp_seed = np.zeros((ns,), np.uint32)
         self.gen_pos = np.zeros((ns,), np.int32)
+        # per-slot adapter pool row ([num_slots] host mirror like the
+        # sampling state): row 0 is the all-zeros base identity, so free
+        # slots and base-model requests gather an exact +0.0 delta
+        self.adapter_idx = np.zeros((ns,), np.int32)
         self._draining = False
         self._old_handlers: Optional[dict] = None
         # engine-step counter drives the profiling window (the serving
@@ -341,6 +364,22 @@ class ServingEngine:
                                         recorder=self.recorder)
         self._slo_every = max(1, int((cfg.slo or {}).get("check_every",
                                                          100)))
+        # multi-tenant plane: the adapter pool the jitted steps gather
+        # from, the per-tenant quota/SLO/metrics policy, and the
+        # delta-mirror marks for the pool counters. The scheduler's
+        # release hook pairs with _bind_adapter's acquire so adapter
+        # refcounts track slot residency exactly (finish, evict, cancel
+        # — every release path funnels through _release_resources).
+        self.adapter_store: Optional[AdapterStore] = None
+        self.tenants: Optional[TenantPolicy] = None
+        self._bound_tenants: Dict[int, str] = {}    # rid -> acquired
+        self._adapter_mirrored = {"publishes": 0, "loads": 0, "spills": 0}
+        if ten_cfg is not None:
+            self.adapter_store = AdapterStore(model, ten_cfg.adapter_pool)
+            self.tenants = TenantPolicy(
+                ten_cfg, registry=self.metrics.registry,
+                recorder=self.recorder, now=now)
+            self.scheduler.release_hook = self._release_adapter
         self.readiness = ReadinessProbe(
             threshold_s=float(cfg.readiness_timeout_s))
         self.metrics_server: Optional[MetricsHTTPServer] = None
@@ -472,7 +511,7 @@ class ServingEngine:
         return k_pages, v_pages, logits
 
     def _prefill_chunk_fn(self, params, k_pages, v_pages, btab, valid,
-                          pos, ids, start, nvalid):
+                          pos, ids, start, nvalid, adapters=None):
         """One FIXED-SHAPE prefill chunk for a single slot: gather the
         slot's pages (the already-computed prefix — cached hit pages and
         earlier chunks — with ``valid`` marking exactly the columns
@@ -500,7 +539,7 @@ class ServingEngine:
         positions = start + jnp.arange(c, dtype=jnp.int32)[None, :]
         last_index = jnp.maximum(nvalid - 1, 0)[None]
         logits, k_cols, v_cols = self.model.prefill_step_paged(
-            params, view, ids, positions, last_index)
+            params, view, ids, positions, last_index, adapters=adapters)
         # scatter the chunk's columns at their physical (page, offset);
         # pad columns (index >= nvalid) route to the trash page
         cols = start + jnp.arange(c, dtype=jnp.int32)
@@ -541,7 +580,7 @@ class ServingEngine:
 
     def _decode_fn(self, params, k_pages, v_pages, block_tables, valid,
                    pos, lengths, tokens, active, temps, top_ps, top_ks,
-                   seeds, gen_pos):
+                   seeds, gen_pos, adapters=None):
         """One static-shape decode step over every slot: gather each
         slot's pages into its [S] window, run the layout-agnostic decode
         step, sample PER-ROW (each slot's traced temperature/top_p/top_k/
@@ -564,7 +603,7 @@ class ServingEngine:
         view = {"k": k_view, "v": v_view, "valid": valid, "pos": pos,
                 "lengths": lengths}
         logits, k_cols, v_cols = self.model.decode_step_paged(
-            params, view, tokens)
+            params, view, tokens, adapters=adapters)
         new_tok, logp = sample_token_per_row(
             seeds, gen_pos, logits, temps, top_ps, top_ks)
         new_tok = jnp.where(active, new_tok, 0)
@@ -585,7 +624,7 @@ class ServingEngine:
 
     def _spec_draft_fn(self, draft_params, k_pages, v_pages, block_tables,
                        valid, pos, lengths, tokens, active, temps,
-                       top_ps, top_ks, seeds, gen_pos):
+                       top_ps, top_ks, seeds, gen_pos, adapters=None):
         """The speculative DRAFT phase: K sequential fixed-shape decode
         steps with the draft tree over the shared paged pool. Step i
         feeds the previous proposal (the pending token at i=0), writes
@@ -616,7 +655,7 @@ class ServingEngine:
             view = {"k": k_view, "v": v_view, "valid": valid_c,
                     "pos": pos_c, "lengths": lens_i}
             logits, k_cols, v_cols = self.model.decode_step_paged(
-                draft_params, view, cur)
+                draft_params, view, cur, adapters=adapters)
             nxt, _ = sample_token_per_row(
                 seeds, gen_pos + i, logits, temps, top_ps, top_ks)
             nxt = jnp.where(active, nxt, 0)
@@ -643,7 +682,8 @@ class ServingEngine:
 
     def _spec_verify_fn(self, params, k_pages, v_pages, block_tables,
                         valid, pos, lengths, tokens, proposals, active,
-                        temps, top_ps, top_ks, seeds, gen_pos):
+                        temps, top_ps, top_ks, seeds, gen_pos,
+                        adapters=None):
         """The speculative VERIFY phase: one multi-token target forward
         over the block [pending, d_1 .. d_K] at columns
         ``lengths .. lengths + K``. ``valid`` is the COMMITTED-ONLY host
@@ -678,7 +718,7 @@ class ServingEngine:
                 "lengths": lengths}
         block = jnp.concatenate([tokens[:, None], proposals], axis=1)
         logits, k_cols, v_cols = self.model.decode_block_paged(
-            params, view, block)
+            params, view, block, adapters=adapters)
         toks, logps = sample_token_block(
             seeds, gen_pos, logits, temps, top_ps, top_ks)
         toks = jnp.where(active[:, None], toks, 0)
@@ -708,7 +748,8 @@ class ServingEngine:
                arrival_time: Optional[float] = None,
                deadline_s: Optional[float] = None,
                priority: int = 0,
-               sampling: Optional[SamplingParams] = None) -> int:
+               sampling: Optional[SamplingParams] = None,
+               tenant: Optional[str] = None) -> int:
         """Queue a request; returns its id. Guards that the request can
         EVER fit: its worst-case page demand (re-admission prefix padded
         to a bucket, plus the decode reserve) within pool capacity.
@@ -730,7 +771,14 @@ class ServingEngine:
         already terminal: SHED at the gate (bucket empty, or it is the
         worst of a full queue) — or it may displace a lower-priority
         queued request, which is shed instead. Check
-        ``result(rid).state``."""
+        ``result(rid).state``.
+
+        ``tenant`` (requires cfg.tenancy) runs the request under that
+        tenant's published LoRA adapter, quota bucket, SLO accounting
+        and prefix-cache namespace; None serves the base weights. A
+        tenant whose own token bucket is empty has THIS request shed
+        (``at="tenant_quota"``) before the shared gate is consulted —
+        per-tenant isolation, other tenants unaffected."""
         if self._draining:
             raise RuntimeError(
                 "engine is draining (SIGTERM received): admission closed")
@@ -738,13 +786,16 @@ class ServingEngine:
             raise RuntimeError(
                 "engine role is 'decode': admission is handoff-only "
                 "(import_request / restore)")
+        if tenant is not None:
+            self._check_tenant(tenant)
         geom = self.cache.geom
         req = Request(prompt_tokens=list(prompt_tokens),
                       max_new_tokens=int(max_new_tokens),
                       arrival_time=(self.now() if arrival_time is None
                                     else arrival_time),
                       priority=int(priority),
-                      sampling=sampling)
+                      sampling=sampling,
+                      tenant=tenant)
         if deadline_s is not None:
             req.deadline = req.arrival_time + float(deadline_s)
         worst = len(req.prompt_tokens) + req.max_new_tokens
@@ -769,6 +820,13 @@ class ServingEngine:
                 "request", "request", req.rid, t=req.arrival_time,
                 prompt_tokens=len(req.prompt_tokens),
                 max_new_tokens=req.max_new_tokens)
+        if tenant is not None and self.tenants is not None:
+            self.tenants.on_submit(tenant)
+            if not self.tenants.gate(tenant, req.arrival_time):
+                # the tenant exhausted ITS OWN bucket: shed this arrival
+                # and nothing else — the shared gate below never sees it
+                self._shed(req, at="tenant_quota")
+                return req.rid
         if self.admission is not None:
             _, victims = self.admission.on_submit(
                 self.scheduler, req, req.arrival_time)
@@ -807,14 +865,20 @@ class ServingEngine:
         discovered as a silent retrace). With ``donate=True`` the OLD
         tree's device buffers are freed eagerly (the rollout refitter's
         donation contract) — only safe when the caller owns the old tree
-        exclusively; never donate params shared with a trainer."""
+        exclusively; never donate params shared with a trainer.
+
+        For an ADAPTER-ONLY change (one tenant's LoRA factors moved, the
+        base weights didn't) use :meth:`publish_adapter` instead: it
+        swaps just that tenant's pool row, never retransfers the base
+        tree, and leaves every other tenant untouched."""
         old = self.params
         old_def = jax.tree_util.tree_structure(old)
         new_def = jax.tree_util.tree_structure(new_params)
         if old_def != new_def:
             raise ValueError(
                 "refit params tree structure mismatch: "
-                f"{new_def} vs engine {old_def}")
+                f"{new_def} vs engine {old_def} (an adapter-only tree "
+                "belongs to publish_adapter, not a full-tree refit)")
         for o, n_ in zip(jax.tree_util.tree_leaves(old),
                          jax.tree_util.tree_leaves(new_params)):
             if o.shape != n_.shape or o.dtype != n_.dtype:
@@ -839,12 +903,42 @@ class ServingEngine:
                     except Exception:
                         pass  # already deleted / externally owned
 
+    def publish_adapter(self, tenant: str, tree, *,
+                        alpha: Optional[float] = None,
+                        rank: Optional[int] = None) -> None:
+        """Install (or hot-swap) one tenant's LoRA adapter — the
+        adapter-only sibling of :meth:`publish_params`. The tree is the
+        adapter pytree ``init_lora`` produces for the pool's targets
+        (treedef-validated the same way a refit is); a resident tenant's
+        pool row is rewritten in place with identical shapes and dtypes,
+        so the decode jit fingerprint — and the compile counters the
+        compile-once tests pin — never move. Requests already decoding
+        under this tenant pick the new factors up on their next step."""
+        if self.adapter_store is None:
+            raise RuntimeError(
+                "publish_adapter requires cfg.tenancy (the engine was "
+                "built without an adapter pool)")
+        self.adapter_store.publish(tenant, tree, alpha=alpha, rank=rank)
+        if self.tenants is not None:
+            self.tenants.ensure(tenant)
+
+    def _check_tenant(self, tenant: str) -> None:
+        if self.adapter_store is None:
+            raise ValueError(
+                "tenant-scoped request requires cfg.tenancy")
+        if not (self.adapter_store.has(tenant)
+                or self.tenants.configured(tenant)):
+            raise ValueError(
+                f"unknown tenant {tenant!r}: publish_adapter first, or "
+                "list it under tenancy.quotas for base-weight serving")
+
     def restore(self, prompt_tokens: List[int], max_new_tokens: int, *,
                 generated: List[int], arrival_time: float,
                 deadline: Optional[float] = None, priority: int = 0,
                 rid: Optional[int] = None,
                 sampling: Optional[SamplingParams] = None,
-                generated_logprobs: Optional[List[float]] = None
+                generated_logprobs: Optional[List[float]] = None,
+                tenant: Optional[str] = None
                 ) -> Request:
         """Re-enter a journaled in-flight request after a supervisor
         rebuild: the eviction deterministic-recompute contract taken
@@ -867,11 +961,17 @@ class ServingEngine:
         request adopts those pages straight into a decode slot and
         resumes with ZERO prefill; otherwise it queues for the normal
         re-prefill."""
+        if tenant is not None:
+            # a rebuilt engine must have the adapter republished by its
+            # factory before replay reaches it — fail loudly, not with
+            # silently-base-weight decoding
+            self._check_tenant(tenant)
         req = Request(prompt_tokens=list(prompt_tokens),
                       max_new_tokens=int(max_new_tokens),
                       arrival_time=arrival_time,
                       priority=int(priority),
-                      sampling=sampling)
+                      sampling=sampling,
+                      tenant=tenant)
         if rid is not None:
             req.rid = rid
         req.deadline = deadline
@@ -915,7 +1015,7 @@ class ServingEngine:
         if self.scheduler._admission_headroom() == 0:
             return False
         pages = self.prefix_cache.acquire_pages(
-            req.prefix_tokens[:committed])
+            req.prefix_tokens[:committed], namespace=req.tenant)
         if pages is None:
             return False
         n_extra = min(self.cfg.decode_reserve_pages,
@@ -939,6 +1039,7 @@ class ServingEngine:
         slot = self.scheduler.adopt(req, pages)
         self.cache.open_slot_prefill(slot, req.pages, committed)
         self.cache.begin_decode(slot, committed, req.generated[-1])
+        self._bind_adapter(req)
         self._bind_slot_sampling(req)
 
     # ------------------------------------------------------- KV migration
@@ -1002,7 +1103,8 @@ class ServingEngine:
             v_payload=v_payload,
             admitted_time=req.admitted_time,
             first_token_time=req.first_token_time,
-            last_token_time=req.last_token_time)
+            last_token_time=req.last_token_time,
+            tenant=req.tenant)
 
     def _export_refuse(self, msg: str):
         self._mig_stats["failed_migrations"] += 1
@@ -1056,6 +1158,14 @@ class ServingEngine:
                 or self.scheduler._admission_headroom() == 0:
             return self._import_refuse(
                 f"ticket {ticket.rid}: no free decode slot")
+        if ticket.tenant is not None:
+            try:
+                self._check_tenant(ticket.tenant)
+            except ValueError as e:
+                # counted like any other refused install: the source
+                # keeps the request, nothing decodes under wrong weights
+                return self._import_refuse(
+                    f"ticket {ticket.rid}: {e}")
         n_alloc = min(needed + self.cfg.decode_reserve_pages,
                       geom.pages_per_slot)
         pages = self.cache.allocator.alloc(n_alloc)
@@ -1073,7 +1183,8 @@ class ServingEngine:
                       max_new_tokens=int(ticket.max_new_tokens),
                       arrival_time=ticket.arrival_time,
                       priority=int(ticket.priority),
-                      sampling=ticket.sampling)
+                      sampling=ticket.sampling,
+                      tenant=ticket.tenant)
         req.rid = ticket.rid
         req.deadline = ticket.deadline
         req.generated = list(ticket.generated)
@@ -1087,7 +1198,8 @@ class ServingEngine:
             # (and future migrations back) alias them; no logits entry —
             # the request resumes decode, there are no prefill logits
             self.prefix_cache.register(
-                req.prefix_tokens[:committed], pages)
+                req.prefix_tokens[:committed], pages,
+                namespace=req.tenant)
         self._results[req.rid] = req
         self._mig_stats["migrations"] += 1
         self._mig_stats["migrated_pages"] += needed
@@ -1188,6 +1300,7 @@ class ServingEngine:
         self._mirror_cache_counters()
         self._mirror_spec_counters()
         self._mirror_migration_counters()
+        self._mirror_adapter_counters()
         m = self.metrics
         m.queue_depth.set(self.scheduler.queue_depth)
         m.active_requests.set(self.scheduler.active_count)
@@ -1195,6 +1308,13 @@ class ServingEngine:
         if self.slo is not None \
                 and self.engine_steps % self._slo_every == 0:
             self.slo.observe(m.snapshot(), step=self.engine_steps)
+        if self.tenants is not None \
+                and self.engine_steps % self._slo_every == 0:
+            # per-tenant burn over each tenant's OWN panel; any tenant
+            # past the (opt-in) burn threshold sheds ONLY its own queue
+            self.tenants.observe(step=self.engine_steps)
+            for victim in self.tenants.shed_pass(self.scheduler):
+                self._shed(victim, at="tenant_slo")
         return emitted
 
     def run_until_drained(self, max_steps: int = 100000,
@@ -1345,8 +1465,11 @@ class ServingEngine:
         state to unwind."""
         self.scheduler.cancel(req, "shed", RequestState.SHED)
         self.metrics.requests_shed.inc()
+        if self.tenants is not None and req.tenant is not None:
+            self.tenants.on_shed(req.tenant)
         self.recorder.record("request_shed", step=self.engine_steps,
-                             rid=req.rid, priority=req.priority, at=at)
+                             rid=req.rid, priority=req.priority, at=at,
+                             tenant=req.tenant)
         if self.tracer.enabled:
             self.tracer.async_end("request", "request", req.rid,
                                   status="shed", tokens=0)
@@ -1440,6 +1563,61 @@ class ServingEngine:
         self.samp_top_k[s] = sp.top_k
         self.samp_seed[s] = np.uint32(sp.seed & 0xFFFFFFFF)
 
+    def _bind_adapter(self, req: Request) -> None:
+        """Pin the request's tenant adapter for its freshly assigned
+        slot and mirror the pool row into ``adapter_idx`` (row 0 — the
+        zero identity — for base requests, and always rewritten so a
+        reused slot never inherits the previous tenant's adapter).
+        Called exactly once per slot assignment, BEFORE the slot's first
+        dispatch; the paired release rides the scheduler's
+        ``release_hook``, so every release path (finish, evict, cancel,
+        shed, drain) unpins it. Load-on-admission lives here: acquire
+        reloads a spilled adapter from its host copy."""
+        if self.adapter_store is None or req.slot is None:
+            return
+        idx = 0
+        if req.tenant is not None and self.adapter_store.has(req.tenant):
+            idx = self.adapter_store.acquire(req.tenant)
+            self._bound_tenants[req.rid] = req.tenant
+        self.adapter_idx[req.slot] = idx
+
+    def _release_adapter(self, req: Request) -> None:
+        """Scheduler release hook: unpin whatever _bind_adapter acquired
+        for this request (a no-op for base requests — the _bound_tenants
+        record keeps acquire/release exactly paired even if an adapter
+        appears for the tenant mid-flight)."""
+        tenant = self._bound_tenants.pop(req.rid, None)
+        if tenant is not None:
+            self.adapter_store.release(tenant)
+
+    def _adapters_args(self, rows=None):
+        """The gathered-adapter argument for one jitted dispatch: the
+        per-slot pool rows (every slot, or ``rows`` for a single-slot
+        prefill chunk) plus the stacked A/B pools. None when tenancy is
+        off — an empty pytree, so the dispatch signature and jit
+        fingerprint are byte-identical to an adapter-free build."""
+        if self.adapter_store is None:
+            return None
+        idx = (self.adapter_idx if rows is None
+               else self.adapter_idx[rows])
+        return {"idx": self._dev(idx), **self.adapter_store.pools}
+
+    def _mirror_adapter_counters(self) -> None:
+        """Delta-mirror the AdapterStore's plain-int counters into the
+        registry (the prefix-cache/speculative mirror contract: a fresh
+        ServingMetrics swap sees only post-swap activity; the Supervisor
+        re-seeds cumulative totals into rebuilt engines)."""
+        st = self.adapter_store
+        if st is None:
+            return
+        m, seen = self.metrics, self._adapter_mirrored
+        m.adapter_publishes.inc(st.publishes - seen["publishes"])
+        m.adapter_loads.inc(st.loads - seen["loads"])
+        m.adapter_spills.inc(st.spills - seen["spills"])
+        seen.update(publishes=st.publishes, loads=st.loads,
+                    spills=st.spills)
+        m.adapter_resident.set(st.resident_count)
+
     def _admit(self, emitted: List[Tuple[int, int]]) -> None:
         """Drain as many bucketed prefill batches as slots/pages allow."""
         while True:
@@ -1502,6 +1680,10 @@ class ServingEngine:
             req = self.scheduler.admit_chunk_prefill()
             if req is None:
                 return
+            # adapter rides every chunk of the prefill, so it binds at
+            # slot assignment — before the first chunk dispatch, not at
+            # activation (this is also where a cold adapter loads)
+            self._bind_adapter(req)
             t = self.now()
             if req.admitted_time is None:
                 req.admitted_time = t
@@ -1564,7 +1746,8 @@ class ServingEngine:
                 self._dev(c.pos[slot:slot + 1]),
                 jnp.asarray(ids),
                 jnp.asarray(start, jnp.int32),
-                jnp.asarray(nvalid, jnp.int32))
+                jnp.asarray(nvalid, jnp.int32),
+                self._adapters_args(slice(slot, slot + 1)))
         self.metrics.prefill_chunks.inc()
         c.mark_computed(slot, start, nvalid)
         req.prefill_pos = start + nvalid
@@ -1581,7 +1764,8 @@ class ServingEngine:
             # first-writer-wins: later identical prompts alias these
             # pages; the stored logits make the NEXT identical prompt a
             # zero-prefill full hit
-            self.prefix_cache.register(prefix, req.pages, logits_np[0])
+            self.prefix_cache.register(prefix, req.pages, logits_np[0],
+                                       namespace=req.tenant)
         self.scheduler.activate(req)
         self._bind_slot_sampling(req)
         self._emit(req, tok, t_done, emitted, first_of_prefill=True,
@@ -1653,7 +1837,7 @@ class ServingEngine:
                 self._dev(c.tokens), jnp.asarray(active),
                 self._dev(self.samp_temp), self._dev(self.samp_top_p),
                 self._dev(self.samp_top_k), self._dev(self.samp_seed),
-                self._dev(self.gen_pos))
+                self._dev(self.gen_pos), self._adapters_args())
             # dla: disable=host-sync-in-hot-loop -- the designed single D2H per decode step (execution-model invariant)
             packed_np = np.asarray(packed)
         toks_np = packed_np[0].view(np.int32)
@@ -1717,14 +1901,18 @@ class ServingEngine:
             top_ks = self._dev(self.samp_top_k)
             seeds = self._dev(self.samp_seed)
             gpos = self._dev(self.gen_pos)
+            # draft and verify share one adapter view: the draft
+            # proposes under the SAME per-slot deltas the target
+            # verifies with, so per-tenant acceptance stays high
+            adapters = self._adapters_args()
             c.k_pages, c.v_pages, proposals = self._spec_draft(
                 self.draft_params, c.k_pages, c.v_pages, btab, valid,
                 pos, lengths, tokens, active_d, temps, top_ps, top_ks,
-                seeds, gpos)
+                seeds, gpos, adapters)
             c.k_pages, c.v_pages, packed = self._spec_verify(
                 self.params, c.k_pages, c.v_pages, btab, valid, pos,
                 lengths, tokens, proposals, active_d, temps, top_ps,
-                top_ks, seeds, gpos)
+                top_ks, seeds, gpos, adapters)
             # dla: disable=host-sync-in-hot-loop -- the designed single D2H per speculative round (proposals never leave the device)
             packed_np = np.asarray(packed)
         toks_np = packed_np[0].view(np.int32)         # [B, K+1]
@@ -1807,10 +1995,17 @@ class ServingEngine:
         req.generated_logprobs.append(float(logp))  # dla: disable=host-sync-in-hot-loop -- float coercion of an already-host scalar
         emitted.append((req.rid, tok))
         self.metrics.tokens_generated.inc()
+        # per-tenant panel: same samples as the engine-wide instruments,
+        # attributed — the surface the tenant SLO watches burn against
+        ten = (self.tenants if req.tenant is not None else None)
+        if ten is not None:
+            ten.on_token(req.tenant)
         traced = self.tracer.enabled
         if req.first_token_time is None:
             req.first_token_time = t
             self.metrics.ttft_ms.record((t - req.arrival_time) * 1000.0)
+            if ten is not None:
+                ten.on_ttft(req.tenant, (t - req.arrival_time) * 1000.0)
             if traced:
                 self.tracer.async_instant(
                     "request", "first_token", req.rid, t=t,
@@ -1820,6 +2015,8 @@ class ServingEngine:
             # (a re-prefill after eviction restarts the clock)
             itl_ms = (t - req.last_token_time) * 1000.0
             self.metrics.itl_ms.record(itl_ms)
+            if ten is not None:
+                ten.on_itl(req.tenant, itl_ms)
             if self.anomaly is not None:
                 self.anomaly.observe("itl_ms", itl_ms, self.engine_steps)
             if traced:
@@ -1838,6 +2035,8 @@ class ServingEngine:
             self.scheduler.finish(req, "length")
             self.metrics.requests_finished.inc()
             status = "length"
+        if ten is not None and status is not None:
+            ten.on_finish(req.tenant)
         if traced and status is not None:
             self.tracer.async_end("request", "request", req.rid, t=t,
                                   status=status,

@@ -1,0 +1,621 @@
+"""Multi-tenant LoRA serving: the adapter registry + tenant policy plane.
+
+One base model serves N fine-tuned variants through ONE jitted decode
+step — the train->serve closure for the framework's own outputs
+(``init_lora`` adapters from SFT/DPO/PPO). The static-shape discipline
+is the design constraint throughout: heterogeneous adapters must batch
+into the engine's single decode compile with zero retraces.
+
+**AdapterStore** — a registry of LoRA adapter trees keyed by
+``tenant_id`` over a fixed-capacity device-resident pool of
+``[n_adapters, L, ...]`` stacked A/B matrices. Every adapter is
+rank-padded (with zeros — mathematically exact) to the configured
+``max_rank`` and its B factor pre-scaled by ``alpha/r`` at publish, so
+the pool's shapes and the in-graph delta (``x @ A @ B``) are static
+across every tenant mix. Pool row 0 is the all-zeros base identity:
+requests without a tenant gather it and add an exact ``+0.0``.
+``publish_adapter`` is a hot-swap following the ``publish_params``
+treedef-validation idiom (same shapes in -> same jit fingerprint, no
+recompile); the host-side fp32 copy is always the source of truth, so
+cold adapters LRU-spill to host-only and reload on admission
+bit-identically.
+
+**TenantPolicy** — per-tenant token buckets gating ``submit`` ahead of
+the global :class:`~dla_tpu.serving.resilience.AdmissionController`
+(a noisy tenant exhausting its bucket sheds only its own arrivals),
+per-tenant metric panels on the engine registry
+(``serving/tenant/<id>/...`` — a dynamic catalog prefix), and
+per-tenant :class:`~dla_tpu.telemetry.slo.SLOWatch` instances whose
+gauges land under ``serving/tenant/<id>/slo/``. Per-tenant SLO burn is
+evaluated against the tenant's OWN latency panel, never the engine-wide
+snapshot, so one tenant's burn cannot shed another's work.
+
+The engine-facing counters (``publishes``/``loads``/``spills``) are
+plain host ints delta-mirrored into the registry by the engine each
+step (the speculative-counter idiom), so totals survive supervisor
+rebuilds.
+
+Declared in config as the serving ``tenancy:`` block
+(``TenancySchema``/``AdapterPoolSchema`` in training/config.py)::
+
+    tenancy:
+      adapter_pool:
+        max_adapters: 8
+        max_rank: 8
+        targets: [wq, wv]      # default: the model's lora_targets
+      quotas:
+        acme: {rate: 50.0, burst: 8}
+      slo:
+        objectives:
+          - name: ttft
+            metric: ttft_ms_p95      # relative to the tenant panel
+            objective: 500.0
+        shed_burn_threshold: 0.0     # 0 = quota-gate isolation only
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dla_tpu.serving.resilience import TokenBucket
+from dla_tpu.telemetry.slo import SLOWatch
+
+__all__ = [
+    "AdapterPoolConfig",
+    "TenancyConfig",
+    "AdapterStore",
+    "TenantPolicy",
+    "export_adapter_tree",
+    "load_adapter_tree",
+]
+
+#: tenant ids become metric-name path segments (serving/tenant/<id>/...)
+#: and filesystem-safe manifest fields — keep them to a sane charset
+_TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
+ADAPTER_FORMAT = "adapter_store/v1"
+ADAPTER_MANIFEST = "manifest.json"
+ADAPTER_WEIGHTS = "adapter.npz"
+
+
+def _check_tenant_id(tenant: str) -> str:
+    if not isinstance(tenant, str) or not _TENANT_RE.match(tenant):
+        raise ValueError(
+            f"invalid tenant id {tenant!r}: must match "
+            f"{_TENANT_RE.pattern} (it names metric series and "
+            "manifest entries)")
+    return tenant
+
+
+# ------------------------------------------------------------------- config
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterPoolConfig:
+    """Device-resident adapter pool geometry (the ``adapter_pool:``
+    sub-block; ``AdapterPoolSchema`` in training/config.py mirrors it).
+    The pool allocates ``max_adapters + 1`` rows — row 0 is reserved for
+    the all-zeros base identity."""
+    max_adapters: int = 8          # concurrent device-resident tenants
+    max_rank: int = 8              # adapters rank-pad up to this
+    targets: Optional[Tuple[str, ...]] = None  # None -> model lora_targets
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict]) -> "AdapterPoolConfig":
+        cfg = dict(cfg or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(cfg) - known)
+        if unknown:
+            raise ValueError(f"unknown adapter_pool config keys: {unknown}")
+        if "targets" in cfg and cfg["targets"] is not None:
+            cfg["targets"] = tuple(cfg["targets"])
+        out = cls(**cfg)
+        if out.max_adapters < 1:
+            raise ValueError(
+                f"adapter_pool.max_adapters must be >= 1, got "
+                f"{out.max_adapters}")
+        if out.max_rank < 1:
+            raise ValueError(
+                f"adapter_pool.max_rank must be >= 1, got {out.max_rank}")
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class TenancyConfig:
+    """The serving ``tenancy:`` config block (``TenancySchema`` in
+    training/config.py mirrors it)."""
+    adapter_pool: AdapterPoolConfig = AdapterPoolConfig()
+    quotas: Optional[Dict[str, Dict]] = None   # tenant -> {rate, burst}
+    slo: Optional[Dict] = None   # per-tenant objectives (panel-relative)
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict]) -> Optional["TenancyConfig"]:
+        """Build from a config dict; None (or ``enabled: false``)
+        disables multi-tenancy entirely."""
+        if not cfg:
+            return None
+        cfg = dict(cfg)
+        if not cfg.pop("enabled", True):
+            return None
+        pool = AdapterPoolConfig.from_config(cfg.pop("adapter_pool", None))
+        known = {"quotas", "slo"}
+        unknown = sorted(set(cfg) - known)
+        if unknown:
+            raise ValueError(f"unknown tenancy config keys: {unknown}")
+        quotas = cfg.get("quotas")
+        if quotas:
+            for tenant, q in quotas.items():
+                _check_tenant_id(tenant)
+                bad = sorted(set(q or {}) - {"rate", "burst"})
+                if bad:
+                    raise ValueError(
+                        f"unknown quota keys for tenant {tenant!r}: {bad}")
+        return cls(adapter_pool=pool, quotas=quotas, slo=cfg.get("slo"))
+
+
+# ------------------------------------------------------------ adapter store
+
+
+class AdapterStore:
+    """Fixed-capacity device pool of stacked per-tenant LoRA factors.
+
+    ``pools`` maps ``f"{target}_lora_a"`` -> ``[N, L, din, max_rank]``
+    and ``f"{target}_lora_b"`` -> ``[N, L, max_rank, dout]`` device
+    arrays (activation-param dtype). The jitted steps gather per-slot
+    rows by ``adapter_idx`` (``Transformer.slot_lora_xs``); publishes
+    and residency loads are ``.at[idx].set`` writes — same shapes and
+    dtypes, so the decode jit fingerprint never changes.
+
+    Residency protocol: ``acquire(tenant)`` on slot bind (refcounted,
+    loading the adapter from its host copy if it was spilled),
+    ``release(tenant)`` when the scheduler releases the slot. Only
+    refcount-0 residents are LRU-spillable; a pool full of pinned
+    adapters is a capacity config error and raises.
+    """
+
+    def __init__(self, model, cfg: AdapterPoolConfig):
+        self.model = model
+        self.cfg = cfg
+        targets = (tuple(cfg.targets) if cfg.targets
+                   else tuple(model.cfg.lora_targets))
+        if not targets:
+            raise ValueError(
+                "adapter pool has no targets: set "
+                "tenancy.adapter_pool.targets or the model's lora_targets")
+        unknown = [t for t in targets if t not in model._LORA_SHAPES]
+        if unknown:
+            raise ValueError(
+                f"unknown adapter targets {unknown}: known targets are "
+                f"{sorted(model._LORA_SHAPES)}")
+        self.targets = targets
+        self.n_rows = int(cfg.max_adapters) + 1   # row 0 = base identity
+        self.max_rank = int(cfg.max_rank)
+        dims = model._lora_dims()
+        L = model.cfg.num_layers
+        self._num_layers = L
+        self._shapes: Dict[str, Tuple[int, ...]] = {}
+        self.pools: Dict[str, jnp.ndarray] = {}
+        for t in targets:
+            din, dout = (dims[k] for k in model._LORA_SHAPES[t])
+            self._shapes[t] = (din, dout)
+            self.pools[f"{t}_lora_a"] = jnp.zeros(
+                (self.n_rows, L, din, self.max_rank), model.pdtype)
+            self.pools[f"{t}_lora_b"] = jnp.zeros(
+                (self.n_rows, L, self.max_rank, dout), model.pdtype)
+        # host fp32 padded/pre-scaled copies: ALWAYS the source of truth
+        # (spill = drop device residency; reload casts fp32 -> pool
+        # dtype exactly as the original publish did, so a reloaded
+        # adapter decodes bit-identically)
+        self._host: Dict[str, Dict[str, np.ndarray]] = {}
+        self._resident: Dict[str, int] = {}
+        self._refs: Dict[str, int] = {}
+        self._free: List[int] = list(range(1, self.n_rows))
+        self._lru: List[str] = []     # refcount-0 residents, oldest first
+        # plain ints, delta-mirrored by the engine (speculative-counter
+        # idiom) so serving/adapter_pool/* stay monotone across rebuilds
+        self.publishes = 0
+        self.loads = 0
+        self.spills = 0
+
+    # ------------------------------------------------------------ publish
+
+    def _expected_treedef(self):
+        layers = {}
+        for t in self.targets:
+            layers[f"{t}_lora_a"] = 0
+            layers[f"{t}_lora_b"] = 0
+        return jax.tree_util.tree_structure({"layers": layers})
+
+    def publish(self, tenant: str, tree, *, alpha: Optional[float] = None,
+                rank: Optional[int] = None) -> None:
+        """Install (or hot-swap) one tenant's adapter tree.
+
+        The tree must be the adapter-only pytree ``init_lora`` produces
+        for this pool's targets — treedef-validated like
+        ``ServingEngine.publish_params`` validates a full refit, and
+        for the same reason: a mismatch would silently retrace. The B
+        factor is pre-scaled by ``alpha / r`` here (r inferred from the
+        A leaves unless given), so the jitted delta is a bare
+        ``x @ A @ B``. A resident tenant's pool row is rewritten in
+        place — a recompile-free, donation-safe hot swap (the pool
+        update is functional; nothing aliases the caller's leaves)."""
+        _check_tenant_id(tenant)
+        tree = self._canonical(tree)
+        exp_def = self._expected_treedef()
+        got_def = jax.tree_util.tree_structure(
+            jax.tree_util.tree_map(lambda _: 0, tree))
+        if got_def != exp_def:
+            raise ValueError(
+                f"publish_adapter tree structure mismatch: {got_def} vs "
+                f"expected {exp_def} (adapter-only tree over targets "
+                f"{list(self.targets)}; a full-weight republish belongs "
+                "to ServingEngine.publish_params)")
+        L = self._num_layers
+        r_seen: Optional[int] = None
+        for t in self.targets:
+            din, dout = self._shapes[t]
+            a = tree["layers"][f"{t}_lora_a"]
+            b = tree["layers"][f"{t}_lora_b"]
+            if a.ndim != 3 or a.shape[0] != L or a.shape[1] != din:
+                raise ValueError(
+                    f"adapter leaf {t}_lora_a shape {tuple(a.shape)}: "
+                    f"expected [L={L}, {din}, r]")
+            r = int(a.shape[2])
+            if b.ndim != 3 or tuple(b.shape) != (L, r, dout):
+                raise ValueError(
+                    f"adapter leaf {t}_lora_b shape {tuple(b.shape)}: "
+                    f"expected [L={L}, r={r}, {dout}]")
+            if r_seen is None:
+                r_seen = r
+            elif r != r_seen:
+                raise ValueError(
+                    f"adapter rank mismatch across targets: {t} has r={r}"
+                    f", earlier targets r={r_seen}")
+        if rank is not None and int(rank) != r_seen:
+            raise ValueError(
+                f"declared rank {rank} != adapter leaves' rank {r_seen}")
+        if r_seen > self.max_rank:
+            raise ValueError(
+                f"adapter rank {r_seen} exceeds the pool's max_rank "
+                f"{self.max_rank} (rank-padding only goes up): raise "
+                "tenancy.adapter_pool.max_rank")
+        mcfg = self.model.cfg
+        eff_alpha = float(alpha) if alpha is not None else float(
+            mcfg.lora_alpha)
+        scale = eff_alpha / r_seen
+        host: Dict[str, np.ndarray] = {}
+        for t in self.targets:
+            din, dout = self._shapes[t]
+            a = np.asarray(jax.device_get(
+                tree["layers"][f"{t}_lora_a"].astype(jnp.float32)))
+            b = np.asarray(jax.device_get(
+                tree["layers"][f"{t}_lora_b"].astype(jnp.float32)))
+            pad_r = self.max_rank - r_seen
+            host[f"{t}_lora_a"] = np.pad(
+                a, ((0, 0), (0, 0), (0, pad_r)))
+            host[f"{t}_lora_b"] = np.pad(
+                b * scale, ((0, 0), (0, pad_r), (0, 0)))
+        self._host[tenant] = host
+        self.publishes += 1
+        idx = self._resident.get(tenant)
+        if idx is not None:
+            self._write(idx, host)   # hot swap in place, no recompile
+
+    def _canonical(self, tree):
+        """Accept interleaved-storage adapter leaves ([V, S, c, ...],
+        what ``init_lora`` emits under pipeline configs) by flattening
+        the layer stack back to canonical [L, ...]."""
+        L = self._num_layers
+
+        def go(x):
+            if getattr(x, "ndim", 0) == 5 and x.shape[0] != L:
+                return x.reshape((L,) + x.shape[3:])
+            return x
+        return jax.tree_util.tree_map(go, tree)
+
+    def _write(self, idx: int, host: Dict[str, np.ndarray]) -> None:
+        for key, arr in host.items():
+            pool = self.pools[key]
+            self.pools[key] = pool.at[idx].set(
+                jnp.asarray(arr, pool.dtype))
+
+    # ---------------------------------------------------------- residency
+
+    def has(self, tenant: str) -> bool:
+        return tenant in self._host
+
+    def resident(self, tenant: str) -> bool:
+        return tenant in self._resident
+
+    @property
+    def tenants(self) -> List[str]:
+        return sorted(self._host)
+
+    @property
+    def resident_count(self) -> int:
+        return len(self._resident)
+
+    def ensure_resident(self, tenant: str) -> int:
+        """The tenant's pool row, loading its host copy into a free (or
+        LRU-spilled) row first when it is not resident."""
+        if tenant not in self._host:
+            raise KeyError(
+                f"unknown tenant {tenant!r}: publish_adapter first "
+                f"(known: {self.tenants})")
+        idx = self._resident.get(tenant)
+        if idx is not None:
+            return idx
+        if self._free:
+            idx = self._free.pop(0)
+        elif self._lru:
+            cold = self._lru.pop(0)
+            idx = self._resident.pop(cold)
+            self.spills += 1   # host copy stays authoritative
+        else:
+            raise RuntimeError(
+                "adapter pool exhausted: every resident adapter is "
+                "pinned by a bound decode slot — raise "
+                "tenancy.adapter_pool.max_adapters above the engine's "
+                "concurrent-tenant working set")
+        self._write(idx, self._host[tenant])
+        self._resident[tenant] = idx
+        self.loads += 1
+        return idx
+
+    def acquire(self, tenant: str) -> int:
+        """Pin the tenant's adapter for one bound slot; returns its pool
+        row for the slot's ``adapter_idx`` mirror."""
+        idx = self.ensure_resident(tenant)
+        self._refs[tenant] = self._refs.get(tenant, 0) + 1
+        if tenant in self._lru:
+            self._lru.remove(tenant)
+        return idx
+
+    def release(self, tenant: str) -> None:
+        """Drop one slot's pin; refcount-0 residents become LRU-spill
+        candidates (they stay resident — and warm — until capacity
+        actually needs the row)."""
+        n = self._refs.get(tenant, 0) - 1
+        if n < 0:
+            raise RuntimeError(
+                f"adapter release underflow for tenant {tenant!r}")
+        self._refs[tenant] = n
+        if n == 0 and tenant in self._resident \
+                and tenant not in self._lru:
+            self._lru.append(tenant)
+
+
+# ------------------------------------------------------------ tenant policy
+
+
+class _TenantPanel:
+    """One tenant's instrument panel on the engine registry. Series ride
+    the ``serving/tenant/`` dynamic catalog prefix; the panel also
+    renders its own snapshot dict because per-tenant SLO watches consume
+    tenant-local values, never the engine-wide snapshot."""
+
+    def __init__(self, registry, tenant: str):
+        self.prefix = p = f"serving/tenant/{tenant}/"
+        self.submitted = registry.counter(p + "requests_submitted")
+        self.finished = registry.counter(p + "requests_finished")
+        self.shed = registry.counter(p + "requests_shed")
+        self.tokens = registry.counter(p + "tokens_generated")
+        self.ttft_ms = registry.histogram(p + "ttft_ms")
+        self.itl_ms = registry.histogram(p + "itl_ms")
+
+    def snapshot(self) -> Dict[str, float]:
+        p = self.prefix
+        out = {
+            p + "requests_submitted": float(self.submitted.value),
+            p + "requests_finished": float(self.finished.value),
+            p + "requests_shed": float(self.shed.value),
+            p + "tokens_generated": float(self.tokens.value),
+        }
+        out.update(self.ttft_ms.summary(p + "ttft_ms_"))
+        out.update(self.itl_ms.summary(p + "itl_ms_"))
+        return out
+
+
+class TenantPolicy:
+    """Per-tenant quotas, metrics, and SLO burn — the policy plane the
+    engine consults around the shared decode step.
+
+    Quota gate: ``gate(tenant, now)`` is a per-tenant
+    :class:`TokenBucket` consulted by ``submit`` BEFORE the global
+    admission controller, so a tenant that exhausts its own bucket
+    sheds only its own arrivals (``at="tenant_quota"``) and never
+    touches the shared queue bound or another tenant's SLO burn.
+
+    SLO rows in the ``tenancy.slo.objectives`` block name metrics
+    RELATIVE to the tenant panel (``ttft_ms_p95``, ``itl_ms_p99``,
+    ``requests_shed`` ...); each tenant gets its own
+    :class:`SLOWatch` over its own panel snapshot with gauges under
+    ``serving/tenant/<id>/slo/``. With ``shed_burn_threshold > 0`` the
+    per-step ``shed_pass`` trims ONLY the burning tenant's queued,
+    never-started requests."""
+
+    def __init__(self, cfg: TenancyConfig, registry, recorder=None,
+                 now=time.monotonic):
+        self.cfg = cfg
+        self.registry = registry
+        self.recorder = recorder
+        self.now = now
+        self._quotas: Dict[str, Dict] = dict(cfg.quotas or {})
+        slo_block = dict(cfg.slo or {})
+        self._slo_rows = list(slo_block.get("objectives") or [])
+        self._slo_defaults = {k: v for k, v in slo_block.items()
+                              if k not in ("objectives",
+                                           "shed_burn_threshold")}
+        self.shed_burn_threshold = float(
+            slo_block.get("shed_burn_threshold", 0.0))
+        self._panels: Dict[str, _TenantPanel] = {}
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._watches: Dict[str, SLOWatch] = {}
+        for tenant in self._quotas:
+            self.ensure(tenant)
+
+    def configured(self, tenant: str) -> bool:
+        return tenant in self._quotas
+
+    @property
+    def tenants(self) -> List[str]:
+        return sorted(self._panels)
+
+    def ensure(self, tenant: str) -> _TenantPanel:
+        """The tenant's panel, lazily creating panel + bucket + SLO
+        watch on first sight (adapters may be published mid-run)."""
+        panel = self._panels.get(tenant)
+        if panel is not None:
+            return panel
+        _check_tenant_id(tenant)
+        panel = _TenantPanel(self.registry, tenant)
+        self._panels[tenant] = panel
+        q = dict(self._quotas.get(tenant) or {})
+        rate = float(q.get("rate", 0.0))
+        if rate > 0:
+            self._buckets[tenant] = TokenBucket(
+                rate, float(q.get("burst", 1.0)))
+        if self._slo_rows:
+            rows = []
+            for row in self._slo_rows:
+                row = dict(row)
+                row["metric"] = panel.prefix + str(row["metric"])
+                rows.append(row)
+            block = dict(self._slo_defaults)
+            block["objectives"] = rows
+            self._watches[tenant] = SLOWatch.from_config(
+                block, registry=self.registry, recorder=self.recorder,
+                prefix=panel.prefix + "slo/")
+        return panel
+
+    # -------------------------------------------------------------- gates
+
+    def gate(self, tenant: str, now: float) -> bool:
+        """One quota-bucket take for an arriving request; True admits.
+        Tenants without a configured rate are never quota-gated."""
+        bucket = self._buckets.get(tenant)
+        return bucket is None or bucket.try_take(now)
+
+    def burn(self, tenant: str) -> float:
+        watch = self._watches.get(tenant)
+        if watch is None:
+            return 0.0
+        return max((watch.burn_rate(s) for s in watch.slos), default=0.0)
+
+    def max_burn(self) -> float:
+        """Hottest tenant's burn rate — the fleet autoscaler's
+        per-tenant pressure signal (a single tenant blowing its SLO
+        scales the fleet even when aggregate latency looks fine)."""
+        return max((self.burn(t) for t in self._watches), default=0.0)
+
+    def shed_pass(self, sched) -> List:
+        """Tenant-scoped burn shedding: victims are queued, never-
+        started requests OF THE BURNING TENANT only — other tenants'
+        queues are structurally untouchable from here."""
+        thr = self.shed_burn_threshold
+        if thr <= 0 or not self._watches:
+            return []
+        victims = []
+        burning = {t for t in self._watches if self.burn(t) >= thr}
+        if burning:
+            victims = [r for r in sched.sheddable_queued()
+                       if r.tenant in burning]
+        return victims
+
+    # ---------------------------------------------------------- recording
+
+    def on_submit(self, tenant: str) -> None:
+        self.ensure(tenant).submitted.inc()
+
+    def on_finish(self, tenant: str) -> None:
+        self.ensure(tenant).finished.inc()
+
+    def on_shed(self, tenant: str) -> None:
+        self.ensure(tenant).shed.inc()
+
+    def on_token(self, tenant: str) -> None:
+        self.ensure(tenant).tokens.inc()
+
+    def on_ttft(self, tenant: str, ms: float) -> None:
+        self.ensure(tenant).ttft_ms.record(ms)
+
+    def on_itl(self, tenant: str, ms: float) -> None:
+        self.ensure(tenant).itl_ms.record(ms)
+
+    def observe(self, step: Optional[int] = None) -> None:
+        """Feed each tenant watch its OWN panel snapshot (the engine
+        snapshot is an explicit hand-built dict that never carries
+        per-tenant series)."""
+        for tenant, watch in self._watches.items():
+            watch.observe(self._panels[tenant].snapshot(), step=step)
+
+
+# --------------------------------------------------------- servable export
+
+
+def export_adapter_tree(out_dir: str, tree, *, targets, rank: int,
+                        alpha: float, num_layers: int,
+                        tenant: Optional[str] = None) -> str:
+    """Write an adapter-only tree in the AdapterStore servable format:
+    ``manifest.json`` (format/targets/rank/alpha/num_layers/tenant) +
+    ``adapter.npz`` holding fp32 canonical ``[L, ...]`` leaves under
+    ``layers.<target>_lora_{a,b}`` keys. The RAW (unscaled, unpadded)
+    factors are stored; ``publish_adapter`` applies ``alpha/r`` scaling
+    and rank-padding at publish time, so a finished RLHF run's export
+    round-trips into serving without re-deriving from checkpoints.
+    Returns ``out_dir``."""
+    os.makedirs(out_dir, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for t in targets:
+        for suffix in ("_lora_a", "_lora_b"):
+            key = f"{t}{suffix}"
+            leaf = tree["layers"][key]
+            if getattr(leaf, "ndim", 0) == 5 \
+                    and leaf.shape[0] != num_layers:
+                # interleaved-storage [V, S, c, ...] -> canonical [L, ...]
+                leaf = leaf.reshape((num_layers,) + leaf.shape[3:])
+            arrays[f"layers.{key}"] = np.asarray(
+                jax.device_get(leaf.astype(jnp.float32)))
+    manifest = {
+        "format": ADAPTER_FORMAT,
+        "tenant": tenant,
+        "targets": list(targets),
+        "rank": int(rank),
+        "alpha": float(alpha),
+        "num_layers": int(num_layers),
+    }
+    with open(os.path.join(out_dir, ADAPTER_MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    np.savez(os.path.join(out_dir, ADAPTER_WEIGHTS), **arrays)
+    return out_dir
+
+
+def load_adapter_tree(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Load an :func:`export_adapter_tree` directory back into the
+    ``(tree, manifest)`` pair ``publish_adapter`` consumes::
+
+        tree, meta = load_adapter_tree(run_dir)
+        engine.publish_adapter("acme", tree,
+                               alpha=meta["alpha"], rank=meta["rank"])
+    """
+    with open(os.path.join(path, ADAPTER_MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != ADAPTER_FORMAT:
+        raise ValueError(
+            f"{path}: manifest format {manifest.get('format')!r} is not "
+            f"{ADAPTER_FORMAT!r}")
+    data = np.load(os.path.join(path, ADAPTER_WEIGHTS))
+    layers = {}
+    for key in data.files:
+        if not key.startswith("layers."):
+            raise ValueError(f"{path}: unexpected npz entry {key!r}")
+        layers[key[len("layers."):]] = jnp.asarray(data[key])
+    return {"layers": layers}, manifest

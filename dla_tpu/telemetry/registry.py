@@ -329,6 +329,24 @@ CATALOG: Tuple[MetricSpec, ...] = (
        "source's last emitted token -> target install (the stream gap "
        "a migrated request's first post-handoff ITL sample includes)",
        "step"),
+    # -- multi-tenant adapter pool (serving.tenancy): device-resident
+    #    stacked LoRA A/B pools serving N tenants through one decode
+    #    step. Counters are store-side plain ints, delta-mirrored by the
+    #    engine (speculative-counter idiom) so totals survive supervisor
+    #    rebuilds; per-tenant series ride the serving/tenant/ dynamic
+    #    prefix below.
+    _s("serving/adapter_pool/resident", "gauge", "adapters",
+       "tenant adapters currently resident in the device pool "
+       "(slot 0, the all-zeros base identity, excluded)", "step"),
+    _s("serving/adapter_pool/publishes", "counter", "publishes",
+       "publish_adapter hot-swaps installed into the pool "
+       "(treedef-validated, recompile-free)", "step"),
+    _s("serving/adapter_pool/loads", "counter", "loads",
+       "cold adapters re-admitted to the device pool from their "
+       "host-side copies (load-on-admission)", "step"),
+    _s("serving/adapter_pool/spills", "counter", "spills",
+       "resident adapters evicted to host-only (LRU over refcount-0 "
+       "residents when the pool is full)", "step"),
     # -- serving gateway (serving.gateway): the HTTP front door. Handler
     #    threads bump plain-int stats; the gateway's engine loop delta-
     #    mirrors them into the gateway-owned registry (speculative-
@@ -488,6 +506,7 @@ DYNAMIC_PREFIXES: Tuple[str, ...] = ("train/rms/", "train/aux/", "eval/",
                                      "telemetry/anomaly/",
                                      "serving/fleet/engine/",
                                      "serving/federation/peer/",
+                                     "serving/tenant/",
                                      "fleet/peer/")
 
 #: Derived suffixes ``latency_summary`` appends to histogram base names.

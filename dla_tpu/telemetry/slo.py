@@ -97,16 +97,22 @@ class SLOWatch:
     """
 
     def __init__(self, slos: List[SLO], registry=None, recorder=None,
-                 now=time.monotonic):
+                 now=time.monotonic, prefix: str = "slo/"):
         self.slos = list(slos)
         self.registry = registry
         self.recorder = recorder
         self.now = now
+        # gauge-name namespace: the default "slo/" serves the global
+        # watch; per-tenant watches pass "serving/tenant/<id>/slo/" so
+        # one process can expose N isolated burn surfaces (both live
+        # under DYNAMIC_PREFIXES, so the names stay catalog-legal)
+        self.prefix = prefix
         self._state = {s.name: _State() for s in self.slos}
 
     @classmethod
     def from_config(cls, cfg: Optional[Dict[str, Any]], registry=None,
-                    recorder=None) -> Optional["SLOWatch"]:
+                    recorder=None, prefix: str = "slo/",
+                    ) -> Optional["SLOWatch"]:
         """Build from a config ``slo:`` block; None without objectives."""
         cfg = dict(cfg or {})
         rows = cfg.get("objectives") or []
@@ -124,7 +130,8 @@ class SLOWatch:
             ))
         if not slos:
             return None
-        return cls(slos, registry=registry, recorder=recorder)
+        return cls(slos, registry=registry, recorder=recorder,
+                   prefix=prefix)
 
     def burn_rate(self, slo: SLO) -> float:
         """Violating fraction of the current window over the budget."""
@@ -158,9 +165,9 @@ class SLOWatch:
                     self._alert(slo, burn, value, step)
             else:
                 st.alerting = False      # re-arm below the line
-            out[f"slo/{slo.name}_ok"] = 0.0 if st.alerting else 1.0
-            out[f"slo/{slo.name}_burn_rate"] = burn
-            out[f"slo/{slo.name}_alerts"] = float(st.alerts)
+            out[f"{self.prefix}{slo.name}_ok"] = 0.0 if st.alerting else 1.0
+            out[f"{self.prefix}{slo.name}_burn_rate"] = burn
+            out[f"{self.prefix}{slo.name}_alerts"] = float(st.alerts)
         if self.registry is not None:
             for name, v in out.items():
                 inst = self.registry._instruments.get(name)

@@ -537,6 +537,7 @@ class FleetSchema:
     prefix_weight: Any = None
     load_weight: Any = None
     sticky_bonus: Any = None
+    adapter_weight: Any = None
     autoscale: Any = None
     scale_up_burn: Any = None
     scale_up_pressure: Any = None
@@ -568,6 +569,27 @@ class MigrationSchema:
     the disaggregated fleet (auto / device / host)."""
     enabled: Any = None
     transport: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterPoolSchema:
+    """serving.tenancy.AdapterPoolConfig: the device-resident LoRA
+    adapter pool behind multi-tenant serving (capacity, rank padding,
+    target projections)."""
+    max_adapters: Any = None
+    max_rank: Any = None
+    targets: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TenancySchema:
+    """serving.tenancy.TenancyConfig: multi-tenant serving — the
+    adapter pool plus per-tenant quota buckets and SLO objectives
+    (docs/SERVING.md "Multi-tenant serving")."""
+    enabled: Any = None
+    adapter_pool: Optional[AdapterPoolSchema] = None
+    quotas: Any = None
+    slo: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -606,6 +628,7 @@ class ServingLatencySchema:
     disagg: Optional[DisaggSchema] = None
     migration: Optional[MigrationSchema] = None
     gateway: Optional[GatewaySchema] = None
+    tenancy: Optional[TenancySchema] = None
 
 
 @dataclasses.dataclass(frozen=True)

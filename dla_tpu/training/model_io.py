@@ -245,3 +245,26 @@ def save_merged_lora_final(trainer, bundle: ModelBundle, base_params,
         trainer.step, {"params": merged}, aux, tag="merged")
     log_rank_zero("[dla_tpu] wrote merged (LoRA-folded) checkpoint "
                   "(`latest` -> merged; training state kept in `final`)")
+    # alongside the fold, export the RAW adapter tree in the
+    # AdapterStore servable format (manifest.json + adapter.npz): the
+    # multi-tenant serving path loads this via tenancy.load_adapter_tree
+    # and serves it unmerged — one base-weight engine, N such adapters
+    cfg = bundle.config
+    tree = adapters if adapters is not None else trainer.params
+    layers = tree.get("layers") if isinstance(tree, dict) else None
+    # only the causal-LM adapter layout is servable: reward-model
+    # adapter trees (no target-keyed ``layers`` block) merge fine above
+    # but have no multi-tenant decode path to export for
+    servable = isinstance(layers, dict) and all(
+        f"{t}_lora_{s}" in layers
+        for t in cfg.lora_targets for s in ("a", "b"))
+    if servable and getattr(trainer.checkpointer, "is_main", True):
+        from dla_tpu.serving.tenancy import export_adapter_tree
+        out = export_adapter_tree(
+            str(Path(trainer.checkpointer.dir) / "adapter_servable"),
+            tree,
+            targets=tuple(cfg.lora_targets), rank=int(cfg.lora_r),
+            alpha=float(cfg.lora_alpha), num_layers=int(cfg.num_layers))
+        log_rank_zero(f"[dla_tpu] wrote servable adapter export at {out} "
+                      "(publish_adapter-loadable; see docs/SERVING.md "
+                      "\"Multi-tenant serving\")")
